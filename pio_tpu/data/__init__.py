@@ -1,0 +1,23 @@
+"""Event data model: Event, DataMap, PropertyMap, BiMap, aggregation.
+
+Rebuild of the reference's ``data/src/main/scala/o/a/p/data/storage/``
+event model (Event.scala, DataMap.scala, PropertyMap.scala, BiMap.scala,
+LEventAggregator.scala — paths UNVERIFIED, reference mount was empty; see
+SURVEY.md provenance warning).
+"""
+
+from pio_tpu.data.datamap import DataMap, PropertyMap
+from pio_tpu.data.event import Event, EventValidationError, validate_event
+from pio_tpu.data.bimap import BiMap
+from pio_tpu.data.aggregation import aggregate_properties, fold_properties
+
+__all__ = [
+    "DataMap",
+    "PropertyMap",
+    "Event",
+    "EventValidationError",
+    "validate_event",
+    "BiMap",
+    "aggregate_properties",
+    "fold_properties",
+]

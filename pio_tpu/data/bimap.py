@@ -1,0 +1,87 @@
+"""BiMap — immutable bidirectional map.
+
+Rebuild of the reference's ``data/.../data/storage/BiMap.scala`` (UNVERIFIED
+path; see SURVEY.md). The main use is indexing string entity ids into dense
+integer ids for matrix-factorization models (``BiMap.stringLong`` /
+``stringInt`` in the reference). Unlike the reference — where the index
+assignment order comes from RDD partition order — we assign indices over
+**sorted** keys so index maps are deterministic and reproducible across runs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Generic, Hashable, Iterable, Iterator, Mapping, Optional, TypeVar
+
+K = TypeVar("K", bound=Hashable)
+V = TypeVar("V", bound=Hashable)
+
+
+class BiMap(Generic[K, V]):
+    """Immutable one-to-one mapping with O(1) lookups both ways."""
+
+    __slots__ = ("_fwd", "_rev")
+
+    def __init__(self, mapping: Mapping[K, V], _rev: Optional[Dict[V, K]] = None):
+        self._fwd: Dict[K, V] = dict(mapping)
+        if _rev is None:
+            _rev = {v: k for k, v in self._fwd.items()}
+            if len(_rev) != len(self._fwd):
+                raise ValueError("BiMap values must be unique")
+        self._rev: Dict[V, K] = _rev
+
+    # -- lookups ------------------------------------------------------------
+    def __getitem__(self, key: K) -> V:
+        return self._fwd[key]
+
+    def get(self, key: K, default: Optional[V] = None) -> Optional[V]:
+        return self._fwd.get(key, default)
+
+    def __contains__(self, key: object) -> bool:
+        return key in self._fwd
+
+    def __len__(self) -> int:
+        return len(self._fwd)
+
+    def __iter__(self) -> Iterator[K]:
+        return iter(self._fwd)
+
+    def keys(self):
+        return self._fwd.keys()
+
+    def values(self):
+        return self._fwd.values()
+
+    def items(self):
+        return self._fwd.items()
+
+    @property
+    def inverse(self) -> "BiMap[V, K]":
+        """Flipped view (reference ``BiMap.inverse``)."""
+        return BiMap(self._rev, _rev=self._fwd)
+
+    def to_dict(self) -> Dict[K, V]:
+        return dict(self._fwd)
+
+    def take(self, n: int) -> "BiMap[K, V]":
+        sub = dict(list(self._fwd.items())[:n])
+        return BiMap(sub)
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, BiMap):
+            return self._fwd == other._fwd
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"BiMap({self._fwd!r})"
+
+    # -- constructors (reference stringInt / stringLong) --------------------
+    @staticmethod
+    def string_int(keys: Iterable[str]) -> "BiMap[str, int]":
+        """Index distinct keys 0..n-1. Keys are sorted first for determinism
+        (deviation from the reference's RDD-order assignment, documented)."""
+        distinct = sorted(set(keys))
+        return BiMap({k: i for i, k in enumerate(distinct)})
+
+    # The reference distinguishes Int vs Long indices (JVM); in Python both
+    # are `int`, so stringLong is an alias kept for API parity.
+    string_long = string_int

@@ -1,0 +1,24 @@
+"""Test configuration.
+
+Tests run on CPU with 8 simulated XLA devices so multi-chip sharding paths
+are exercised without TPU hardware (the reference's analog: running Spark
+suites on ``local[*]`` — SURVEY.md §4). Must run before the first jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_home(tmp_path, monkeypatch):
+    """Isolated PIO home directory for storage/metadata tests."""
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path))
+    return tmp_path

@@ -1,0 +1,215 @@
+"""Event core tests: Event validation, DataMap, BiMap, aggregation fold.
+
+Mirrors the reference's DataMapSpec / BiMapSpec / EventJson4sSupport
+round-trip specs and LEventAggregator semantics (SURVEY.md §4).
+"""
+
+import datetime as dt
+
+import pytest
+
+from pio_tpu.data import (
+    BiMap,
+    DataMap,
+    Event,
+    EventValidationError,
+    aggregate_properties,
+    fold_properties,
+    validate_event,
+)
+from pio_tpu.data.datamap import DataMapError
+
+
+def T(h, m=0, s=0):
+    return dt.datetime(2026, 1, 1, h, m, s, tzinfo=dt.timezone.utc)
+
+
+# ---------------------------------------------------------------- DataMap
+class TestDataMap:
+    def test_typed_get(self):
+        d = DataMap({"a": 1, "b": "x", "c": 2.5, "d": [1, 2], "e": {"k": 1}, "f": True})
+        assert d.get("a", int) == 1
+        assert d.get_string("b") == "x"
+        assert d.get_double("c") == 2.5
+        assert d.get_double("a") == 1.0  # int coerces to float
+        assert d.get("d", list) == [1, 2]
+        assert d.get("f", bool) is True
+
+    def test_missing_and_null(self):
+        d = DataMap({"a": None})
+        with pytest.raises(DataMapError):
+            d.get("zzz")
+        with pytest.raises(DataMapError):
+            d.get("a")
+        assert d.get_opt("a") is None
+        assert d.get_opt("zzz") is None
+        assert d.get_or_else("zzz", 7) == 7
+
+    def test_type_mismatch(self):
+        d = DataMap({"a": "str"})
+        with pytest.raises(DataMapError):
+            d.get("a", int)
+
+    def test_union_minus(self):
+        d = DataMap({"a": 1, "b": 2})
+        assert d.union({"b": 3, "c": 4}).to_dict() == {"a": 1, "b": 3, "c": 4}
+        assert d.minus(["b"]).to_dict() == {"a": 1}
+
+    def test_json_roundtrip(self):
+        d = DataMap({"a": 1, "b": [1, "x"], "c": {"n": None}})
+        assert DataMap.from_json(d.to_json()) == d
+
+    def test_string_list(self):
+        assert DataMap({"a": ["x", "y"]}).get_string_list("a") == ["x", "y"]
+        with pytest.raises(DataMapError):
+            DataMap({"a": ["x", 1]}).get_string_list("a")
+
+
+# ---------------------------------------------------------------- BiMap
+class TestBiMap:
+    def test_bidirectional(self):
+        m = BiMap({"a": 1, "b": 2})
+        assert m["a"] == 1
+        assert m.inverse[2] == "b"
+        assert m.inverse.inverse["a"] == 1
+
+    def test_duplicate_values_rejected(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 1, "b": 1})
+
+    def test_string_int_deterministic(self):
+        m = BiMap.string_int(["u3", "u1", "u2", "u1"])
+        assert m.to_dict() == {"u1": 0, "u2": 1, "u3": 2}
+        assert BiMap.string_long(["u3", "u1", "u2"]) == m
+
+    def test_get_and_contains(self):
+        m = BiMap.string_int(["x"])
+        assert "x" in m and m.get("y") is None and len(m) == 1
+
+
+# ---------------------------------------------------------------- Validation
+class TestEventValidation:
+    def test_basic_ok(self):
+        validate_event(Event("rate", "user", "u1", "item", "i1"))
+
+    def test_empty_fields(self):
+        for kwargs in (
+            dict(event="", entity_type="user", entity_id="u1"),
+            dict(event="rate", entity_type="", entity_id="u1"),
+            dict(event="rate", entity_type="user", entity_id=""),
+        ):
+            with pytest.raises(EventValidationError):
+                validate_event(Event(**kwargs))
+
+    def test_target_entity_pairing(self):
+        with pytest.raises(EventValidationError):
+            validate_event(Event("rate", "user", "u1", target_entity_type="item"))
+        with pytest.raises(EventValidationError):
+            validate_event(Event("rate", "user", "u1", target_entity_id="i1"))
+
+    def test_dollar_names_reserved(self):
+        with pytest.raises(EventValidationError):
+            validate_event(Event("$foo", "user", "u1"))
+        validate_event(Event("$set", "user", "u1", properties={"a": 1}))
+
+    def test_special_event_rules(self):
+        with pytest.raises(EventValidationError):  # $set with target entity
+            validate_event(Event("$set", "user", "u1", "item", "i1"))
+        with pytest.raises(EventValidationError):  # $unset empty properties
+            validate_event(Event("$unset", "user", "u1"))
+        with pytest.raises(EventValidationError):  # $delete with properties
+            validate_event(Event("$delete", "user", "u1", properties={"a": 1}))
+        validate_event(Event("$delete", "user", "u1"))
+
+    def test_reserved_prefixes(self):
+        with pytest.raises(EventValidationError):
+            validate_event(Event("rate", "pio_user", "u1"))
+        with pytest.raises(EventValidationError):
+            validate_event(Event("rate", "user", "u1", properties={"pio_x": 1}))
+        with pytest.raises(EventValidationError):
+            validate_event(Event("rate", "user", "u1", properties={"$x": 1}))
+        # builtin entity type allowed
+        validate_event(Event("predict", "pio_pr", "p1"))
+
+    def test_api_roundtrip(self):
+        e = Event(
+            "buy", "user", "u1", "item", "i42",
+            properties={"price": 9.99},
+            event_time=T(12), tags=("t1",), pr_id="pr9",
+            event_id="abc",
+        )
+        d = e.to_api_dict()
+        e2 = Event.from_api_dict(d)
+        assert e2.event == "buy" and e2.entity_id == "u1"
+        assert e2.target_entity_id == "i42"
+        assert e2.properties.get_double("price") == 9.99
+        assert e2.event_time == T(12)
+        assert e2.tags == ("t1",) and e2.pr_id == "pr9" and e2.event_id == "abc"
+
+    def test_api_parse_errors(self):
+        with pytest.raises(EventValidationError):
+            Event.from_api_dict({"event": "x"})
+        with pytest.raises(EventValidationError):
+            Event.from_api_dict(
+                {"event": "x", "entityType": "u", "entityId": "1", "eventTime": "nope"}
+            )
+
+    def test_naive_datetime_becomes_utc(self):
+        e = Event("rate", "user", "u1", event_time=dt.datetime(2026, 1, 1))
+        assert e.event_time.tzinfo is dt.timezone.utc
+
+
+# ---------------------------------------------------------------- Aggregation
+def ev(name, t, props=None, eid="u1"):
+    return Event(name, "user", eid, properties=props or {}, event_time=t)
+
+
+class TestAggregation:
+    def test_set_last_write_wins(self):
+        pm = fold_properties(
+            [
+                ev("$set", T(1), {"a": 1, "b": 1}),
+                ev("$set", T(3), {"a": 3}),
+                ev("$set", T(2), {"a": 2, "c": 2}),
+            ]
+        )
+        assert pm.to_dict() == {"a": 3, "b": 1, "c": 2}
+        assert pm.first_updated == T(1)
+        assert pm.last_updated == T(3)
+
+    def test_unset_removes_keys(self):
+        pm = fold_properties(
+            [
+                ev("$set", T(1), {"a": 1, "b": 1}),
+                ev("$unset", T(2), {"a": None}),
+            ]
+        )
+        assert pm.to_dict() == {"b": 1}
+        assert pm.last_updated == T(2)
+
+    def test_delete_clears_and_restarts_watermark(self):
+        pm = fold_properties(
+            [
+                ev("$set", T(1), {"a": 1}),
+                ev("$delete", T(2)),
+                ev("$set", T(3), {"b": 2}),
+            ]
+        )
+        assert pm.to_dict() == {"b": 2}
+        assert pm.first_updated == T(3)
+
+    def test_final_delete_yields_none(self):
+        assert fold_properties([ev("$set", T(1), {"a": 1}), ev("$delete", T(2))]) is None
+        assert fold_properties([ev("$unset", T(1), {"a": None})]) is None
+
+    def test_aggregate_groups_entities(self):
+        out = aggregate_properties(
+            [
+                ev("$set", T(1), {"a": 1}, eid="u1"),
+                ev("$set", T(1), {"a": 2}, eid="u2"),
+                ev("$delete", T(2), eid="u2"),
+                ev("rate", T(3), {"r": 5}, eid="u1"),  # non-special ignored
+            ]
+        )
+        assert set(out) == {("user", "u1")}
+        assert out[("user", "u1")].to_dict() == {"a": 1}
