@@ -9,6 +9,7 @@ JSON object; ``get`` raises on a missing key, ``get_opt`` returns ``None``.
 
 from __future__ import annotations
 
+import copy as _copy
 import datetime as _dt
 import json
 from typing import Any, Iterable, Iterator, Mapping, Optional, Type, TypeVar
@@ -56,7 +57,10 @@ class DataMap:
     __slots__ = ("_fields",)
 
     def __init__(self, fields: Optional[Mapping[str, JsonValue]] = None):
-        self._fields: dict = dict(fields or {})
+        # Deep-copy once at construction: container values can then be
+        # returned directly from getters without leaking mutable internals,
+        # and outside mutation of the source dict can't reach us either.
+        self._fields: dict = _copy.deepcopy(dict(fields)) if fields else {}
 
     # -- Mapping protocol ---------------------------------------------------
     def __getitem__(self, key: str) -> JsonValue:
@@ -81,6 +85,10 @@ class DataMap:
         return self._fields.items()
 
     def __eq__(self, other: object) -> bool:
+        if isinstance(other, PropertyMap):
+            # A plain DataMap never equals a PropertyMap (whose identity
+            # includes timestamps) — keeps == transitive.
+            return False
         if isinstance(other, DataMap):
             return self._fields == other._fields
         if isinstance(other, Mapping):
@@ -99,18 +107,26 @@ class DataMap:
             raise DataMapError(f"The field {name!r} is required.")
 
     def get(self, name: str, typ: Optional[Type[T]] = None) -> T:  # type: ignore[override]
-        """Mandatory typed get — raises :class:`DataMapError` if absent/null."""
+        """Mandatory typed get — raises :class:`DataMapError` if absent/null.
+
+        Container values come back as copies so callers can't mutate the
+        (immutable) map through them.
+        """
         self.require(name)
         value = self._fields[name]
         if value is None:
             raise DataMapError(f"The required field {name!r} cannot be null.")
-        return _check_type(name, value, typ)
+        value = _check_type(name, value, typ)
+        # Containers come back as copies so callers can't mutate the map
+        # (hash stability); scalar gets — the common case — stay copy-free.
+        return _copy.deepcopy(value) if isinstance(value, (list, dict)) else value
 
     def get_opt(self, name: str, typ: Optional[Type[T]] = None) -> Optional[T]:
         value = self._fields.get(name)
         if value is None:
             return None
-        return _check_type(name, value, typ)
+        value = _check_type(name, value, typ)
+        return _copy.deepcopy(value) if isinstance(value, (list, dict)) else value
 
     def get_or_else(self, name: str, default: T, typ: Optional[Type[T]] = None) -> T:
         value = self.get_opt(name, typ)
@@ -140,6 +156,8 @@ class DataMap:
 
     # -- JSON ---------------------------------------------------------------
     def to_dict(self) -> dict:
+        """Shallow copy — hot paths (EventFrame) read it without per-row
+        deep copies; callers must not mutate nested containers."""
         return dict(self._fields)
 
     def to_json(self) -> str:
@@ -191,6 +209,10 @@ class PropertyMap(DataMap):
                 and self.first_updated == other.first_updated
                 and self.last_updated == other.last_updated
             )
-        return super().__eq__(other)
+        # Never equal to a plain DataMap/dict: delegating to field-only
+        # equality would make == non-transitive across PropertyMaps with
+        # different timestamps. Must be False, not NotImplemented — the
+        # reflected DataMap.__eq__ would otherwise field-compare.
+        return False
 
     __hash__ = DataMap.__hash__

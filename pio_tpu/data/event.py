@@ -56,16 +56,19 @@ class Event:
     creation_time: _dt.datetime = field(default_factory=_utcnow)
 
     def __post_init__(self):
-        # Normalize: naive datetimes are taken as UTC; properties may arrive
+        # Normalize: naive datetimes are taken as UTC; times truncate to
+        # millisecond precision (Joda-time parity — keeps in-memory values
+        # identical to their wire/storage round-trip); properties may arrive
         # as a plain mapping.
         if not isinstance(self.properties, DataMap):
             object.__setattr__(self, "properties", DataMap(self.properties))
         for attr in ("event_time", "creation_time"):
             value = getattr(self, attr)
-            if isinstance(value, _dt.datetime) and value.tzinfo is None:
-                object.__setattr__(
-                    self, attr, value.replace(tzinfo=_dt.timezone.utc)
-                )
+            if isinstance(value, _dt.datetime):
+                if value.tzinfo is None:
+                    value = value.replace(tzinfo=_dt.timezone.utc)
+                value = value.replace(microsecond=value.microsecond // 1000 * 1000)
+                object.__setattr__(self, attr, value)
         if isinstance(self.tags, list):
             object.__setattr__(self, "tags", tuple(self.tags))
 
@@ -102,12 +105,6 @@ class Event:
     @classmethod
     def from_api_dict(cls, d: Mapping[str, Any]) -> "Event":
         """Parse the Event-Server wire format (camelCase keys)."""
-        if "event" not in d:
-            raise EventValidationError("field 'event' is required")
-        if "entityType" not in d:
-            raise EventValidationError("field 'entityType' is required")
-        if "entityId" not in d:
-            raise EventValidationError("field 'entityId' is required")
         props = d.get("properties")
         if props is None:
             props = {}
@@ -139,6 +136,8 @@ class Event:
 
 
 def _req_str(d: Mapping[str, Any], key: str) -> str:
+    if key not in d:
+        raise EventValidationError(f"field {key!r} is required")
     v = d[key]
     if not isinstance(v, str):
         raise EventValidationError(f"field {key!r} must be a string")

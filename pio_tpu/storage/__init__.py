@@ -1,0 +1,53 @@
+"""Storage layer: SPI traits, backends, registry, columnar event frames.
+
+Rebuild of the reference's storage subsystem (``data/.../data/storage/`` +
+``storage/*`` subprojects — UNVERIFIED paths; see SURVEY.md). Backends:
+in-memory (tests/ephemeral), SQLite (quickstart default ≙ reference JDBC),
+Parquet shards (bulk/training ≙ reference HBase), LocalFS model blobs.
+"""
+
+from pio_tpu.storage.base import (
+    AccessKeys,
+    Apps,
+    Channels,
+    EngineInstances,
+    EvaluationInstances,
+    LEvents,
+    Models,
+    PEvents,
+    StorageError,
+)
+from pio_tpu.storage.frame import EventFrame
+from pio_tpu.storage.records import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+    RunStatus,
+)
+from pio_tpu.storage.registry import Storage, StorageConfigError, pio_home
+
+__all__ = [
+    "AccessKey",
+    "AccessKeys",
+    "App",
+    "Apps",
+    "Channel",
+    "Channels",
+    "EngineInstance",
+    "EngineInstances",
+    "EvaluationInstance",
+    "EvaluationInstances",
+    "EventFrame",
+    "LEvents",
+    "Model",
+    "Models",
+    "PEvents",
+    "RunStatus",
+    "Storage",
+    "StorageConfigError",
+    "StorageError",
+    "pio_home",
+]

@@ -1,0 +1,141 @@
+"""EventFrame — columnar event batches that become device arrays.
+
+This is the TPU-native replacement for the reference's ``RDD[Event]``
+(``PEvents.find(...)(sc)`` in ``data/.../data/storage/PEvents.scala``,
+UNVERIFIED path): instead of a distributed collection of JVM objects, bulk
+event reads materialize as host-side columnar arrays, and
+:meth:`EventFrame.to_device_arrays` places numeric columns onto a
+``jax.sharding.Mesh`` batch axis (padded to the mesh divisor, with a mask) so
+DataSources feed sharded jit programs directly.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pio_tpu.data.bimap import BiMap
+from pio_tpu.data.event import Event
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+
+def _to_micros(t: _dt.datetime) -> int:
+    return int((t - _EPOCH).total_seconds() * 1e6)
+
+
+class EventFrame:
+    """A batch of events in column-oriented form."""
+
+    def __init__(
+        self,
+        event: np.ndarray,
+        entity_type: np.ndarray,
+        entity_id: np.ndarray,
+        target_entity_type: np.ndarray,
+        target_entity_id: np.ndarray,
+        properties: List[dict],
+        event_time_us: np.ndarray,
+    ):
+        self.event = event
+        self.entity_type = entity_type
+        self.entity_id = entity_id
+        self.target_entity_type = target_entity_type
+        self.target_entity_id = target_entity_id
+        self.properties = properties
+        self.event_time_us = event_time_us
+
+    @classmethod
+    def from_events(cls, events: Iterable[Event]) -> "EventFrame":
+        evs = list(events)
+        return cls(
+            event=np.array([e.event for e in evs], dtype=object),
+            entity_type=np.array([e.entity_type for e in evs], dtype=object),
+            entity_id=np.array([e.entity_id for e in evs], dtype=object),
+            target_entity_type=np.array(
+                [e.target_entity_type or "" for e in evs], dtype=object
+            ),
+            target_entity_id=np.array(
+                [e.target_entity_id or "" for e in evs], dtype=object
+            ),
+            properties=[e.properties.to_dict() for e in evs],
+            event_time_us=np.array([_to_micros(e.event_time) for e in evs], dtype=np.int64),
+        )
+
+    def __len__(self) -> int:
+        return len(self.event)
+
+    # -- column extraction --------------------------------------------------
+    def property_column(
+        self, name: str, dtype=np.float32, default: float = np.nan
+    ) -> np.ndarray:
+        """Numeric property column; missing values become ``default``."""
+        out = np.full(len(self), default, dtype=dtype)
+        for i, p in enumerate(self.properties):
+            v = p.get(name)
+            if v is not None:
+                out[i] = v
+        return out
+
+    def codes(
+        self, column: str, index: Optional[BiMap] = None
+    ) -> Tuple[BiMap, np.ndarray]:
+        """Index a string column into dense int32 codes.
+
+        Returns (BiMap, codes). Unseen ids under a supplied ``index`` map to
+        -1 (callers mask them out).
+        """
+        col = getattr(self, column)
+        if index is None:
+            index = BiMap.string_int(col.tolist())
+        fwd = index.to_dict()
+        codes = np.array([fwd.get(v, -1) for v in col.tolist()], dtype=np.int32)
+        return index, codes
+
+    # -- device placement ---------------------------------------------------
+    def to_device_arrays(
+        self,
+        columns: Dict[str, np.ndarray],
+        mesh=None,
+        axis_name: str = "data",
+    ):
+        """Place host columns on devices, sharded along the batch dim.
+
+        ``columns`` maps name -> 1-D host array (all equal length). Arrays
+        are padded up to a multiple of the mesh axis size; the returned dict
+        gains a ``"mask"`` float column that is 1 for real rows, 0 for pad.
+        Without a mesh, arrays go to the default device unsharded.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        n = None
+        for v in columns.values():
+            if n is None:
+                n = len(v)
+            elif len(v) != n:
+                raise ValueError("all columns must have equal length")
+        if n is None:
+            raise ValueError("no columns given")
+
+        if mesh is None:
+            out = {k: jnp.asarray(v) for k, v in columns.items()}
+            out["mask"] = jnp.ones((n,), dtype=jnp.float32)
+            return out
+
+        shards = mesh.devices.size
+        padded = -(-n // shards) * shards
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(axis_name)
+        )
+        out = {}
+        for k, v in columns.items():
+            pv = np.zeros((padded,), dtype=v.dtype)
+            pv[:n] = v
+            out[k] = jax.device_put(pv, sharding)
+        mask = np.zeros((padded,), dtype=np.float32)
+        mask[:n] = 1.0
+        out["mask"] = jax.device_put(mask, sharding)
+        return out

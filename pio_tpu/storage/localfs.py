@@ -1,0 +1,43 @@
+"""LocalFS model-blob store.
+
+Rebuild of the reference's ``storage/localfs/.../LocalFSModels.scala``
+(UNVERIFIED path; see SURVEY.md): one file per engine-instance id.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from pio_tpu.storage import base
+from pio_tpu.storage.records import Model
+
+
+class LocalFSModels(base.Models):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, model_id: str) -> str:
+        safe = model_id.replace("/", "_")
+        return os.path.join(self.root, f"{safe}.bin")
+
+    def insert(self, model: Model) -> None:
+        tmp = self._path(model.id) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(model.models)
+        os.replace(tmp, self._path(model.id))
+
+    def get(self, model_id: str) -> Optional[Model]:
+        p = self._path(model_id)
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return Model(model_id, f.read())
+
+    def delete(self, model_id: str) -> bool:
+        p = self._path(model_id)
+        if os.path.exists(p):
+            os.remove(p)
+            return True
+        return False

@@ -1,0 +1,220 @@
+"""Storage registry — env-var-driven backend wiring.
+
+Rebuild of the reference's ``data/.../data/storage/Storage.scala``
+(UNVERIFIED path; see SURVEY.md): three repositories (METADATA, EVENTDATA,
+MODELDATA) each bound to a named source; sources declare a backend type.
+
+Environment scheme (parity with the reference's ``PIO_STORAGE_*``):
+
+    PIO_STORAGE_REPOSITORIES_METADATA_SOURCE=MYSQLITE
+    PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=MYPARQUET
+    PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE=MYFS
+    PIO_STORAGE_SOURCES_MYSQLITE_TYPE=sqlite
+    PIO_STORAGE_SOURCES_MYSQLITE_PATH=/path/to/pio.db
+    PIO_STORAGE_SOURCES_MYPARQUET_TYPE=parquet
+    PIO_STORAGE_SOURCES_MYPARQUET_PATH=/path/to/events
+    PIO_STORAGE_SOURCES_MYFS_TYPE=localfs
+    PIO_STORAGE_SOURCES_MYFS_PATH=/path/to/models
+
+Unset → quickstart defaults under ``$PIO_TPU_HOME`` (default
+``~/.pio_tpu``): SQLite for metadata + events, localfs for models.
+Backend types: ``sqlite``, ``memory``, ``parquet`` (events only),
+``localfs`` (models only).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from pio_tpu.storage import base
+from pio_tpu.storage.localfs import LocalFSModels
+from pio_tpu.storage.memory import (
+    MemAccessKeys,
+    MemApps,
+    MemChannels,
+    MemEngineInstances,
+    MemEvaluationInstances,
+    MemLEvents,
+    MemModels,
+    MemPEvents,
+)
+from pio_tpu.storage.parquet import ParquetPEvents
+from pio_tpu.storage.sqlite import (
+    SQLiteAccessKeys,
+    SQLiteApps,
+    SQLiteChannels,
+    SQLiteClient,
+    SQLiteEngineInstances,
+    SQLiteEvaluationInstances,
+    SQLiteEvents,
+    SQLiteModels,
+    SQLitePEvents,
+)
+
+REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+
+class StorageConfigError(base.StorageError):
+    pass
+
+
+def pio_home() -> str:
+    home = os.environ.get("PIO_TPU_HOME")
+    if not home:
+        home = os.path.join(os.path.expanduser("~"), ".pio_tpu")
+    os.makedirs(home, exist_ok=True)
+    return home
+
+
+class _SourceConfig:
+    def __init__(self, name: str, type_: str, path: Optional[str]):
+        self.name = name
+        self.type = type_
+        self.path = path
+
+
+def _source_config(repo: str) -> _SourceConfig:
+    src_name = os.environ.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "")
+    if src_name:
+        type_ = os.environ.get(f"PIO_STORAGE_SOURCES_{src_name}_TYPE")
+        if not type_:
+            raise StorageConfigError(
+                f"source {src_name!r} referenced by {repo} has no "
+                f"PIO_STORAGE_SOURCES_{src_name}_TYPE"
+            )
+        path = os.environ.get(f"PIO_STORAGE_SOURCES_{src_name}_PATH")
+        return _SourceConfig(src_name, type_.lower(), path)
+    # quickstart defaults
+    if repo == "MODELDATA":
+        return _SourceConfig("DEFAULT_FS", "localfs", None)
+    return _SourceConfig("DEFAULT_SQLITE", "sqlite", None)
+
+
+class Storage:
+    """Process-wide registry with per-config caching (thread-safe)."""
+
+    _lock = threading.RLock()
+    _clients: Dict[str, object] = {}
+    _mem: Dict[str, object] = {}
+
+    # -- internal -----------------------------------------------------------
+    @classmethod
+    def _sqlite_client(cls, cfg: _SourceConfig) -> SQLiteClient:
+        path = cfg.path or os.path.join(pio_home(), "pio.db")
+        key = f"sqlite:{path}"
+        with cls._lock:
+            if key not in cls._clients:
+                cls._clients[key] = SQLiteClient(path)
+            return cls._clients[key]  # type: ignore[return-value]
+
+    @classmethod
+    def _memory(cls, kind: str, factory):
+        with cls._lock:
+            if kind not in cls._mem:
+                cls._mem[kind] = factory()
+            return cls._mem[kind]
+
+    @classmethod
+    def reset(cls) -> None:
+        """Drop cached clients (tests use this between isolated homes)."""
+        with cls._lock:
+            cls._clients.clear()
+            cls._mem.clear()
+
+    # -- metadata stores ----------------------------------------------------
+    @classmethod
+    def _meta(cls, sqlite_cls, mem_kind: str, mem_factory):
+        cfg = _source_config("METADATA")
+        if cfg.type == "sqlite":
+            return sqlite_cls(cls._sqlite_client(cfg))
+        if cfg.type == "memory":
+            return cls._memory(mem_kind, mem_factory)
+        raise StorageConfigError(f"backend {cfg.type!r} cannot serve METADATA")
+
+    @classmethod
+    def get_meta_data_apps(cls) -> base.Apps:
+        return cls._meta(SQLiteApps, "apps", MemApps)
+
+    @classmethod
+    def get_meta_data_access_keys(cls) -> base.AccessKeys:
+        return cls._meta(SQLiteAccessKeys, "access_keys", MemAccessKeys)
+
+    @classmethod
+    def get_meta_data_channels(cls) -> base.Channels:
+        return cls._meta(SQLiteChannels, "channels", MemChannels)
+
+    @classmethod
+    def get_meta_data_engine_instances(cls) -> base.EngineInstances:
+        return cls._meta(SQLiteEngineInstances, "engine_instances", MemEngineInstances)
+
+    @classmethod
+    def get_meta_data_evaluation_instances(cls) -> base.EvaluationInstances:
+        return cls._meta(
+            SQLiteEvaluationInstances, "evaluation_instances", MemEvaluationInstances
+        )
+
+    # -- event stores -------------------------------------------------------
+    @classmethod
+    def get_levents(cls) -> base.LEvents:
+        cfg = _source_config("EVENTDATA")
+        if cfg.type == "sqlite":
+            return SQLiteEvents(cls._sqlite_client(cfg))
+        if cfg.type == "memory":
+            return cls._memory("levents", MemLEvents)
+        if cfg.type == "parquet":
+            raise StorageConfigError(
+                "parquet backend is bulk-only (PEvents); pair it with sqlite "
+                "or memory LEvents via a second source"
+            )
+        raise StorageConfigError(f"backend {cfg.type!r} cannot serve EVENTDATA")
+
+    @classmethod
+    def get_pevents(cls) -> base.PEvents:
+        cfg = _source_config("EVENTDATA")
+        if cfg.type == "sqlite":
+            return SQLitePEvents(SQLiteEvents(cls._sqlite_client(cfg)))
+        if cfg.type == "memory":
+            return MemPEvents(cls._memory("levents", MemLEvents))
+        if cfg.type == "parquet":
+            path = cfg.path or os.path.join(pio_home(), "events")
+            return ParquetPEvents(path)
+        raise StorageConfigError(f"backend {cfg.type!r} cannot serve EVENTDATA")
+
+    # -- model store --------------------------------------------------------
+    @classmethod
+    def get_model_data_models(cls) -> base.Models:
+        cfg = _source_config("MODELDATA")
+        if cfg.type == "sqlite":
+            return SQLiteModels(cls._sqlite_client(cfg))
+        if cfg.type == "memory":
+            return cls._memory("models", MemModels)
+        if cfg.type == "localfs":
+            path = cfg.path or os.path.join(pio_home(), "models")
+            return LocalFSModels(path)
+        raise StorageConfigError(f"backend {cfg.type!r} cannot serve MODELDATA")
+
+    # -- health -------------------------------------------------------------
+    @classmethod
+    def verify_all_data_objects(cls) -> Dict[str, bool]:
+        """Connectivity self-check used by ``pio status``
+        (reference ``Storage.verifyAllDataObjects``)."""
+        out = {}
+        checks = {
+            "METADATA/apps": cls.get_meta_data_apps,
+            "METADATA/access_keys": cls.get_meta_data_access_keys,
+            "METADATA/channels": cls.get_meta_data_channels,
+            "METADATA/engine_instances": cls.get_meta_data_engine_instances,
+            "METADATA/evaluation_instances": cls.get_meta_data_evaluation_instances,
+            "EVENTDATA/levents": cls.get_levents,
+            "EVENTDATA/pevents": cls.get_pevents,
+            "MODELDATA/models": cls.get_model_data_models,
+        }
+        for name, fn in checks.items():
+            try:
+                fn()
+                out[name] = True
+            except Exception:
+                out[name] = False
+        return out

@@ -1,0 +1,665 @@
+"""SQLite storage backend — the quickstart default.
+
+Plays the role of the reference's JDBC/PostgreSQL backend
+(``storage/jdbc/src/main/scala/o/a/p/data/storage/jdbc/*`` — UNVERIFIED
+path; see SURVEY.md): implements every SPI trait over a single SQLite file.
+Connections are per-thread (sqlite3 objects can't cross threads); WAL mode
+keeps concurrent server reads cheap.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import sqlite3
+import threading
+import uuid
+from typing import Iterable, List, Optional, Sequence
+
+from pio_tpu.data.datamap import DataMap
+from pio_tpu.data.event import Event
+from pio_tpu.storage import base
+from pio_tpu.storage.records import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS events (
+  id TEXT NOT NULL,
+  app_id INTEGER NOT NULL,
+  channel_id INTEGER NOT NULL DEFAULT 0,
+  event TEXT NOT NULL,
+  entity_type TEXT NOT NULL,
+  entity_id TEXT NOT NULL,
+  target_entity_type TEXT,
+  target_entity_id TEXT,
+  properties TEXT NOT NULL,
+  event_time_us INTEGER NOT NULL,
+  tags TEXT NOT NULL,
+  pr_id TEXT,
+  creation_time_us INTEGER NOT NULL,
+  PRIMARY KEY (app_id, channel_id, id)
+);
+CREATE INDEX IF NOT EXISTS idx_events_scan
+  ON events (app_id, channel_id, event_time_us);
+CREATE INDEX IF NOT EXISTS idx_events_entity
+  ON events (app_id, channel_id, entity_type, entity_id);
+CREATE TABLE IF NOT EXISTS apps (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT UNIQUE NOT NULL,
+  description TEXT
+);
+CREATE TABLE IF NOT EXISTS access_keys (
+  key TEXT PRIMARY KEY,
+  app_id INTEGER NOT NULL,
+  events TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS channels (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL,
+  app_id INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS engine_instances (
+  id TEXT PRIMARY KEY,
+  status TEXT NOT NULL,
+  start_time_us INTEGER NOT NULL,
+  end_time_us INTEGER NOT NULL,
+  engine_id TEXT NOT NULL,
+  engine_version TEXT NOT NULL,
+  engine_variant TEXT NOT NULL,
+  engine_factory TEXT NOT NULL,
+  batch TEXT NOT NULL DEFAULT '',
+  env TEXT NOT NULL DEFAULT '{}',
+  jax_conf TEXT NOT NULL DEFAULT '{}',
+  data_source_params TEXT NOT NULL DEFAULT '{}',
+  preparator_params TEXT NOT NULL DEFAULT '{}',
+  algorithms_params TEXT NOT NULL DEFAULT '[]',
+  serving_params TEXT NOT NULL DEFAULT '{}'
+);
+CREATE TABLE IF NOT EXISTS evaluation_instances (
+  id TEXT PRIMARY KEY,
+  status TEXT NOT NULL,
+  start_time_us INTEGER NOT NULL,
+  end_time_us INTEGER NOT NULL,
+  evaluation_class TEXT NOT NULL DEFAULT '',
+  engine_params_generator_class TEXT NOT NULL DEFAULT '',
+  batch TEXT NOT NULL DEFAULT '',
+  env TEXT NOT NULL DEFAULT '{}',
+  evaluator_results TEXT NOT NULL DEFAULT '',
+  evaluator_results_html TEXT NOT NULL DEFAULT '',
+  evaluator_results_json TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS models (
+  id TEXT PRIMARY KEY,
+  models BLOB NOT NULL
+);
+"""
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+
+def _to_us(t: _dt.datetime) -> int:
+    return int((t - _EPOCH).total_seconds() * 1e6)
+
+
+def _from_us(us: int) -> _dt.datetime:
+    return _EPOCH + _dt.timedelta(microseconds=us)
+
+
+class SQLiteClient:
+    """Per-thread connections to one SQLite file (or shared memory db)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._local = threading.local()
+        self._init_lock = threading.Lock()
+        with self._init_lock:
+            conn = self.conn()
+            conn.executescript(_SCHEMA)
+            conn.commit()
+
+    def conn(self) -> sqlite3.Connection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = sqlite3.connect(self.path, timeout=30.0)
+            c.execute("PRAGMA journal_mode=WAL")
+            c.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = c
+        return c
+
+    def close(self):
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            c.close()
+            self._local.conn = None
+
+
+def _chan(channel_id) -> int:
+    return 0 if channel_id is None else int(channel_id)
+
+
+def _row_to_event(r) -> Event:
+    return Event(
+        event=r[3],
+        entity_type=r[4],
+        entity_id=r[5],
+        target_entity_type=r[6],
+        target_entity_id=r[7],
+        properties=DataMap(json.loads(r[8])),
+        event_time=_from_us(r[9]),
+        tags=tuple(json.loads(r[10])),
+        pr_id=r[11],
+        event_id=r[0],
+        creation_time=_from_us(r[12]),
+    )
+
+
+class SQLiteEvents(base.LEvents, base.PEvents):
+    """LEvents + PEvents over the ``events`` table."""
+
+    def __init__(self, client: SQLiteClient):
+        self._c = client
+
+    def init_channel(self, app_id, channel_id=None) -> bool:
+        return True  # single-table design; nothing to create
+
+    def insert(self, event: Event, app_id, channel_id=None) -> str:
+        eid = event.event_id or Event.new_event_id()
+        conn = self._c.conn()
+        conn.execute(
+            "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                eid,
+                app_id,
+                _chan(channel_id),
+                event.event,
+                event.entity_type,
+                event.entity_id,
+                event.target_entity_type,
+                event.target_entity_id,
+                json.dumps(event.properties.to_dict()),
+                _to_us(event.event_time),
+                json.dumps(list(event.tags)),
+                event.pr_id,
+                _to_us(event.creation_time),
+            ),
+        )
+        conn.commit()
+        return eid
+
+    def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
+        cur = self._c.conn().execute(
+            "SELECT * FROM events WHERE app_id=? AND channel_id=? AND id=?",
+            (app_id, _chan(channel_id), event_id),
+        )
+        r = cur.fetchone()
+        return _row_to_event(r) if r else None
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        conn = self._c.conn()
+        cur = conn.execute(
+            "DELETE FROM events WHERE app_id=? AND channel_id=? AND id=?",
+            (app_id, _chan(channel_id), event_id),
+        )
+        conn.commit()
+        return cur.rowcount > 0
+
+    def find(
+        self,
+        app_id,
+        channel_id=None,
+        start_time=None,
+        until_time=None,
+        entity_type=None,
+        entity_id=None,
+        event_names=None,
+        target_entity_type=None,
+        target_entity_id=None,
+        limit=None,
+        reversed_order=False,
+    ) -> List[Event]:
+        sql = ["SELECT * FROM events WHERE app_id=? AND channel_id=?"]
+        args: list = [app_id, _chan(channel_id)]
+        if start_time is not None:
+            sql.append("AND event_time_us >= ?")
+            args.append(_to_us(start_time))
+        if until_time is not None:
+            sql.append("AND event_time_us < ?")
+            args.append(_to_us(until_time))
+        if entity_type is not None:
+            sql.append("AND entity_type = ?")
+            args.append(entity_type)
+        if entity_id is not None:
+            sql.append("AND entity_id = ?")
+            args.append(entity_id)
+        if event_names is not None:
+            qs = ",".join("?" * len(list(event_names)))
+            sql.append(f"AND event IN ({qs})")
+            args.extend(event_names)
+        if target_entity_type is not None:
+            sql.append("AND target_entity_type = ?")
+            args.append(target_entity_type)
+        if target_entity_id is not None:
+            sql.append("AND target_entity_id = ?")
+            args.append(target_entity_id)
+        sql.append(
+            "ORDER BY event_time_us DESC" if reversed_order else "ORDER BY event_time_us ASC"
+        )
+        if limit is not None and limit >= 0:
+            sql.append("LIMIT ?")
+            args.append(limit)
+        cur = self._c.conn().execute(" ".join(sql), args)
+        return [_row_to_event(r) for r in cur.fetchall()]
+
+    def remove(self, app_id, channel_id=None) -> bool:
+        conn = self._c.conn()
+        conn.execute(
+            "DELETE FROM events WHERE app_id=? AND channel_id=?",
+            (app_id, _chan(channel_id)),
+        )
+        conn.commit()
+        return True
+
+    # -- PEvents ------------------------------------------------------------
+    def write(self, events: Iterable[Event], app_id, channel_id=None) -> None:
+        conn = self._c.conn()
+        rows = []
+        for event in events:
+            eid = event.event_id or Event.new_event_id()
+            rows.append(
+                (
+                    eid,
+                    app_id,
+                    _chan(channel_id),
+                    event.event,
+                    event.entity_type,
+                    event.entity_id,
+                    event.target_entity_type,
+                    event.target_entity_id,
+                    json.dumps(event.properties.to_dict()),
+                    _to_us(event.event_time),
+                    json.dumps(list(event.tags)),
+                    event.pr_id,
+                    _to_us(event.creation_time),
+                )
+            )
+        conn.executemany(
+            "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows
+        )
+        conn.commit()
+
+    def delete_bulk(self, event_ids, app_id, channel_id=None) -> None:
+        conn = self._c.conn()
+        conn.executemany(
+            "DELETE FROM events WHERE app_id=? AND channel_id=? AND id=?",
+            [(app_id, _chan(channel_id), eid) for eid in event_ids],
+        )
+        conn.commit()
+
+    def close(self) -> None:
+        self._c.close()
+
+
+class SQLitePEvents(base.PEvents):
+    """PEvents SPI facade (bulk delete name differs from LEvents.delete)."""
+
+    def __init__(self, events: SQLiteEvents):
+        self._e = events
+
+    def find(self, app_id, channel_id=None, **filters) -> List[Event]:
+        return self._e.find(app_id, channel_id=channel_id, **filters)
+
+    def write(self, events, app_id, channel_id=None) -> None:
+        self._e.write(events, app_id, channel_id)
+
+    def delete(self, event_ids, app_id, channel_id=None) -> None:
+        self._e.delete_bulk(event_ids, app_id, channel_id)
+
+
+class SQLiteApps(base.Apps):
+    def __init__(self, client: SQLiteClient):
+        self._c = client
+
+    def insert(self, app: App) -> Optional[int]:
+        conn = self._c.conn()
+        try:
+            if app.id:
+                cur = conn.execute(
+                    "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
+                    (app.id, app.name, app.description),
+                )
+            else:
+                cur = conn.execute(
+                    "INSERT INTO apps (name, description) VALUES (?,?)",
+                    (app.name, app.description),
+                )
+            conn.commit()
+            return cur.lastrowid if not app.id else app.id
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, app_id: int) -> Optional[App]:
+        r = self._c.conn().execute(
+            "SELECT id, name, description FROM apps WHERE id=?", (app_id,)
+        ).fetchone()
+        return App(*r) if r else None
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        r = self._c.conn().execute(
+            "SELECT id, name, description FROM apps WHERE name=?", (name,)
+        ).fetchone()
+        return App(*r) if r else None
+
+    def get_all(self) -> List[App]:
+        rows = self._c.conn().execute(
+            "SELECT id, name, description FROM apps ORDER BY id"
+        ).fetchall()
+        return [App(*r) for r in rows]
+
+    def update(self, app: App) -> bool:
+        conn = self._c.conn()
+        cur = conn.execute(
+            "UPDATE apps SET name=?, description=? WHERE id=?",
+            (app.name, app.description, app.id),
+        )
+        conn.commit()
+        return cur.rowcount > 0
+
+    def delete(self, app_id: int) -> bool:
+        conn = self._c.conn()
+        cur = conn.execute("DELETE FROM apps WHERE id=?", (app_id,))
+        conn.commit()
+        return cur.rowcount > 0
+
+
+class SQLiteAccessKeys(base.AccessKeys):
+    def __init__(self, client: SQLiteClient):
+        self._c = client
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        ak = access_key
+        if not ak.key:
+            ak = AccessKey.generate(ak.app_id, ak.events)
+        conn = self._c.conn()
+        try:
+            conn.execute(
+                "INSERT INTO access_keys VALUES (?,?,?)",
+                (ak.key, ak.app_id, json.dumps(list(ak.events))),
+            )
+            conn.commit()
+            return ak.key
+        except sqlite3.IntegrityError:
+            return None
+
+    def _row(self, r) -> AccessKey:
+        return AccessKey(r[0], r[1], tuple(json.loads(r[2])))
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        r = self._c.conn().execute(
+            "SELECT * FROM access_keys WHERE key=?", (key,)
+        ).fetchone()
+        return self._row(r) if r else None
+
+    def get_all(self) -> List[AccessKey]:
+        return [self._row(r) for r in self._c.conn().execute(
+            "SELECT * FROM access_keys").fetchall()]
+
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]:
+        return [
+            self._row(r)
+            for r in self._c.conn()
+            .execute("SELECT * FROM access_keys WHERE app_id=?", (app_id,))
+            .fetchall()
+        ]
+
+    def update(self, access_key: AccessKey) -> bool:
+        conn = self._c.conn()
+        cur = conn.execute(
+            "UPDATE access_keys SET app_id=?, events=? WHERE key=?",
+            (access_key.app_id, json.dumps(list(access_key.events)), access_key.key),
+        )
+        conn.commit()
+        return cur.rowcount > 0
+
+    def delete(self, key: str) -> bool:
+        conn = self._c.conn()
+        cur = conn.execute("DELETE FROM access_keys WHERE key=?", (key,))
+        conn.commit()
+        return cur.rowcount > 0
+
+
+class SQLiteChannels(base.Channels):
+    def __init__(self, client: SQLiteClient):
+        self._c = client
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        conn = self._c.conn()
+        try:
+            if channel.id:
+                conn.execute(
+                    "INSERT INTO channels (id, name, app_id) VALUES (?,?,?)",
+                    (channel.id, channel.name, channel.app_id),
+                )
+                conn.commit()
+                return channel.id
+            cur = conn.execute(
+                "INSERT INTO channels (name, app_id) VALUES (?,?)",
+                (channel.name, channel.app_id),
+            )
+            conn.commit()
+            return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        r = self._c.conn().execute(
+            "SELECT id, name, app_id FROM channels WHERE id=?", (channel_id,)
+        ).fetchone()
+        return Channel(*r) if r else None
+
+    def get_by_app_id(self, app_id: int) -> List[Channel]:
+        rows = self._c.conn().execute(
+            "SELECT id, name, app_id FROM channels WHERE app_id=?", (app_id,)
+        ).fetchall()
+        return [Channel(*r) for r in rows]
+
+    def delete(self, channel_id: int) -> bool:
+        conn = self._c.conn()
+        cur = conn.execute("DELETE FROM channels WHERE id=?", (channel_id,))
+        conn.commit()
+        return cur.rowcount > 0
+
+
+class SQLiteEngineInstances(base.EngineInstances):
+    def __init__(self, client: SQLiteClient):
+        self._c = client
+
+    def insert(self, instance: EngineInstance) -> str:
+        iid = instance.id or uuid.uuid4().hex
+        conn = self._c.conn()
+        conn.execute(
+            "INSERT OR REPLACE INTO engine_instances VALUES "
+            "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                iid,
+                instance.status,
+                _to_us(instance.start_time),
+                _to_us(instance.end_time),
+                instance.engine_id,
+                instance.engine_version,
+                instance.engine_variant,
+                instance.engine_factory,
+                instance.batch,
+                json.dumps(instance.env),
+                json.dumps(instance.jax_conf),
+                instance.data_source_params,
+                instance.preparator_params,
+                instance.algorithms_params,
+                instance.serving_params,
+            ),
+        )
+        conn.commit()
+        return iid
+
+    def _row(self, r) -> EngineInstance:
+        return EngineInstance(
+            id=r[0],
+            status=r[1],
+            start_time=_from_us(r[2]),
+            end_time=_from_us(r[3]),
+            engine_id=r[4],
+            engine_version=r[5],
+            engine_variant=r[6],
+            engine_factory=r[7],
+            batch=r[8],
+            env=json.loads(r[9]),
+            jax_conf=json.loads(r[10]),
+            data_source_params=r[11],
+            preparator_params=r[12],
+            algorithms_params=r[13],
+            serving_params=r[14],
+        )
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        r = self._c.conn().execute(
+            "SELECT * FROM engine_instances WHERE id=?", (instance_id,)
+        ).fetchone()
+        return self._row(r) if r else None
+
+    def get_all(self) -> List[EngineInstance]:
+        rows = self._c.conn().execute("SELECT * FROM engine_instances").fetchall()
+        return [self._row(r) for r in rows]
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        rows = self._c.conn().execute(
+            "SELECT * FROM engine_instances WHERE status='COMPLETED' AND "
+            "engine_id=? AND engine_version=? AND engine_variant=? "
+            "ORDER BY start_time_us DESC",
+            (engine_id, engine_version, engine_variant),
+        ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        done = self.get_completed(engine_id, engine_version, engine_variant)
+        return done[0] if done else None
+
+    def update(self, instance: EngineInstance) -> bool:
+        if self.get(instance.id) is None:
+            return False
+        self.insert(instance)
+        return True
+
+    def delete(self, instance_id: str) -> bool:
+        conn = self._c.conn()
+        cur = conn.execute(
+            "DELETE FROM engine_instances WHERE id=?", (instance_id,)
+        )
+        conn.commit()
+        return cur.rowcount > 0
+
+
+class SQLiteEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, client: SQLiteClient):
+        self._c = client
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        iid = instance.id or uuid.uuid4().hex
+        conn = self._c.conn()
+        conn.execute(
+            "INSERT OR REPLACE INTO evaluation_instances VALUES "
+            "(?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                iid,
+                instance.status,
+                _to_us(instance.start_time),
+                _to_us(instance.end_time),
+                instance.evaluation_class,
+                instance.engine_params_generator_class,
+                instance.batch,
+                json.dumps(instance.env),
+                instance.evaluator_results,
+                instance.evaluator_results_html,
+                instance.evaluator_results_json,
+            ),
+        )
+        conn.commit()
+        return iid
+
+    def _row(self, r) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=r[0],
+            status=r[1],
+            start_time=_from_us(r[2]),
+            end_time=_from_us(r[3]),
+            evaluation_class=r[4],
+            engine_params_generator_class=r[5],
+            batch=r[6],
+            env=json.loads(r[7]),
+            evaluator_results=r[8],
+            evaluator_results_html=r[9],
+            evaluator_results_json=r[10],
+        )
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        r = self._c.conn().execute(
+            "SELECT * FROM evaluation_instances WHERE id=?", (instance_id,)
+        ).fetchone()
+        return self._row(r) if r else None
+
+    def get_all(self) -> List[EvaluationInstance]:
+        rows = self._c.conn().execute(
+            "SELECT * FROM evaluation_instances"
+        ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        rows = self._c.conn().execute(
+            "SELECT * FROM evaluation_instances WHERE status='COMPLETED' "
+            "ORDER BY start_time_us DESC"
+        ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        if self.get(instance.id) is None:
+            return False
+        self.insert(instance)
+        return True
+
+    def delete(self, instance_id: str) -> bool:
+        conn = self._c.conn()
+        cur = conn.execute(
+            "DELETE FROM evaluation_instances WHERE id=?", (instance_id,)
+        )
+        conn.commit()
+        return cur.rowcount > 0
+
+
+class SQLiteModels(base.Models):
+    def __init__(self, client: SQLiteClient):
+        self._c = client
+
+    def insert(self, model: Model) -> None:
+        conn = self._c.conn()
+        conn.execute(
+            "INSERT OR REPLACE INTO models VALUES (?,?)", (model.id, model.models)
+        )
+        conn.commit()
+
+    def get(self, model_id: str) -> Optional[Model]:
+        r = self._c.conn().execute(
+            "SELECT id, models FROM models WHERE id=?", (model_id,)
+        ).fetchone()
+        return Model(r[0], r[1]) if r else None
+
+    def delete(self, model_id: str) -> bool:
+        conn = self._c.conn()
+        cur = conn.execute("DELETE FROM models WHERE id=?", (model_id,))
+        conn.commit()
+        return cur.rowcount > 0

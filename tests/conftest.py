@@ -7,12 +7,21 @@ suites on ``local[*]`` — SURVEY.md §4). Must run before the first jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The session image pins the experimental `axon` TPU platform in a way that
+# ignores the JAX_PLATFORMS env var — jax.config.update is the only override
+# that sticks (must happen before any backend touch). Set
+# PIO_TPU_TEST_PLATFORM to run the suite on real hardware instead.
+import jax  # noqa: E402
+
+jax.config.update(
+    "jax_platforms", os.environ.get("PIO_TPU_TEST_PLATFORM", "cpu")
+)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,427 @@
+"""Storage conformance suite: one spec, many backends (SURVEY.md §4).
+
+Mirrors the reference's LEventsSpec / PEventsSpec pattern parameterized over
+backends, plus meta-store CRUD, model store, EventFrame, and registry tests.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from pio_tpu.data import DataMap, Event
+from pio_tpu.storage import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+    RunStatus,
+    Storage,
+)
+from pio_tpu.storage.localfs import LocalFSModels
+from pio_tpu.storage.memory import (
+    MemAccessKeys,
+    MemApps,
+    MemChannels,
+    MemEngineInstances,
+    MemEvaluationInstances,
+    MemLEvents,
+    MemModels,
+    MemPEvents,
+)
+from pio_tpu.storage.parquet import ParquetPEvents
+from pio_tpu.storage.sqlite import (
+    SQLiteAccessKeys,
+    SQLiteApps,
+    SQLiteChannels,
+    SQLiteClient,
+    SQLiteEngineInstances,
+    SQLiteEvaluationInstances,
+    SQLiteEvents,
+    SQLiteModels,
+    SQLitePEvents,
+)
+
+
+def T(h, m=0):
+    return dt.datetime(2026, 2, 1, h, m, tzinfo=dt.timezone.utc)
+
+
+def ev(name, t, eid="u1", etype="user", target=None, props=None):
+    return Event(
+        name,
+        etype,
+        eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=props or {},
+        event_time=t,
+    )
+
+
+@pytest.fixture()
+def sqlite_client(tmp_path):
+    return SQLiteClient(str(tmp_path / "test.db"))
+
+
+# ------------------------------------------------------------------ LEvents
+@pytest.fixture(params=["memory", "sqlite"])
+def levents(request, tmp_path):
+    if request.param == "memory":
+        return MemLEvents()
+    return SQLiteEvents(SQLiteClient(str(tmp_path / "le.db")))
+
+
+class TestLEventsConformance:
+    def test_insert_get_delete(self, levents):
+        e = ev("rate", T(1), target="i1", props={"rating": 4.0})
+        eid = levents.insert(e, app_id=1)
+        got = levents.get(eid, 1)
+        assert got is not None
+        assert got.event == "rate"
+        assert got.target_entity_id == "i1"
+        assert got.properties.get_double("rating") == 4.0
+        assert got.event_id == eid
+        assert levents.delete(eid, 1)
+        assert levents.get(eid, 1) is None
+        assert not levents.delete(eid, 1)
+
+    def test_find_filters(self, levents):
+        levents.insert(ev("rate", T(1), "u1", target="i1"), 1)
+        levents.insert(ev("buy", T(2), "u1", target="i2"), 1)
+        levents.insert(ev("rate", T(3), "u2", target="i1"), 1)
+        levents.insert(ev("rate", T(4), "u9"), 2)  # other app
+
+        assert len(levents.find(1)) == 3
+        assert [e.event for e in levents.find(1, event_names=["buy"])] == ["buy"]
+        assert len(levents.find(1, entity_id="u1")) == 2
+        assert len(levents.find(1, target_entity_type="item", target_entity_id="i1")) == 2
+        assert len(levents.find(1, start_time=T(2))) == 2
+        assert len(levents.find(1, until_time=T(2))) == 1
+        assert len(levents.find(1, start_time=T(2), until_time=T(3))) == 1
+        assert len(levents.find(2)) == 1
+
+    def test_find_order_and_limit(self, levents):
+        for h in (3, 1, 2):
+            levents.insert(ev("rate", T(h), f"u{h}"), 1)
+        times = [e.event_time for e in levents.find(1)]
+        assert times == sorted(times)
+        rev = levents.find(1, reversed_order=True, limit=2)
+        assert [e.event_time for e in rev] == [T(3), T(2)]
+
+    def test_channels_isolated(self, levents):
+        levents.init_channel(1, 5)
+        levents.insert(ev("rate", T(1)), 1, channel_id=5)
+        levents.insert(ev("rate", T(2)), 1)
+        assert len(levents.find(1)) == 1
+        assert len(levents.find(1, channel_id=5)) == 1
+        levents.remove(1, channel_id=5)
+        assert len(levents.find(1, channel_id=5)) == 0
+        assert len(levents.find(1)) == 1
+
+    def test_aggregate_properties(self, levents):
+        levents.insert(ev("$set", T(1), "u1", props={"a": 1, "plan": "free"}), 1)
+        levents.insert(ev("$set", T(2), "u1", props={"plan": "pro"}), 1)
+        levents.insert(ev("$unset", T(3), "u1", props={"a": None}), 1)
+        levents.insert(ev("$set", T(1), "u2", props={"b": 2}), 1)
+        levents.insert(ev("$delete", T(2), "u2"), 1)
+        levents.insert(ev("rate", T(4), "u1", target="i1"), 1)
+
+        agg = levents.aggregate_properties(1, "user")
+        assert set(agg) == {"u1"}
+        assert agg["u1"].to_dict() == {"plan": "pro"}
+
+        req = levents.aggregate_properties(1, "user", required=["missing"])
+        assert req == {}
+
+
+# ------------------------------------------------------------------ PEvents
+@pytest.fixture(params=["memory", "sqlite", "parquet"])
+def pevents(request, tmp_path):
+    if request.param == "memory":
+        return MemPEvents(MemLEvents())
+    if request.param == "sqlite":
+        return SQLitePEvents(SQLiteEvents(SQLiteClient(str(tmp_path / "pe.db"))))
+    return ParquetPEvents(str(tmp_path / "events"))
+
+
+class TestPEventsConformance:
+    def test_write_find(self, pevents):
+        evs = [
+            ev("rate", T(i), f"u{i % 3}", target=f"i{i}", props={"rating": float(i)})
+            for i in range(1, 7)
+        ]
+        pevents.write(evs, app_id=1)
+        out = pevents.find(1)
+        assert len(out) == 6
+        assert [e.event_time for e in out] == [T(i) for i in range(1, 7)]
+        assert len(pevents.find(1, entity_id="u1")) == 2
+        assert len(pevents.find(1, start_time=T(3), until_time=T(5))) == 2
+        assert pevents.find(2) == []
+
+    def test_write_appends(self, pevents):
+        pevents.write([ev("a", T(1))], 1)
+        pevents.write([ev("b", T(2))], 1)
+        assert len(pevents.find(1)) == 2
+
+    def test_bulk_delete(self, pevents):
+        e1, e2 = ev("a", T(1)).with_event_id("id1"), ev("b", T(2)).with_event_id("id2")
+        pevents.write([e1, e2], 1)
+        pevents.delete(["id1"], 1)
+        out = pevents.find(1)
+        assert [e.event_id for e in out] == ["id2"]
+
+    def test_find_frame(self, pevents):
+        pevents.write(
+            [ev("rate", T(i), f"u{i}", target="i1", props={"rating": i / 2}) for i in (1, 2)],
+            1,
+        )
+        frame = pevents.find_frame(1)
+        assert len(frame) == 2
+        np.testing.assert_allclose(
+            frame.property_column("rating"), np.array([0.5, 1.0], dtype=np.float32)
+        )
+        idx, codes = frame.codes("entity_id")
+        assert idx.to_dict() == {"u1": 0, "u2": 1}
+        assert codes.tolist() == [0, 1]
+
+    def test_aggregate_properties(self, pevents):
+        pevents.write(
+            [
+                ev("$set", T(1), "u1", props={"x": 1}),
+                ev("$unset", T(2), "u1", props={"x": None}),
+                ev("$set", T(3), "u1", props={"y": 2}),
+            ],
+            1,
+        )
+        agg = pevents.aggregate_properties(1, "user")
+        assert agg["u1"].to_dict() == {"y": 2}
+
+
+def test_parquet_compact(tmp_path):
+    pe = ParquetPEvents(str(tmp_path / "ev"))
+    pe.write([ev("a", T(1))], 1)
+    pe.write([ev("b", T(2))], 1)
+    pe.compact(1)
+    import os
+
+    d = pe._dir(1, None)
+    assert len(os.listdir(d)) == 1
+    assert len(pe.find(1)) == 2
+
+
+# ------------------------------------------------------------------ meta
+@pytest.fixture(params=["memory", "sqlite"])
+def meta(request, sqlite_client):
+    if request.param == "memory":
+        return dict(
+            apps=MemApps(),
+            keys=MemAccessKeys(),
+            channels=MemChannels(),
+            engine_instances=MemEngineInstances(),
+            evaluation_instances=MemEvaluationInstances(),
+        )
+    return dict(
+        apps=SQLiteApps(sqlite_client),
+        keys=SQLiteAccessKeys(sqlite_client),
+        channels=SQLiteChannels(sqlite_client),
+        engine_instances=SQLiteEngineInstances(sqlite_client),
+        evaluation_instances=SQLiteEvaluationInstances(sqlite_client),
+    )
+
+
+class TestMetaConformance:
+    def test_apps_crud(self, meta):
+        apps = meta["apps"]
+        aid = apps.insert(App(0, "myapp", "desc"))
+        assert aid
+        assert apps.get(aid).name == "myapp"
+        assert apps.get_by_name("myapp").id == aid
+        assert apps.insert(App(0, "myapp")) is None  # duplicate name
+        aid2 = apps.insert(App(0, "other"))
+        assert aid2 != aid
+        assert [a.name for a in apps.get_all()] == ["myapp", "other"]
+        assert apps.update(App(aid, "renamed", None))
+        assert apps.get(aid).name == "renamed"
+        assert apps.delete(aid2)
+        assert apps.get(aid2) is None
+
+    def test_access_keys(self, meta):
+        keys = meta["keys"]
+        k = keys.insert(AccessKey("", 7, ("rate", "buy")))
+        assert k and len(k) > 20
+        got = keys.get(k)
+        assert got.app_id == 7 and got.events == ("rate", "buy")
+        k2 = keys.insert(AccessKey("fixed-key", 7))
+        assert k2 == "fixed-key"
+        assert keys.insert(AccessKey("fixed-key", 8)) is None  # dup
+        assert {x.key for x in keys.get_by_app_id(7)} == {k, "fixed-key"}
+        assert keys.update(AccessKey("fixed-key", 7, ("x",)))
+        assert keys.get("fixed-key").events == ("x",)
+        assert keys.delete(k)
+        assert keys.get(k) is None
+
+    def test_channels(self, meta):
+        channels = meta["channels"]
+        cid = channels.insert(Channel(0, "mobile", 7))
+        assert cid
+        assert channels.get(cid).name == "mobile"
+        assert channels.insert(Channel(0, "bad name!", 7)) is None
+        assert channels.insert(Channel(0, "x" * 17, 7)) is None
+        cid2 = channels.insert(Channel(0, "web", 7))
+        assert {c.name for c in channels.get_by_app_id(7)} == {"mobile", "web"}
+        assert channels.delete(cid2)
+        assert channels.get(cid2) is None
+
+    def test_engine_instances(self, meta):
+        ei = meta["engine_instances"]
+        base_kwargs = dict(
+            start_time=T(1),
+            end_time=T(1),
+            engine_id="rec",
+            engine_version="1",
+            engine_variant="engine.json",
+            engine_factory="RecommendationEngine",
+        )
+        iid = ei.insert(EngineInstance(id="", status=RunStatus.RUNNING, **base_kwargs))
+        assert iid
+        got = ei.get(iid)
+        assert got.status == "RUNNING"
+        assert ei.get_latest_completed("rec", "1", "engine.json") is None
+        ei.update(got.with_status(RunStatus.COMPLETED))
+        later = EngineInstance(
+            id="", status=RunStatus.COMPLETED,
+            **{**base_kwargs, "start_time": T(2), "end_time": T(2)},
+        )
+        iid2 = ei.insert(later)
+        latest = ei.get_latest_completed("rec", "1", "engine.json")
+        assert latest.id == iid2
+        assert len(ei.get_completed("rec", "1", "engine.json")) == 2
+        assert ei.delete(iid2)
+        assert ei.get(iid2) is None
+        assert not ei.update(EngineInstance(id="nope", status="X", **base_kwargs))
+
+    def test_evaluation_instances(self, meta):
+        evi = meta["evaluation_instances"]
+        iid = evi.insert(
+            EvaluationInstance(
+                id="", status=RunStatus.RUNNING, start_time=T(1), end_time=T(1),
+                evaluation_class="MyEval",
+            )
+        )
+        got = evi.get(iid)
+        assert got.evaluation_class == "MyEval"
+        evi.update(got.with_status(RunStatus.COMPLETED))
+        assert [i.id for i in evi.get_completed()] == [iid]
+        assert evi.delete(iid)
+
+
+# ------------------------------------------------------------------ models
+@pytest.fixture(params=["memory", "sqlite", "localfs"])
+def models(request, sqlite_client, tmp_path):
+    if request.param == "memory":
+        return MemModels()
+    if request.param == "sqlite":
+        return SQLiteModels(sqlite_client)
+    return LocalFSModels(str(tmp_path / "models"))
+
+
+class TestModelsConformance:
+    def test_roundtrip(self, models):
+        blob = b"\x00\x01binary\xff" * 100
+        models.insert(Model("inst1", blob))
+        assert models.get("inst1").models == blob
+        models.insert(Model("inst1", b"v2"))  # overwrite
+        assert models.get("inst1").models == b"v2"
+        assert models.get("missing") is None
+        assert models.delete("inst1")
+        assert not models.delete("inst1")
+
+
+# ------------------------------------------------------------------ frame
+class TestEventFrame:
+    def test_to_device_arrays_unsharded(self):
+        from pio_tpu.storage.frame import EventFrame
+
+        frame = EventFrame.from_events(
+            [ev("rate", T(i), f"u{i}", target="i1", props={"rating": float(i)}) for i in (1, 2, 3)]
+        )
+        _, codes = frame.codes("entity_id")
+        arrays = frame.to_device_arrays(
+            {"user": codes, "rating": frame.property_column("rating")}
+        )
+        assert arrays["user"].shape == (3,)
+        assert float(arrays["mask"].sum()) == 3.0
+
+    def test_to_device_arrays_sharded_pads(self):
+        import jax
+        from jax.sharding import Mesh
+
+        from pio_tpu.storage.frame import EventFrame
+
+        frame = EventFrame.from_events(
+            [ev("rate", T(i), f"u{i}") for i in range(1, 6)]  # 5 rows on 8 devices
+        )
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        _, codes = frame.codes("entity_id")
+        arrays = frame.to_device_arrays({"user": codes}, mesh=mesh)
+        assert arrays["user"].shape == (8,)  # padded to mesh multiple
+        assert float(arrays["mask"].sum()) == 5.0
+        assert arrays["user"].sharding.spec == jax.sharding.PartitionSpec("data")
+
+    def test_codes_with_existing_index(self):
+        from pio_tpu.data.bimap import BiMap
+        from pio_tpu.storage.frame import EventFrame
+
+        frame = EventFrame.from_events([ev("r", T(1), "u1"), ev("r", T(2), "uX")])
+        idx = BiMap.string_int(["u1", "u2"])
+        _, codes = frame.codes("entity_id", index=idx)
+        assert codes.tolist() == [0, -1]  # unseen id masked as -1
+
+
+# ------------------------------------------------------------------ registry
+class TestRegistry:
+    def test_defaults_sqlite(self, tmp_home, monkeypatch):
+        for var in list(__import__("os").environ):
+            if var.startswith("PIO_STORAGE_"):
+                monkeypatch.delenv(var)
+        Storage.reset()
+        apps = Storage.get_meta_data_apps()
+        aid = apps.insert(App(0, "regtest"))
+        assert Storage.get_meta_data_apps().get(aid).name == "regtest"
+        assert (tmp_home / "pio.db").exists()
+        checks = Storage.verify_all_data_objects()
+        assert all(checks.values()), checks
+        Storage.reset()
+
+    def test_env_wiring_parquet_events(self, tmp_home, monkeypatch):
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "PQ")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_PQ_TYPE", "parquet")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_PQ_PATH", str(tmp_home / "ev"))
+        Storage.reset()
+        pe = Storage.get_pevents()
+        pe.write([ev("rate", T(1))], 1)
+        assert len(pe.find(1)) == 1
+        assert (tmp_home / "ev").exists()
+        Storage.reset()
+
+    def test_env_wiring_memory(self, tmp_home, monkeypatch):
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "MEM")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+        Storage.reset()
+        le = Storage.get_levents()
+        le.insert(ev("rate", T(1)), 1)
+        # PEvents over the same memory store sees the event
+        assert len(Storage.get_pevents().find(1)) == 1
+        Storage.reset()
+
+    def test_bad_source(self, tmp_home, monkeypatch):
+        from pio_tpu.storage import StorageConfigError
+
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "NOPE")
+        Storage.reset()
+        with pytest.raises(StorageConfigError):
+            Storage.get_meta_data_apps()
+        Storage.reset()
