@@ -1,0 +1,161 @@
+"""DASE component ABCs: DataSource, Preparator, Algorithm, Serving.
+
+Rebuild of the reference's ``core/src/main/scala/o/a/p/controller/
+{PDataSource,LDataSource,PPreparator,LPreparator,PAlgorithm,P2LAlgorithm,
+LAlgorithm,LServing}.scala`` (UNVERIFIED paths; see SURVEY.md).
+
+The reference splits every component into P (distributed, RDD-based) and L
+(local) variants because Spark makes distribution a type-level concern. Under
+JAX the split collapses: a component receives a
+:class:`~pio_tpu.parallel.context.ComputeContext` and the SAME code runs on
+one device or a pod mesh — sharding is a data annotation, not a class
+hierarchy. We keep ``PAlgorithm``/``P2LAlgorithm``/``LAlgorithm`` as aliases
+so reference users find familiar names; all mean :class:`Algorithm`.
+
+Every component is constructed with its Params instance (reference
+``Doer.apply``): ``cls(params)``.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Generic, List, Optional, Sequence, Tuple, TypeVar
+
+from pio_tpu.controller.params import EmptyParams, Params
+from pio_tpu.parallel.context import ComputeContext
+
+TD = TypeVar("TD")  # training data
+EI = TypeVar("EI")  # evaluation info
+PD = TypeVar("PD")  # prepared data
+Q = TypeVar("Q")  # query
+P = TypeVar("P")  # prediction
+A = TypeVar("A")  # actual (ground truth)
+M = TypeVar("M")  # model
+
+
+class Component:
+    """Base: holds the params it was constructed with (reference AbstractDoer)."""
+
+    params_class: type = EmptyParams
+
+    def __init__(self, params: Optional[Params] = None):
+        self.params = params if params is not None else self.params_class()
+
+
+class SanityCheck(abc.ABC):
+    """Opt-in hook called on TD/PD after read/prepare
+    (reference ``controller/SanityCheck.scala``)."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None:
+        """Raise if the data is unusable (e.g. empty training set)."""
+
+
+class DataSource(Component, Generic[TD, EI, Q, A]):
+    """Reads training/eval data from the event store
+    (reference ``PDataSource.readTraining(sc)`` / ``readEval``)."""
+
+    @abc.abstractmethod
+    def read_training(self, ctx: ComputeContext) -> TD: ...
+
+    def read_eval(
+        self, ctx: ComputeContext
+    ) -> List[Tuple[TD, EI, List[Tuple[Q, A]]]]:
+        """Eval folds: (trainingData, evalInfo, [(query, actual)]).
+
+        Default: no eval data (reference's default throws on eval use;
+        returning [] makes ``eval`` a clean no-op instead).
+        """
+        return []
+
+
+class Preparator(Component, Generic[TD, PD]):
+    """TD -> PD feature preparation (reference ``PPreparator.prepare``)."""
+
+    @abc.abstractmethod
+    def prepare(self, ctx: ComputeContext, training_data: TD) -> PD: ...
+
+
+class IdentityPreparator(Preparator[TD, TD]):
+    """PD == TD passthrough (reference ``IdentityPreparator``)."""
+
+    def prepare(self, ctx: ComputeContext, training_data: TD) -> TD:
+        return training_data
+
+
+class Algorithm(Component, Generic[PD, M, Q, P]):
+    """Train a model; answer queries (reference ``PAlgorithm``/``LAlgorithm``).
+
+    ``train`` typically builds sharded arrays from PD and runs a pjit
+    program over ``ctx.mesh``; ``predict`` runs a (cached-jit) device
+    computation per query; ``batch_predict`` vectorizes offline scoring
+    (reference ``batchPredict`` used by ``pio batchpredict``).
+    """
+
+    @abc.abstractmethod
+    def train(self, ctx: ComputeContext, prepared_data: PD) -> M: ...
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> P: ...
+
+    def batch_predict(self, model: M, queries: Sequence[Tuple[int, Q]]) -> List[Tuple[int, P]]:
+        """Default: loop predict. Override with a vectorized device program."""
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+
+# Reference-parity aliases (see module docstring): the P/L/P2L distinction is
+# a Spark artifact; on a mesh all algorithms are "distributed".
+PAlgorithm = Algorithm
+P2LAlgorithm = Algorithm
+LAlgorithm = Algorithm
+PDataSource = DataSource
+LDataSource = DataSource
+PPreparator = Preparator
+LPreparator = Preparator
+
+
+class PersistentModel(abc.ABC):
+    """Opt-in custom model persistence (reference ``PersistentModel`` /
+    ``PersistentModelLoader``). Models not implementing this are stored as a
+    pickled blob in the Models store (reference: Kryo blob)."""
+
+    @abc.abstractmethod
+    def save(self, instance_id: str, params: Params, ctx: ComputeContext) -> bool:
+        """Persist; return True if handled (False -> fall back to blob)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, instance_id: str, params: Params, ctx: ComputeContext) -> "PersistentModel":
+        ...
+
+
+class Serving(Component, Generic[Q, P]):
+    """Combine per-algorithm predictions into one response
+    (reference ``LServing.serve``)."""
+
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: List[P]) -> P: ...
+
+    def supplement(self, query: Q) -> Q:
+        """Hook to enrich the query before algorithms see it
+        (reference ``LServing.supplementBase``)."""
+        return query
+
+
+class FirstServing(Serving[Q, P]):
+    """Returns the first algorithm's prediction (reference ``FirstServing``)."""
+
+    def serve(self, query: Q, predictions: List[P]) -> P:
+        return predictions[0]
+
+
+class AverageServing(Serving[Q, float]):
+    """Numeric mean of predictions (reference ``LAverageServing``)."""
+
+    def serve(self, query: Q, predictions: List[float]) -> float:
+        return sum(predictions) / len(predictions)
+
+
+LServing = Serving
+LFirstServing = FirstServing
+LAverageServing = AverageServing

@@ -1,0 +1,263 @@
+"""Engine — binds DASE components + params; orchestrates train/eval.
+
+Rebuild of the reference's ``controller/Engine.scala`` +
+``controller/EngineFactory.scala`` (UNVERIFIED paths; see SURVEY.md). Key
+differences from the reference, by design:
+
+- No JVM reflection: engine factories register by name in a process registry
+  (``@register_engine``) or resolve as ``"module.path:attribute"`` — the
+  ``engineFactory`` field of ``engine.json`` accepts either.
+- ``train`` returns plain Python model objects; model persistence happens in
+  the workflow layer (pickle blob ≙ reference Kryo blob, or
+  ``PersistentModel`` opt-out).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+from pio_tpu.controller.components import (
+    Algorithm,
+    DataSource,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+from pio_tpu.controller.params import (
+    EmptyParams,
+    Params,
+    ParamsError,
+    params_from_dict,
+    params_to_dict,
+)
+from pio_tpu.parallel.context import ComputeContext
+
+log = logging.getLogger("pio_tpu.engine")
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """Per-run parameter bundle (reference ``EngineParams``)."""
+
+    data_source_params: Params = EmptyParams()
+    preparator_params: Params = EmptyParams()
+    algorithm_params_list: Tuple[Tuple[str, Params], ...] = ()
+    serving_params: Params = EmptyParams()
+
+
+class Engine:
+    """Binds DASE component classes (reference ``Engine[TD,EI,PD,Q,P,A]``).
+
+    ``algorithm_class_map`` maps algorithm names (as referenced from
+    engine.json's ``algorithms[].name``) to Algorithm classes.
+    """
+
+    def __init__(
+        self,
+        data_source_class: Type[DataSource],
+        preparator_class: Type[Preparator],
+        algorithm_class_map: Dict[str, Type[Algorithm]],
+        serving_class: Type[Serving],
+    ):
+        self.data_source_class = data_source_class
+        self.preparator_class = preparator_class
+        self.algorithm_class_map = dict(algorithm_class_map)
+        self.serving_class = serving_class
+
+    # -- params binding (reference jValueToEngineParams) ---------------------
+    def params_from_variant(self, variant: Dict[str, Any]) -> EngineParams:
+        """Bind an engine.json variant dict to typed EngineParams."""
+
+        def section(name: str) -> Optional[dict]:
+            v = variant.get(name)
+            if v is None:
+                return None
+            if not isinstance(v, dict):
+                raise ParamsError(f"engine.json {name!r} must be an object")
+            return v.get("params", {})
+
+        ds = params_from_dict(
+            self.data_source_class.params_class, section("datasource")
+        )
+        prep = params_from_dict(
+            self.preparator_class.params_class, section("preparator")
+        )
+        serv = params_from_dict(self.serving_class.params_class, section("serving"))
+
+        algos: List[Tuple[str, Params]] = []
+        for entry in variant.get("algorithms", []):
+            name = entry.get("name")
+            if name not in self.algorithm_class_map:
+                raise ParamsError(
+                    f"unknown algorithm {name!r}; engine declares "
+                    f"{sorted(self.algorithm_class_map)}"
+                )
+            algos.append(
+                (
+                    name,
+                    params_from_dict(
+                        self.algorithm_class_map[name].params_class,
+                        entry.get("params", {}),
+                    ),
+                )
+            )
+        if not algos:
+            # default: every declared algorithm with default params
+            algos = [
+                (name, cls.params_class())
+                for name, cls in self.algorithm_class_map.items()
+            ]
+        return EngineParams(
+            data_source_params=ds,
+            preparator_params=prep,
+            algorithm_params_list=tuple(algos),
+            serving_params=serv,
+        )
+
+    # -- instantiation (reference Doer.apply) --------------------------------
+    def _algorithms(self, engine_params: EngineParams) -> List[Algorithm]:
+        return [
+            self.algorithm_class_map[name](params)
+            for name, params in engine_params.algorithm_params_list
+        ]
+
+    # -- train (reference object Engine.train) -------------------------------
+    def train(
+        self,
+        ctx: ComputeContext,
+        engine_params: EngineParams,
+        skip_sanity_check: bool = False,
+        stop_after_read: bool = False,
+        stop_after_prepare: bool = False,
+    ) -> List[Any]:
+        """Run DataSource -> Preparator -> each Algorithm; return models."""
+        data_source = self.data_source_class(engine_params.data_source_params)
+        td = data_source.read_training(ctx)
+        if not skip_sanity_check and isinstance(td, SanityCheck):
+            td.sanity_check()
+        if stop_after_read:
+            log.info("stopping after read_training (stop_after_read)")
+            return []
+        preparator = self.preparator_class(engine_params.preparator_params)
+        pd = preparator.prepare(ctx, td)
+        if not skip_sanity_check and isinstance(pd, SanityCheck):
+            pd.sanity_check()
+        if stop_after_prepare:
+            log.info("stopping after prepare (stop_after_prepare)")
+            return []
+        models = []
+        for algo in self._algorithms(engine_params):
+            models.append(algo.train(ctx, pd))
+        return models
+
+    # -- eval (reference object Engine.eval) ---------------------------------
+    def eval(
+        self, ctx: ComputeContext, engine_params: EngineParams
+    ) -> List[Tuple[Any, Any, List[Tuple[Any, Any, Any]]]]:
+        """Returns per-fold: (evalInfo, query-prediction-actual triples).
+
+        Shape parity with the reference's
+        ``Seq[(EI, RDD[(Q, P, A)])]`` (fold-level lazy evaluation replaced
+        by eager lists).
+        """
+        data_source = self.data_source_class(engine_params.data_source_params)
+        preparator = self.preparator_class(engine_params.preparator_params)
+        serving = self.serving_class(engine_params.serving_params)
+        algorithms = self._algorithms(engine_params)
+
+        results = []
+        for td, eval_info, qa in data_source.read_eval(ctx):
+            pd = preparator.prepare(ctx, td)
+            models = [algo.train(ctx, pd) for algo in algorithms]
+            qpa = []
+            for q, actual in qa:
+                q = serving.supplement(q)
+                preds = [
+                    algo.predict(model, q)
+                    for algo, model in zip(algorithms, models)
+                ]
+                qpa.append((q, serving.serve(q, preds), actual))
+            results.append((eval_info, qpa))
+        return results
+
+    # -- deploy prep (reference Engine.prepareDeploy) ------------------------
+    def make_serving(self, engine_params: EngineParams) -> Serving:
+        return self.serving_class(engine_params.serving_params)
+
+    def algorithms_with_models(
+        self, engine_params: EngineParams, models: Sequence[Any]
+    ) -> List[Tuple[Algorithm, Any]]:
+        algos = self._algorithms(engine_params)
+        if len(algos) != len(models):
+            raise ValueError(
+                f"{len(algos)} algorithms but {len(models)} models"
+            )
+        return list(zip(algos, models))
+
+
+class SimpleEngine(Engine):
+    """Single-algorithm engine with identity prep + first serving
+    (reference ``SimpleEngine``)."""
+
+    def __init__(self, data_source_class, algorithm_class):
+        from pio_tpu.controller.components import FirstServing, IdentityPreparator
+
+        super().__init__(
+            data_source_class,
+            IdentityPreparator,
+            {"default": algorithm_class},
+            FirstServing,
+        )
+
+
+# -------------------------------------------------------------- registry
+EngineFactory = Callable[[], Engine]
+
+_ENGINE_REGISTRY: Dict[str, EngineFactory] = {}
+
+
+def register_engine(name: str):
+    """Decorator registering an engine factory under a stable name
+    (the TPU-native replacement for the reference's reflective
+    ``engineFactory`` class lookup)."""
+
+    def deco(factory: EngineFactory) -> EngineFactory:
+        _ENGINE_REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def engine_factory_names() -> List[str]:
+    return sorted(_ENGINE_REGISTRY)
+
+
+def get_engine_factory(name: str) -> EngineFactory:
+    """Resolve a factory: registry name first, then ``module:attr`` import."""
+    if name in _ENGINE_REGISTRY:
+        return _ENGINE_REGISTRY[name]
+    if ":" in name:
+        mod_name, _, attr = name.partition(":")
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError as e:
+            raise ParamsError(f"cannot import engine factory {name!r}: {e}") from None
+        fn = getattr(mod, attr, None)
+        if fn is None:
+            raise ParamsError(f"{mod_name!r} has no attribute {attr!r}")
+        return fn
+    # final attempt: importing the module may register the name
+    if "." in name:
+        try:
+            importlib.import_module(name.rsplit(".", 1)[0])
+        except ImportError:
+            pass
+        if name in _ENGINE_REGISTRY:
+            return _ENGINE_REGISTRY[name]
+    raise ParamsError(
+        f"engine factory {name!r} not registered; known: {engine_factory_names()}"
+    )

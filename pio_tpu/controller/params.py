@@ -1,0 +1,96 @@
+"""Typed component params — the engine.json binding layer.
+
+Rebuild of the reference's ``controller/Params.scala`` + the
+``workflow/JsonExtractor.scala`` reflection machinery (UNVERIFIED paths; see
+SURVEY.md). Where the reference reflects Scala case-class constructors from
+Json4s ASTs, we bind JSON objects to Python dataclasses with explicit
+validation: unknown keys are rejected (same behavior the reference gets from
+strict extraction), missing keys fall back to dataclass defaults, and a
+missing required key is an error naming the field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from typing import Any, Mapping, Optional, Type, TypeVar
+
+P = TypeVar("P", bound="Params")
+
+
+class ParamsError(ValueError):
+    """Raised when engine.json params don't bind to a Params dataclass."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    """Base class for component parameters (reference ``trait Params``).
+
+    Subclass as a frozen dataclass:
+
+        @dataclasses.dataclass(frozen=True)
+        class ALSParams(Params):
+            rank: int = 10
+            num_iterations: int = 10
+            reg: float = 0.01
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    """No parameters (reference ``EmptyParams``)."""
+
+
+def _check_field_type(name: str, value: Any, ftype: Any) -> Any:
+    """Best-effort runtime check/coercion for common JSON-able field types."""
+    origin = typing.get_origin(ftype)
+    if ftype is Any or origin is not None and origin is not list:
+        return value  # Optional/Union/Dict etc. — accept as-is
+    if ftype is float and isinstance(value, int) and not isinstance(value, bool):
+        return float(value)
+    if isinstance(ftype, type):
+        if ftype is int and isinstance(value, bool):
+            raise ParamsError(f"param {name!r}: got bool, expected int")
+        if origin is None and not isinstance(value, ftype):
+            raise ParamsError(
+                f"param {name!r}: got {type(value).__name__}, "
+                f"expected {ftype.__name__}"
+            )
+    return value
+
+
+def params_from_dict(cls: Type[P], d: Optional[Mapping[str, Any]]) -> P:
+    """Bind a JSON object to a Params dataclass (strict about unknown keys)."""
+    d = dict(d or {})
+    if not dataclasses.is_dataclass(cls):
+        raise ParamsError(f"{cls.__name__} must be a dataclass")
+    hints = typing.get_type_hints(cls)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(d) - set(fields)
+    if unknown:
+        raise ParamsError(
+            f"{cls.__name__}: unknown params {sorted(unknown)}; "
+            f"known: {sorted(fields)}"
+        )
+    kwargs = {}
+    for name, f in fields.items():
+        if name in d:
+            kwargs[name] = _check_field_type(name, d[name], hints.get(name))
+        elif (
+            f.default is dataclasses.MISSING
+            and f.default_factory is dataclasses.MISSING  # type: ignore[misc]
+        ):
+            raise ParamsError(f"{cls.__name__}: missing required param {name!r}")
+    try:
+        return cls(**kwargs)  # type: ignore[return-value]
+    except (TypeError, ValueError) as e:
+        raise ParamsError(f"{cls.__name__}: {e}") from None
+
+
+def params_to_dict(p: Params) -> dict:
+    return dataclasses.asdict(p)
+
+
+def params_to_json(p: Params) -> str:
+    return json.dumps(params_to_dict(p), sort_keys=True)

@@ -57,10 +57,19 @@ class DataMap:
     __slots__ = ("_fields",)
 
     def __init__(self, fields: Optional[Mapping[str, JsonValue]] = None):
-        # Deep-copy once at construction: container values can then be
-        # returned directly from getters without leaking mutable internals,
-        # and outside mutation of the source dict can't reach us either.
+        # Deep-copy once at construction so outside mutation of the source
+        # dict can't reach us. Decode hot paths that own their freshly
+        # parsed dict should use :meth:`_wrap` instead.
         self._fields: dict = _copy.deepcopy(dict(fields)) if fields else {}
+
+    @classmethod
+    def _wrap(cls, owned: dict) -> "DataMap":
+        """No-copy constructor for callers handing over ownership of a
+        never-aliased dict (e.g. a fresh ``json.loads`` result on storage
+        decode paths)."""
+        self = cls.__new__(cls)
+        self._fields = owned
+        return self
 
     # -- Mapping protocol ---------------------------------------------------
     def __getitem__(self, key: str) -> JsonValue:

@@ -1,0 +1,10 @@
+"""Mesh / sharding / collective helpers — the Spark-substrate replacement.
+
+Where the reference scales via Spark RDD partitioning + shuffle +
+treeAggregate (SURVEY.md §2.6), this package provides the TPU-native
+vocabulary: device meshes, named shardings, and pjit-visible collectives.
+"""
+
+from pio_tpu.parallel.context import ComputeContext, default_mesh
+
+__all__ = ["ComputeContext", "default_mesh"]

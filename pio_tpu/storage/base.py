@@ -196,6 +196,35 @@ class PEvents(abc.ABC):
         """Bulk delete by event id (reference ``PEvents.delete``)."""
 
 
+class PEventsAdapter(PEvents):
+    """PEvents facade over a combined LEvents+bulk backend.
+
+    Needed because ``PEvents.delete`` (bulk, by id list) clashes with
+    ``LEvents.delete`` (single id) on classes implementing both; backends
+    expose the bulk variant as ``delete_bulk`` and this adapter maps it to
+    the SPI name.
+    """
+
+    def __init__(self, backend):
+        self._b = backend
+
+    def find(self, app_id, channel_id=None, **filters) -> List[Event]:
+        return self._b.find(app_id, channel_id=channel_id, **filters)
+
+    def find_frame(self, app_id, **filters):
+        from pio_tpu.storage.frame import EventFrame
+
+        if hasattr(self._b, "find_frame"):
+            return self._b.find_frame(app_id, **filters)
+        return EventFrame.from_events(self.find(app_id, **filters))
+
+    def write(self, events, app_id, channel_id=None) -> None:
+        self._b.write(events, app_id, channel_id)
+
+    def delete(self, event_ids, app_id, channel_id=None) -> None:
+        self._b.delete_bulk(event_ids, app_id, channel_id)
+
+
 # ----------------------------------------------------------------- meta data
 class Apps(abc.ABC):
     @abc.abstractmethod

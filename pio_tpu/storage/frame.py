@@ -19,11 +19,7 @@ import numpy as np
 from pio_tpu.data.bimap import BiMap
 from pio_tpu.data.event import Event
 
-_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
-
-
-def _to_micros(t: _dt.datetime) -> int:
-    return int((t - _EPOCH).total_seconds() * 1e6)
+from pio_tpu.utils.timeutil import to_micros as _to_micros
 
 
 class EventFrame:
@@ -104,38 +100,15 @@ class EventFrame:
         """Place host columns on devices, sharded along the batch dim.
 
         ``columns`` maps name -> 1-D host array (all equal length). Arrays
-        are padded up to a multiple of the mesh axis size; the returned dict
-        gains a ``"mask"`` float column that is 1 for real rows, 0 for pad.
-        Without a mesh, arrays go to the default device unsharded.
+        are padded up to a multiple of the mesh *batch-axis* size; the
+        returned dict gains a ``"mask"`` float column that is 1 for real
+        rows, 0 for pad. Without a mesh, arrays go to the default device
+        unsharded. Delegates to :meth:`ComputeContext.shard_batch` — one
+        padding/placement implementation.
         """
-        import jax
-        import jax.numpy as jnp
+        from pio_tpu.parallel.context import ComputeContext
 
-        n = None
-        for v in columns.values():
-            if n is None:
-                n = len(v)
-            elif len(v) != n:
-                raise ValueError("all columns must have equal length")
-        if n is None:
+        if not columns:
             raise ValueError("no columns given")
-
-        if mesh is None:
-            out = {k: jnp.asarray(v) for k, v in columns.items()}
-            out["mask"] = jnp.ones((n,), dtype=jnp.float32)
-            return out
-
-        shards = mesh.devices.size
-        padded = -(-n // shards) * shards
-        sharding = jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec(axis_name)
-        )
-        out = {}
-        for k, v in columns.items():
-            pv = np.zeros((padded,), dtype=v.dtype)
-            pv[:n] = v
-            out[k] = jax.device_put(pv, sharding)
-        mask = np.zeros((padded,), dtype=np.float32)
-        mask[:n] = 1.0
-        out["mask"] = jax.device_put(mask, sharding)
-        return out
+        ctx = ComputeContext(mesh=mesh, batch_axis=axis_name)
+        return ctx.shard_batch(columns)

@@ -139,20 +139,8 @@ class MemLEvents(base.LEvents, base.PEvents):
                 self._bucket(app_id, channel_id).pop(eid, None)
 
 
-# PEvents.delete name clashes with LEvents.delete(event_id); expose the bulk
-# variant under the SPI name via a small adapter used by the registry.
-class MemPEvents(base.PEvents):
-    def __init__(self, levents: MemLEvents):
-        self._l = levents
-
-    def find(self, app_id, channel_id=None, **filters) -> List[Event]:
-        return self._l.find(app_id, channel_id=channel_id, **filters)
-
-    def write(self, events, app_id, channel_id=None) -> None:
-        self._l.write(events, app_id, channel_id)
-
-    def delete(self, event_ids, app_id, channel_id=None) -> None:
-        self._l.delete_bulk(event_ids, app_id, channel_id)
+# Shared facade mapping the bulk PEvents SPI onto the combined store.
+MemPEvents = base.PEventsAdapter
 
 
 class MemApps(base.Apps):

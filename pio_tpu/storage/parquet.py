@@ -29,8 +29,6 @@ from pio_tpu.data.event import Event
 from pio_tpu.storage import base
 from pio_tpu.storage.frame import EventFrame
 
-_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
-
 _SCHEMA = pa.schema(
     [
         ("id", pa.string()),
@@ -48,12 +46,7 @@ _SCHEMA = pa.schema(
 )
 
 
-def _to_us(t: _dt.datetime) -> int:
-    return int((t - _EPOCH).total_seconds() * 1e6)
-
-
-def _from_us(us: int) -> _dt.datetime:
-    return _EPOCH + _dt.timedelta(microseconds=int(us))
+from pio_tpu.utils.timeutil import from_micros as _from_us, to_micros as _to_us
 
 
 class ParquetPEvents(base.PEvents):
@@ -147,7 +140,7 @@ class ParquetPEvents(base.PEvents):
                     entity_id=cols["entity_id"][i],
                     target_entity_type=cols["target_entity_type"][i] or None,
                     target_entity_id=cols["target_entity_id"][i] or None,
-                    properties=DataMap(json.loads(cols["properties"][i])),
+                    properties=DataMap._wrap(json.loads(cols["properties"][i])),
                     event_time=_from_us(cols["event_time_us"][i]),
                     tags=tuple(json.loads(cols["tags"][i])),
                     pr_id=cols["pr_id"][i] or None,

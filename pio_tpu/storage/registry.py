@@ -207,10 +207,13 @@ class Storage:
             "METADATA/channels": cls.get_meta_data_channels,
             "METADATA/engine_instances": cls.get_meta_data_engine_instances,
             "METADATA/evaluation_instances": cls.get_meta_data_evaluation_instances,
-            "EVENTDATA/levents": cls.get_levents,
             "EVENTDATA/pevents": cls.get_pevents,
             "MODELDATA/models": cls.get_model_data_models,
         }
+        # parquet serves the bulk interface only — probing LEvents there
+        # would flag a correctly configured deployment as broken.
+        if _source_config("EVENTDATA").type != "parquet":
+            checks["EVENTDATA/levents"] = cls.get_levents
         for name, fn in checks.items():
             try:
                 fn()

@@ -100,15 +100,7 @@ CREATE TABLE IF NOT EXISTS models (
 );
 """
 
-_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
-
-
-def _to_us(t: _dt.datetime) -> int:
-    return int((t - _EPOCH).total_seconds() * 1e6)
-
-
-def _from_us(us: int) -> _dt.datetime:
-    return _EPOCH + _dt.timedelta(microseconds=us)
+from pio_tpu.utils.timeutil import from_micros as _from_us, to_micros as _to_us
 
 
 class SQLiteClient:
@@ -150,7 +142,7 @@ def _row_to_event(r) -> Event:
         entity_id=r[5],
         target_entity_type=r[6],
         target_entity_id=r[7],
-        properties=DataMap(json.loads(r[8])),
+        properties=DataMap._wrap(json.loads(r[8])),
         event_time=_from_us(r[9]),
         tags=tuple(json.loads(r[10])),
         pr_id=r[11],
@@ -305,20 +297,8 @@ class SQLiteEvents(base.LEvents, base.PEvents):
         self._c.close()
 
 
-class SQLitePEvents(base.PEvents):
-    """PEvents SPI facade (bulk delete name differs from LEvents.delete)."""
-
-    def __init__(self, events: SQLiteEvents):
-        self._e = events
-
-    def find(self, app_id, channel_id=None, **filters) -> List[Event]:
-        return self._e.find(app_id, channel_id=channel_id, **filters)
-
-    def write(self, events, app_id, channel_id=None) -> None:
-        self._e.write(events, app_id, channel_id)
-
-    def delete(self, event_ids, app_id, channel_id=None) -> None:
-        self._e.delete_bulk(event_ids, app_id, channel_id)
+# Shared facade mapping the bulk PEvents SPI onto the combined store.
+SQLitePEvents = base.PEventsAdapter
 
 
 class SQLiteApps(base.Apps):

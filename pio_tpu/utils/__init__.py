@@ -1,0 +1,5 @@
+"""Shared utilities."""
+
+from pio_tpu.utils.timeutil import EPOCH, from_micros, to_micros
+
+__all__ = ["EPOCH", "from_micros", "to_micros"]

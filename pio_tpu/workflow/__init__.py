@@ -1,0 +1,35 @@
+"""Workflow engine: engine.json loading, train/eval drivers, bookkeeping.
+
+Rebuild of the reference's ``core/.../workflow/`` (CreateWorkflow,
+CoreWorkflow, EvaluationWorkflow — UNVERIFIED paths; see SURVEY.md).
+"""
+
+from pio_tpu.workflow.core_workflow import (
+    deserialize_models,
+    load_models_for_instance,
+    run_evaluation,
+    run_train,
+    serialize_models,
+)
+from pio_tpu.workflow.engine_json import (
+    EngineJsonError,
+    EngineVariant,
+    build_engine,
+    load_variant,
+    variant_from_dict,
+)
+from pio_tpu.workflow.params import WorkflowParams
+
+__all__ = [
+    "EngineJsonError",
+    "EngineVariant",
+    "WorkflowParams",
+    "build_engine",
+    "deserialize_models",
+    "load_models_for_instance",
+    "load_variant",
+    "run_evaluation",
+    "run_train",
+    "serialize_models",
+    "variant_from_dict",
+]

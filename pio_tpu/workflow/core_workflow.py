@@ -1,0 +1,248 @@
+"""CoreWorkflow — train/eval drivers with run bookkeeping.
+
+Rebuild of the reference's ``workflow/CoreWorkflow.scala`` +
+``workflow/CreateWorkflow.scala`` + ``workflow/EvaluationWorkflow.scala``
+(UNVERIFIED paths; see SURVEY.md): set the EngineInstance status to RUNNING,
+run ``Engine.train``, persist models (pickled blob ≙ reference Kryo blob, or
+``PersistentModel`` custom path), mark COMPLETED — or FAILED with the error
+recorded, so ``pio status``/dashboard surface crashed runs.
+
+Upgrade over the reference: per-phase wall-time is recorded into the
+instance env (the reference has no tracing at all — SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json as _json
+import logging
+import pickle
+import time
+import traceback
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from pio_tpu.controller.components import PersistentModel
+from pio_tpu.controller.engine import Engine, EngineParams
+from pio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+)
+from pio_tpu.controller.params import params_to_dict, params_to_json
+from pio_tpu.parallel.context import ComputeContext
+from pio_tpu.storage import (
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+    RunStatus,
+    Storage,
+)
+from pio_tpu.workflow.engine_json import EngineVariant
+from pio_tpu.workflow.params import WorkflowParams
+
+log = logging.getLogger("pio_tpu.workflow")
+
+
+def _utcnow() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _to_host(obj: Any) -> Any:
+    """Pull device arrays in a model pytree back to host numpy for pickling.
+
+    jax.Array leaves (possibly sharded) become np.ndarray; anything jax
+    doesn't recognize passes through untouched.
+    """
+    import jax
+
+    def leaf(x):
+        return np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x
+
+    return jax.tree_util.tree_map(leaf, obj)
+
+
+def serialize_models(models: Sequence[Any]) -> bytes:
+    """Default model persistence (≙ reference Kryo blob via KryoInjection)."""
+    return pickle.dumps([_to_host(m) for m in models], protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_models(blob: bytes) -> List[Any]:
+    return pickle.loads(blob)
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    variant: EngineVariant,
+    workflow_params: WorkflowParams = WorkflowParams(),
+    ctx: Optional[ComputeContext] = None,
+) -> str:
+    """Train + persist; returns the engine-instance id
+    (reference ``CoreWorkflow.runTrain``)."""
+    if ctx is None:
+        ctx = ComputeContext.create(seed=workflow_params.seed)
+    instances = Storage.get_meta_data_engine_instances()
+    now = _utcnow()
+    instance = EngineInstance(
+        id="",
+        status=RunStatus.RUNNING,
+        start_time=now,
+        end_time=now,
+        engine_id=variant.engine_id,
+        engine_version=variant.engine_version,
+        engine_variant=variant.path or variant.engine_id,
+        engine_factory=variant.engine_factory,
+        batch=workflow_params.batch,
+        env={},
+        jax_conf=variant.jax_conf,
+        data_source_params=params_to_json(engine_params.data_source_params),
+        preparator_params=params_to_json(engine_params.preparator_params),
+        algorithms_params=_json.dumps(
+            [
+                {"name": n, "params": params_to_dict(p)}
+                for n, p in engine_params.algorithm_params_list
+            ],
+            sort_keys=True,
+        ),
+        serving_params=params_to_json(engine_params.serving_params),
+    )
+    instance_id = instances.insert(instance)
+    instance = instances.get(instance_id)
+    log.info("training started: instance %s", instance_id)
+
+    t0 = time.monotonic()
+    try:
+        models = engine.train(
+            ctx,
+            engine_params,
+            skip_sanity_check=workflow_params.skip_sanity_check,
+            stop_after_read=workflow_params.stop_after_read,
+            stop_after_prepare=workflow_params.stop_after_prepare,
+        )
+        train_s = time.monotonic() - t0
+        if workflow_params.stop_after_read or workflow_params.stop_after_prepare:
+            instances.update(instance.with_status(RunStatus.ABORTED))
+            log.info("run %s aborted early by stop-after flag", instance_id)
+            return instance_id
+
+        # Persist: PersistentModel handles itself; everything else goes into
+        # the Models store as one pickled blob.
+        persisted_externally = []
+        for (name, algo_params), model in zip(
+            engine_params.algorithm_params_list, models
+        ):
+            if isinstance(model, PersistentModel):
+                persisted_externally.append(
+                    model.save(instance_id, algo_params, ctx)
+                )
+            else:
+                persisted_externally.append(False)
+        blob_models = [
+            None if ext else m for ext, m in zip(persisted_externally, models)
+        ]
+        Storage.get_model_data_models().insert(
+            Model(id=instance_id, models=serialize_models(blob_models))
+        )
+
+        done = dataclasses.replace(
+            instance.with_status(RunStatus.COMPLETED),
+            env={
+                "train_seconds": f"{train_s:.3f}",
+                "num_devices": str(ctx.num_devices),
+            },
+        )
+        instances.update(done)
+        log.info(
+            "training finished: instance %s (%.2fs, %d model(s))",
+            instance_id, train_s, len(models),
+        )
+        return instance_id
+    except Exception:
+        err = traceback.format_exc()
+        failed = dataclasses.replace(
+            instance.with_status(RunStatus.FAILED), env={"error": err[-4000:]}
+        )
+        instances.update(failed)
+        log.error("training FAILED: instance %s\n%s", instance_id, err)
+        raise
+
+
+def load_models_for_instance(
+    instance_id: str,
+    engine: Engine,
+    engine_params: EngineParams,
+    ctx: ComputeContext,
+) -> List[Any]:
+    """Models-store blob + PersistentModel loads
+    (reference ``Engine.prepareDeploy``)."""
+    record = Storage.get_model_data_models().get(instance_id)
+    if record is None:
+        raise RuntimeError(f"no models stored for instance {instance_id!r}")
+    blob_models = deserialize_models(record.models)
+    out = []
+    for (name, algo_params), blob_model in zip(
+        engine_params.algorithm_params_list, blob_models
+    ):
+        if blob_model is not None:
+            out.append(blob_model)
+            continue
+        algo_cls = engine.algorithm_class_map[name]
+        model_cls = getattr(algo_cls, "model_class", None)
+        if model_cls is None or not issubclass(model_cls, PersistentModel):
+            raise RuntimeError(
+                f"algorithm {name!r}: model was persisted externally but "
+                f"{algo_cls.__name__} declares no PersistentModel model_class"
+            )
+        out.append(model_cls.load(instance_id, algo_params, ctx))
+    return out
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    generator: EngineParamsGenerator,
+    workflow_params: WorkflowParams = WorkflowParams(),
+    ctx: Optional[ComputeContext] = None,
+    evaluation_class: str = "",
+    generator_class: str = "",
+) -> MetricEvaluatorResult:
+    """Sweep params, record the winner (reference
+    ``EvaluationWorkflow.runEvaluation``). Returns the result; the
+    EvaluationInstance row carries its JSON for the dashboard."""
+    if ctx is None:
+        ctx = ComputeContext.create(seed=workflow_params.seed)
+    instances = Storage.get_meta_data_evaluation_instances()
+    now = _utcnow()
+    instance = EvaluationInstance(
+        id="",
+        status=RunStatus.RUNNING,
+        start_time=now,
+        end_time=now,
+        evaluation_class=evaluation_class or type(evaluation).__name__,
+        engine_params_generator_class=generator_class or type(generator).__name__,
+        batch=workflow_params.batch,
+    )
+    instance_id = instances.insert(instance)
+    instance = instances.get(instance_id)
+    try:
+        evaluator = MetricEvaluator(evaluation.metric, evaluation.other_metrics)
+        result = evaluator.evaluate(
+            ctx, evaluation.engine, generator.engine_params_list
+        )
+        done = dataclasses.replace(
+            instance.with_status(RunStatus.COMPLETED),
+            evaluator_results=f"{result.metric_header}: {result.best_score}",
+            evaluator_results_json=result.to_json(),
+        )
+        instances.update(done)
+        return result
+    except Exception:
+        err = traceback.format_exc()
+        failed = dataclasses.replace(
+            instance.with_status(RunStatus.FAILED), evaluator_results=err[-4000:]
+        )
+        instances.update(failed)
+        raise

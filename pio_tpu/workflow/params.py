@@ -1,0 +1,18 @@
+"""WorkflowParams (reference ``workflow/WorkflowParams.scala``, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkflowParams:
+    """Debug/controls for a train/eval run (reference fields: batch, verbose,
+    skipSanityCheck, stopAfterRead, stopAfterPrepare, sparkEnv→jax_conf)."""
+
+    batch: str = ""
+    verbose: int = 2
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+    seed: int = 0

@@ -1,0 +1,123 @@
+"""Fixture engine with recording components (reference ``SampleEngine.scala``
+pattern, SURVEY.md §4): tiny deterministic DASE components whose TD/PD/models
+are dataclasses recording the params they saw — tests assert pipeline
+plumbing, not ML quality.
+"""
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from pio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+    Serving,
+    register_engine,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DSParams(Params):
+    id: int = 0
+    fail_sanity: bool = False
+    eval_folds: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PrepParams(Params):
+    id: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgoParams(Params):
+    id: int = 0
+    mult: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ServParams(Params):
+    id: int = 0
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    ds_id: int
+    fail_sanity: bool = False
+    sanity_checked: bool = False
+
+    def sanity_check(self):
+        self.sanity_checked = True
+        if self.fail_sanity:
+            raise ValueError("sanity check failed: empty training data")
+
+
+@dataclasses.dataclass
+class PreparedData:
+    td: TrainingData
+    prep_id: int
+
+
+@dataclasses.dataclass
+class FixtureModel:
+    algo_id: int
+    mult: int
+    prep_id: int
+    ds_id: int
+
+
+class FixtureDataSource(DataSource):
+    params_class = DSParams
+
+    def read_training(self, ctx):
+        return TrainingData(ds_id=self.params.id, fail_sanity=self.params.fail_sanity)
+
+    def read_eval(self, ctx):
+        folds = []
+        for fold in range(self.params.eval_folds):
+            td = TrainingData(ds_id=self.params.id)
+            qa = [(q, q * 2) for q in range(3)]  # actual = query * 2
+            folds.append((td, {"fold": fold}, qa))
+        return folds
+
+
+class FixturePreparator(Preparator):
+    params_class = PrepParams
+
+    def prepare(self, ctx, td):
+        return PreparedData(td=td, prep_id=self.params.id)
+
+
+class FixtureAlgo(Algorithm):
+    params_class = AlgoParams
+
+    def train(self, ctx, pd):
+        return FixtureModel(
+            algo_id=self.params.id,
+            mult=self.params.mult,
+            prep_id=pd.prep_id,
+            ds_id=pd.td.ds_id,
+        )
+
+    def predict(self, model, query):
+        return query * model.mult
+
+
+class FixtureServing(Serving):
+    params_class = ServParams
+
+    def serve(self, query, predictions):
+        return max(predictions)
+
+
+@register_engine("fixture-engine")
+def fixture_engine() -> Engine:
+    return Engine(
+        FixtureDataSource,
+        FixturePreparator,
+        {"algo": FixtureAlgo, "algo2": FixtureAlgo},
+        FixtureServing,
+    )

@@ -1,0 +1,276 @@
+"""DASE controller tests: params binding, engine orchestration, metrics,
+FastEval memoization (reference EngineTest/JsonExtractorSuite/
+MetricEvaluatorTest analogs, SURVEY.md §4)."""
+
+import dataclasses
+
+import pytest
+
+from pio_tpu.controller import (
+    AverageMetric,
+    ComputeContext,
+    Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    FirstServing,
+    MetricEvaluator,
+    OptionAverageMetric,
+    Params,
+    ParamsError,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+    get_engine_factory,
+    params_from_dict,
+    register_engine,
+)
+from tests.fixtures import (
+    AlgoParams,
+    DSParams,
+    FixtureAlgo,
+    FixtureDataSource,
+    FixtureModel,
+    PrepParams,
+    ServParams,
+    fixture_engine,
+)
+
+
+CTX = ComputeContext.local()
+
+
+# ---------------------------------------------------------------- params
+@dataclasses.dataclass(frozen=True)
+class PTest(Params):
+    rank: int = 10
+    reg: float = 0.01
+    name: str = "als"
+    required_field: int = dataclasses.field(default=3)
+
+
+class TestParamsBinding:
+    def test_defaults_and_overrides(self):
+        p = params_from_dict(PTest, {"rank": 20})
+        assert p.rank == 20 and p.reg == 0.01
+
+    def test_int_coerces_to_float(self):
+        assert params_from_dict(PTest, {"reg": 1}).reg == 1.0
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ParamsError, match="unknown params.*'rnak'"):
+            params_from_dict(PTest, {"rnak": 20})
+
+    def test_type_mismatch(self):
+        with pytest.raises(ParamsError):
+            params_from_dict(PTest, {"rank": "ten"})
+        with pytest.raises(ParamsError):
+            params_from_dict(PTest, {"rank": True})
+
+    def test_missing_required(self):
+        @dataclasses.dataclass(frozen=True)
+        class NeedsIt(Params):
+            must: int
+
+        with pytest.raises(ParamsError, match="missing required param 'must'"):
+            params_from_dict(NeedsIt, {})
+        assert params_from_dict(NeedsIt, {"must": 5}).must == 5
+
+    def test_none_uses_defaults(self):
+        assert params_from_dict(PTest, None) == PTest()
+
+
+# ---------------------------------------------------------------- engine
+def variant(algos=None, ds=None):
+    v = {
+        "id": "test",
+        "engineFactory": "fixture-engine",
+        "datasource": {"params": ds or {"id": 7}},
+        "preparator": {"params": {"id": 8}},
+        "serving": {"params": {"id": 9}},
+    }
+    if algos is not None:
+        v["algorithms"] = algos
+    return v
+
+
+class TestEngine:
+    def test_params_from_variant(self):
+        engine = fixture_engine()
+        ep = engine.params_from_variant(
+            variant(algos=[{"name": "algo", "params": {"id": 1, "mult": 3}}])
+        )
+        assert ep.data_source_params == DSParams(id=7)
+        assert ep.preparator_params == PrepParams(id=8)
+        assert ep.serving_params == ServParams(id=9)
+        assert ep.algorithm_params_list == (("algo", AlgoParams(id=1, mult=3)),)
+
+    def test_variant_default_algorithms(self):
+        engine = fixture_engine()
+        ep = engine.params_from_variant(variant())
+        assert [n for n, _ in ep.algorithm_params_list] == ["algo", "algo2"]
+
+    def test_variant_unknown_algorithm(self):
+        with pytest.raises(ParamsError, match="unknown algorithm 'nope'"):
+            fixture_engine().params_from_variant(variant(algos=[{"name": "nope"}]))
+
+    def test_train_plumbs_params_through_stages(self):
+        engine = fixture_engine()
+        ep = engine.params_from_variant(
+            variant(algos=[
+                {"name": "algo", "params": {"id": 1, "mult": 2}},
+                {"name": "algo2", "params": {"id": 2, "mult": 5}},
+            ])
+        )
+        models = engine.train(CTX, ep)
+        assert models == [
+            FixtureModel(algo_id=1, mult=2, prep_id=8, ds_id=7),
+            FixtureModel(algo_id=2, mult=5, prep_id=8, ds_id=7),
+        ]
+
+    def test_sanity_check_runs_and_fails(self):
+        engine = fixture_engine()
+        ep = engine.params_from_variant(
+            variant(ds={"id": 1, "fail_sanity": True},
+                    algos=[{"name": "algo"}])
+        )
+        with pytest.raises(ValueError, match="sanity check failed"):
+            engine.train(CTX, ep)
+        # skip flag bypasses it
+        models = engine.train(CTX, ep, skip_sanity_check=True)
+        assert len(models) == 1
+
+    def test_stop_after_flags(self):
+        engine = fixture_engine()
+        ep = engine.params_from_variant(variant(algos=[{"name": "algo"}]))
+        assert engine.train(CTX, ep, stop_after_read=True) == []
+        assert engine.train(CTX, ep, stop_after_prepare=True) == []
+
+    def test_eval_serving_combines(self):
+        engine = fixture_engine()
+        ep = engine.params_from_variant(
+            variant(ds={"id": 1, "eval_folds": 2},
+                    algos=[{"name": "algo", "params": {"mult": 1}},
+                           {"name": "algo2", "params": {"mult": 10}}])
+        )
+        folds = engine.eval(CTX, ep)
+        assert len(folds) == 2
+        info, qpa = folds[0]
+        assert info == {"fold": 0}
+        # serving=max over {q*1, q*10}
+        assert [(q, p) for q, p, a in qpa] == [(0, 0), (1, 10), (2, 20)]
+        assert [a for _, _, a in qpa] == [0, 2, 4]
+
+    def test_registry_unknown(self):
+        with pytest.raises(ParamsError, match="not registered"):
+            get_engine_factory("no-such-engine")
+
+    def test_registry_module_attr(self):
+        f = get_engine_factory("tests.fixtures:fixture_engine")
+        assert isinstance(f(), Engine)
+
+    def test_mismatched_models(self):
+        engine = fixture_engine()
+        ep = engine.params_from_variant(variant(algos=[{"name": "algo"}]))
+        with pytest.raises(ValueError, match="1 algorithms but 2 models"):
+            engine.algorithms_with_models(ep, [1, 2])
+
+
+# ---------------------------------------------------------------- metrics
+class AbsErr(AverageMetric):
+    def calculate_one(self, q, p, a):
+        return abs(p - a)
+
+
+class TestMetrics:
+    DATA = [({}, [(0, 1.0, 2.0), (1, 5.0, 5.0)]), ({}, [(2, 0.0, 4.0)])]
+
+    def test_average(self):
+        assert AbsErr().calculate(self.DATA) == pytest.approx((1 + 0 + 4) / 3)
+
+    def test_option_average_skips_none(self):
+        class M(OptionAverageMetric):
+            def calculate_one(self, q, p, a):
+                return None if p == 0.0 else float(p)
+
+        assert M().calculate(self.DATA) == pytest.approx(3.0)
+
+    def test_sum_and_zero(self):
+        class S(SumMetric):
+            def calculate_one(self, q, p, a):
+                return float(p)
+
+        assert S().calculate(self.DATA) == 6.0
+        assert ZeroMetric().calculate(self.DATA) == 0.0
+
+    def test_stdev(self):
+        class S(StdevMetric):
+            def calculate_one(self, q, p, a):
+                return float(p)
+
+        import statistics
+
+        assert S().calculate(self.DATA) == pytest.approx(
+            statistics.pstdev([1.0, 5.0, 0.0])
+        )
+
+    def test_compare_direction(self):
+        m = AbsErr()
+        m.higher_is_better = False
+        assert m.compare(1.0, 2.0) > 0  # lower err wins
+
+    def test_empty_is_nan(self):
+        import math
+
+        assert math.isnan(AbsErr().calculate([]))
+
+
+# ---------------------------------------------------------------- evaluator
+class NegAbsErr(AverageMetric):
+    """Higher-is-better form of abs error."""
+
+    def calculate_one(self, q, p, a):
+        return -abs(p - a)
+
+
+class TestMetricEvaluator:
+    def _params(self, mult):
+        engine = fixture_engine()
+        return engine.params_from_variant(
+            variant(ds={"id": 1, "eval_folds": 1},
+                    algos=[{"name": "algo", "params": {"mult": mult}}])
+        )
+
+    def test_picks_best(self):
+        engine = fixture_engine()
+        # actual = q*2, prediction = q*mult -> mult=2 is perfect
+        candidates = [self._params(m) for m in (1, 2, 5)]
+        result = MetricEvaluator(NegAbsErr()).evaluate(CTX, engine, candidates)
+        assert result.best_index == 1
+        assert result.best_score == 0.0
+        assert ("algo", AlgoParams(mult=2)) in result.best_engine_params.algorithm_params_list
+        assert "bestEngineParams" in result.to_json()
+
+    def test_fast_eval_memoizes_stages(self, monkeypatch):
+        engine = fixture_engine()
+        reads = {"n": 0}
+        orig = FixtureDataSource.read_eval
+
+        def counting_read_eval(self, ctx):
+            reads["n"] += 1
+            return orig(self, ctx)
+
+        monkeypatch.setattr(FixtureDataSource, "read_eval", counting_read_eval)
+        candidates = [self._params(m) for m in (1, 2, 3)]  # same DS params
+        MetricEvaluator(NegAbsErr()).evaluate(CTX, engine, candidates)
+        assert reads["n"] == 1  # DataSource ran once for the whole sweep
+
+        reads["n"] = 0
+        MetricEvaluator(NegAbsErr()).evaluate(
+            CTX, engine, candidates, fast_eval=False
+        )
+        assert reads["n"] == 3  # no memoization
+
+    def test_generator_requires_nonempty(self):
+        with pytest.raises(ValueError):
+            EngineParamsGenerator([])

@@ -1,0 +1,133 @@
+"""Workflow tests: run_train bookkeeping, model persistence, engine.json,
+run_evaluation (reference CoreWorkflow/FakeWorkflow test analogs)."""
+
+import json
+
+import pytest
+
+from pio_tpu.controller import ComputeContext
+from pio_tpu.storage import RunStatus, Storage
+from pio_tpu.workflow import (
+    EngineJsonError,
+    WorkflowParams,
+    build_engine,
+    load_models_for_instance,
+    load_variant,
+    run_evaluation,
+    run_train,
+    variant_from_dict,
+)
+from tests.fixtures import FixtureModel, fixture_engine
+from tests.test_controller import NegAbsErr, variant
+
+
+@pytest.fixture(autouse=True)
+def isolated_storage(tmp_home):
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+CTX = ComputeContext.local()
+
+
+class TestEngineJson:
+    def test_load_variant_file(self, tmp_path):
+        p = tmp_path / "engine.json"
+        p.write_text(json.dumps(variant(algos=[{"name": "algo"}])))
+        v = load_variant(str(p))
+        assert v.engine_factory == "fixture-engine"
+        assert v.engine_id == "test"
+        engine, ep = build_engine(v)
+        assert ep.algorithm_params_list[0][0] == "algo"
+
+    def test_missing_file(self):
+        with pytest.raises(EngineJsonError, match="not found"):
+            load_variant("/nope/engine.json")
+
+    def test_bad_json(self, tmp_path):
+        p = tmp_path / "engine.json"
+        p.write_text("{nope")
+        with pytest.raises(EngineJsonError, match="invalid JSON"):
+            load_variant(str(p))
+
+    def test_missing_factory(self):
+        with pytest.raises(EngineJsonError, match="engineFactory"):
+            variant_from_dict({"id": "x"})
+
+
+class TestRunTrain:
+    def _variant(self, **kw):
+        return variant_from_dict(variant(**kw))
+
+    def test_completed_run_persists_models(self):
+        v = self._variant(algos=[{"name": "algo", "params": {"id": 1, "mult": 4}}])
+        engine, ep = build_engine(v)
+        iid = run_train(engine, ep, v, ctx=CTX)
+
+        inst = Storage.get_meta_data_engine_instances().get(iid)
+        assert inst.status == RunStatus.COMPLETED
+        assert inst.engine_factory == "fixture-engine"
+        assert json.loads(inst.algorithms_params)[0]["params"]["mult"] == 4
+        assert "train_seconds" in inst.env
+
+        models = load_models_for_instance(iid, engine, ep, CTX)
+        assert models == [FixtureModel(algo_id=1, mult=4, prep_id=8, ds_id=7)]
+
+        latest = Storage.get_meta_data_engine_instances().get_latest_completed(
+            v.engine_id, v.engine_version, v.path or v.engine_id
+        )
+        assert latest.id == iid
+
+    def test_failed_run_marked(self):
+        v = self._variant(ds={"id": 1, "fail_sanity": True}, algos=[{"name": "algo"}])
+        engine, ep = build_engine(v)
+        with pytest.raises(ValueError):
+            run_train(engine, ep, v, ctx=CTX)
+        insts = Storage.get_meta_data_engine_instances().get_all()
+        assert len(insts) == 1
+        assert insts[0].status == RunStatus.FAILED
+        assert "sanity check failed" in insts[0].env["error"]
+
+    def test_stop_after_read_aborts(self):
+        v = self._variant(algos=[{"name": "algo"}])
+        engine, ep = build_engine(v)
+        iid = run_train(
+            engine, ep, v, WorkflowParams(stop_after_read=True), ctx=CTX
+        )
+        assert (
+            Storage.get_meta_data_engine_instances().get(iid).status
+            == RunStatus.ABORTED
+        )
+        assert Storage.get_model_data_models().get(iid) is None
+
+    def test_load_models_missing_instance(self):
+        v = self._variant(algos=[{"name": "algo"}])
+        engine, ep = build_engine(v)
+        with pytest.raises(RuntimeError, match="no models stored"):
+            load_models_for_instance("ghost", engine, ep, CTX)
+
+
+class TestRunEvaluation:
+    def test_records_result(self):
+        from pio_tpu.controller import EngineParamsGenerator, Evaluation
+
+        engine = fixture_engine()
+        candidates = [
+            engine.params_from_variant(
+                variant(ds={"id": 1, "eval_folds": 1},
+                        algos=[{"name": "algo", "params": {"mult": m}}])
+            )
+            for m in (1, 2)
+        ]
+        result = run_evaluation(
+            Evaluation(engine, NegAbsErr()),
+            EngineParamsGenerator(candidates),
+            ctx=CTX,
+        )
+        assert result.best_score == 0.0
+        done = Storage.get_meta_data_evaluation_instances().get_completed()
+        assert len(done) == 1
+        assert "NegAbsErr" in done[0].evaluator_results
+        parsed = json.loads(done[0].evaluator_results_json)
+        assert parsed["bestIndex"] == 1
