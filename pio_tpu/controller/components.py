@@ -33,8 +33,12 @@ A = TypeVar("A")  # actual (ground truth)
 M = TypeVar("M")  # model
 
 
-class Component:
-    """Base: holds the params it was constructed with (reference AbstractDoer)."""
+class Component(abc.ABC):
+    """Base: holds the params it was constructed with (reference AbstractDoer).
+
+    Inherits ABC so ``@abc.abstractmethod`` on subclasses is actually
+    enforced at instantiation time.
+    """
 
     params_class: type = EmptyParams
 

@@ -39,6 +39,22 @@ from pio_tpu.parallel.context import ComputeContext
 log = logging.getLogger("pio_tpu.engine")
 
 
+def serve_fold(serving, algorithms, models, qa):
+    """One eval fold's query loop: supplement → per-algo predict → serve.
+
+    Shared by :meth:`Engine.eval` and the FastEval path so serving
+    semantics can't diverge. Returns [(query, prediction, actual)].
+    """
+    qpa = []
+    for q, actual in qa:
+        q = serving.supplement(q)
+        preds = [
+            algo.predict(model, q) for algo, model in zip(algorithms, models)
+        ]
+        qpa.append((q, serving.serve(q, preds), actual))
+    return qpa
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineParams:
     """Per-run parameter bundle (reference ``EngineParams``)."""
@@ -173,15 +189,7 @@ class Engine:
         for td, eval_info, qa in data_source.read_eval(ctx):
             pd = preparator.prepare(ctx, td)
             models = [algo.train(ctx, pd) for algo in algorithms]
-            qpa = []
-            for q, actual in qa:
-                q = serving.supplement(q)
-                preds = [
-                    algo.predict(model, q)
-                    for algo, model in zip(algorithms, models)
-                ]
-                qpa.append((q, serving.serve(q, preds), actual))
-            results.append((eval_info, qpa))
+            results.append((eval_info, serve_fold(serving, algorithms, models, qa)))
         return results
 
     # -- deploy prep (reference Engine.prepareDeploy) ------------------------
@@ -250,14 +258,20 @@ def get_engine_factory(name: str) -> EngineFactory:
         if fn is None:
             raise ParamsError(f"{mod_name!r} has no attribute {attr!r}")
         return fn
-    # final attempt: importing the module may register the name
+    # Final attempt: importing a module may register the name as a side
+    # effect. Try the name itself, its parent package, and both prefixed
+    # with "pio_tpu." (bundled templates register e.g.
+    # "templates.recommendation" but live at pio_tpu.templates.*).
     if "." in name:
-        try:
-            importlib.import_module(name.rsplit(".", 1)[0])
-        except ImportError:
-            pass
-        if name in _ENGINE_REGISTRY:
-            return _ENGINE_REGISTRY[name]
+        candidates = [name, name.rsplit(".", 1)[0]]
+        candidates += [f"pio_tpu.{c}" for c in candidates]
+        for mod_name in candidates:
+            try:
+                importlib.import_module(mod_name)
+            except ImportError:
+                continue
+            if name in _ENGINE_REGISTRY:
+                return _ENGINE_REGISTRY[name]
     raise ParamsError(
         f"engine factory {name!r} not registered; known: {engine_factory_names()}"
     )

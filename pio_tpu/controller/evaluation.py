@@ -18,10 +18,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import math
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from pio_tpu.controller.components import Serving
-from pio_tpu.controller.engine import Engine, EngineParams
+from pio_tpu.controller.engine import Engine, EngineParams, serve_fold
 from pio_tpu.controller.metrics import Metric
 from pio_tpu.controller.params import params_to_dict
 from pio_tpu.parallel.context import ComputeContext
@@ -86,18 +87,22 @@ class MetricEvaluatorResult:
                 "servingParams": params_to_dict(ep.serving_params),
             }
 
+        def safe(x: float):
+            # json.dumps would emit the invalid literal `NaN` otherwise
+            return x if math.isfinite(x) else None
+
         return json.dumps(
             {
                 "metricHeader": self.metric_header,
                 "otherMetricHeaders": self.other_metric_headers,
-                "bestScore": self.best_score,
+                "bestScore": safe(self.best_score),
                 "bestIndex": self.best_index,
                 "bestEngineParams": ep_dict(self.best_engine_params),
                 "engineParamsScores": [
                     {
                         "engineParams": ep_dict(s.engine_params),
-                        "score": s.score,
-                        "otherScores": s.other_scores,
+                        "score": safe(s.score),
+                        "otherScores": [safe(x) for x in s.other_scores],
                     }
                     for s in self.engine_params_scores
                 ],
@@ -181,17 +186,10 @@ def _fast_eval(
     trained = cache.get_or(cache.algorithms, cache.algo_key(ep), compute_models)
 
     serving = engine.serving_class(ep.serving_params)
-    results = []
-    for algorithms, models, eval_info, qa in trained:
-        qpa = []
-        for q, actual in qa:
-            q = serving.supplement(q)
-            preds = [
-                algo.predict(model, q) for algo, model in zip(algorithms, models)
-            ]
-            qpa.append((q, serving.serve(q, preds), actual))
-        results.append((eval_info, qpa))
-    return results
+    return [
+        (eval_info, serve_fold(serving, algorithms, models, qa))
+        for algorithms, models, eval_info, qa in trained
+    ]
 
 
 class MetricEvaluator:
@@ -225,10 +223,21 @@ class MetricEvaluator:
             )
             scores.append(MetricScores(ep, score, others))
 
-        best_i = 0
-        for i in range(1, len(scores)):
-            if self.metric.compare(scores[i].score, scores[best_i].score) > 0:
+        # NaN scores (empty/unscorable folds) can never win: a NaN at index
+        # 0 would otherwise stick because compare() returns 0 for NaN.
+        best_i = None
+        for i in range(len(scores)):
+            if math.isnan(scores[i].score):
+                continue
+            if best_i is None or self.metric.compare(
+                scores[i].score, scores[best_i].score
+            ) > 0:
                 best_i = i
+        if best_i is None:
+            raise ValueError(
+                "every candidate scored NaN - no fold produced a scorable "
+                "(query, prediction, actual) triple"
+            )
         if cache is not None:
             log.info(
                 "FastEval cache: %d hits / %d misses", cache.hits, cache.misses

@@ -103,6 +103,12 @@ class ComputeContext:
         import jax.numpy as jnp
 
         n = len(next(iter(arrays.values())))
+        for k, v in arrays.items():
+            if len(v) != n:
+                raise ValueError(
+                    f"all arrays must share dim-0 length; {k!r} has "
+                    f"{len(v)} != {n}"
+                )
         if self.mesh is None:
             out = {k: jnp.asarray(v) for k, v in arrays.items()}
             out["mask"] = jnp.ones((n,), dtype=jnp.float32)

@@ -211,8 +211,13 @@ class Storage:
             "MODELDATA/models": cls.get_model_data_models,
         }
         # parquet serves the bulk interface only — probing LEvents there
-        # would flag a correctly configured deployment as broken.
-        if _source_config("EVENTDATA").type != "parquet":
+        # would flag a correctly configured deployment as broken. A broken
+        # EVENTDATA config must still be *reported*, not raised.
+        try:
+            eventdata_type = _source_config("EVENTDATA").type
+        except StorageConfigError:
+            eventdata_type = None
+        if eventdata_type != "parquet":
             checks["EVENTDATA/levents"] = cls.get_levents
         for name, fn in checks.items():
             try:

@@ -1,0 +1,11 @@
+"""Bundled engine templates (reference ``examples/scala-parallel-*``).
+
+Importing this package registers every bundled engine factory:
+
+- ``templates.recommendation`` — explicit ALS recommender
+  (≙ examples/scala-parallel-recommendation)
+"""
+
+from pio_tpu.templates import recommendation  # noqa: F401  (registers factory)
+
+__all__ = ["recommendation"]

@@ -1,0 +1,296 @@
+"""Recommendation template — ALS on rate/buy events.
+
+Rebuild of the reference's ``examples/scala-parallel-recommendation``
+(DataSource.scala, Preparator.scala, ALSAlgorithm.scala, Serving.scala —
+UNVERIFIED paths; see SURVEY.md): read ``rate``/``buy`` events, index string
+ids densely, factorize with ALS, serve top-N item scores per user.
+
+engine.json:
+
+    {
+      "id": "recommendation",
+      "engineFactory": "templates.recommendation",
+      "datasource": {"params": {"app_name": "myapp"}},
+      "algorithms": [{"name": "als", "params":
+          {"rank": 10, "num_iterations": 10, "lambda_": 0.01, "seed": 3}}]
+    }
+
+Query ``{"user": "u1", "num": 4}`` →
+``{"itemScores": [{"item": "i5", "score": 3.2}, ...]}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+    register_engine,
+)
+from pio_tpu.data.bimap import BiMap
+from pio_tpu.models.als import ALSConfig, ALSFactors, top_n, train_als
+from pio_tpu.parallel.context import ComputeContext
+from pio_tpu.storage import Storage
+from pio_tpu.storage.frame import EventFrame
+
+
+# --------------------------------------------------------------- data source
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    app_id: int = 0  # alternative to app_name
+    channel: str = ""  # optional named channel
+    #: events read as ratings; ``buy`` is treated as an implicit 4.0 rating
+    #: (parity with the reference template's buyEvent handling)
+    rate_event: str = "rate"
+    buy_event: str = "buy"
+    buy_rating: float = 4.0
+    eval_k: int = 0  # >0 enables k-fold read_eval
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    user_ids: np.ndarray  # [n] str objects
+    item_ids: np.ndarray  # [n] str objects
+    ratings: np.ndarray  # [n] float32
+
+    def sanity_check(self) -> None:
+        if len(self.ratings) == 0:
+            raise ValueError(
+                "TrainingData is empty - no rate/buy events found. "
+                "Did you import events for this app?"
+            )
+
+    def __len__(self):
+        return len(self.ratings)
+
+
+def _resolve_app(params: DataSourceParams) -> Tuple[int, Optional[int]]:
+    app_id = params.app_id
+    if params.app_name:
+        app = Storage.get_meta_data_apps().get_by_name(params.app_name)
+        if app is None:
+            raise ValueError(f"app {params.app_name!r} not found")
+        app_id = app.id
+    if not app_id:
+        raise ValueError("datasource params need app_name or app_id")
+    channel_id = None
+    if params.channel:
+        chans = Storage.get_meta_data_channels().get_by_app_id(app_id)
+        match = [c for c in chans if c.name == params.channel]
+        if not match:
+            raise ValueError(f"channel {params.channel!r} not found")
+        channel_id = match[0].id
+    return app_id, channel_id
+
+
+class RecommendationDataSource(DataSource):
+    """PEvents bulk read → columnar ratings
+    (≙ reference DataSource.readTraining via PEventStore.find)."""
+
+    params_class = DataSourceParams
+
+    def _read_frame(self) -> Tuple[EventFrame, "DataSourceParams"]:
+        p: DataSourceParams = self.params
+        app_id, channel_id = _resolve_app(p)
+        frame = Storage.get_pevents().find_frame(
+            app_id,
+            channel_id=channel_id,
+            event_names=[p.rate_event, p.buy_event],
+            entity_type="user",
+            target_entity_type="item",
+        )
+        return frame, p
+
+    def _to_training_data(self, frame: EventFrame) -> TrainingData:
+        p: DataSourceParams = self.params
+        ratings = frame.property_column("rating", default=np.nan)
+        is_buy = frame.event == p.buy_event
+        ratings = np.where(is_buy, np.float32(p.buy_rating), ratings)
+        # drop rate events with no rating property
+        keep = ~np.isnan(ratings)
+        return TrainingData(
+            user_ids=frame.entity_id[keep],
+            item_ids=frame.target_entity_id[keep],
+            ratings=ratings[keep].astype(np.float32),
+        )
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        frame, _ = self._read_frame()
+        return self._to_training_data(frame)
+
+    def read_eval(self, ctx: ComputeContext):
+        """k-fold split by rating index (≙ e2 CommonHelperFunctions.splitData)."""
+        p: DataSourceParams = self.params
+        if p.eval_k <= 0:
+            return []
+        frame, _ = self._read_frame()
+        td_all = self._to_training_data(frame)
+        n = len(td_all)
+        fold_of = np.arange(n) % p.eval_k
+        folds = []
+        for k in range(p.eval_k):
+            train = fold_of != k
+            test = ~train
+            td = TrainingData(
+                user_ids=td_all.user_ids[train],
+                item_ids=td_all.item_ids[train],
+                ratings=td_all.ratings[train],
+            )
+            qa = [
+                (
+                    Query(user=str(u), num=1, item=str(i)),
+                    float(r),
+                )
+                for u, i, r in zip(
+                    td_all.user_ids[test],
+                    td_all.item_ids[test],
+                    td_all.ratings[test],
+                )
+            ]
+            folds.append((td, {"fold": k}, qa))
+        return folds
+
+
+# --------------------------------------------------------------- preparator
+@dataclasses.dataclass
+class PreparedData:
+    user_index: BiMap
+    item_index: BiMap
+    user_codes: np.ndarray  # [n] int32
+    item_codes: np.ndarray  # [n] int32
+    ratings: np.ndarray  # [n] float32
+
+
+class RecommendationPreparator(Preparator):
+    """String ids → dense codes (≙ reference Preparator + BiMap.stringInt)."""
+
+    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
+        user_index = BiMap.string_int(td.user_ids.tolist())
+        item_index = BiMap.string_int(td.item_ids.tolist())
+        ufwd, ifwd = user_index.to_dict(), item_index.to_dict()
+        user_codes = np.fromiter(
+            (ufwd[u] for u in td.user_ids.tolist()), np.int32, len(td)
+        )
+        item_codes = np.fromiter(
+            (ifwd[i] for i in td.item_ids.tolist()), np.int32, len(td)
+        )
+        return PreparedData(
+            user_index, item_index, user_codes, item_codes, td.ratings
+        )
+
+
+# --------------------------------------------------------------- algorithm
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+    item: str = ""  # when set, score just this item (used by eval)
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {
+            "itemScores": [
+                {"item": s.item, "score": s.score} for s in self.item_scores
+            ]
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01  # engine.json key "lambda_" (lambda is reserved)
+    seed: int = 3
+    implicit_prefs: bool = False
+    alpha: float = 1.0
+
+
+@dataclasses.dataclass
+class ALSModel:
+    factors: ALSFactors
+    user_index: BiMap
+    item_index: BiMap
+
+    def scores_for_user(self, user: str) -> Optional[np.ndarray]:
+        code = self.user_index.get(user)
+        if code is None:
+            return None
+        return self.factors.user_factors[code] @ self.factors.item_factors.T
+
+
+class ALSAlgorithm(Algorithm):
+    """pjit ALS (≙ reference ALSAlgorithm.train → MLlib ALS.train)."""
+
+    params_class = ALSAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> ALSModel:
+        p: ALSAlgorithmParams = self.params
+        factors = train_als(
+            ctx,
+            pd.user_codes,
+            pd.item_codes,
+            pd.ratings,
+            n_users=len(pd.user_index),
+            n_items=len(pd.item_index),
+            config=ALSConfig(
+                rank=p.rank,
+                iterations=p.num_iterations,
+                reg=p.lambda_,
+                implicit=p.implicit_prefs,
+                alpha=p.alpha,
+                seed=p.seed,
+            ),
+        )
+        return ALSModel(factors, pd.user_index, pd.item_index)
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        scores = model.scores_for_user(query.user)
+        if scores is None:
+            return PredictedResult()  # unknown user (parity: empty result)
+        if query.item:
+            code = model.item_index.get(query.item)
+            if code is None:
+                return PredictedResult()
+            return PredictedResult(
+                (ItemScore(query.item, float(scores[code])),)
+            )
+        idx, vals = top_n(scores, query.num)
+        inv = model.item_index.inverse
+        return PredictedResult(
+            tuple(ItemScore(inv[int(i)], float(v)) for i, v in zip(idx, vals))
+        )
+
+
+class RecommendationServing(FirstServing):
+    pass
+
+
+@register_engine("templates.recommendation")
+def recommendation_engine() -> Engine:
+    return Engine(
+        RecommendationDataSource,
+        RecommendationPreparator,
+        {"als": ALSAlgorithm},
+        RecommendationServing,
+    )

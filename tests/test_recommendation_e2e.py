@@ -1,0 +1,154 @@
+"""End-to-end slice (SURVEY.md §7.5): import events → DataSource(find→arrays)
+→ Preparator(BiMap) → ALS train via run_train → model store → reload →
+top-N query. The quickstart_test.py analog of the reference's integration
+tier, minus the HTTP servers (covered in server tests)."""
+
+import datetime as dt
+import json
+
+import numpy as np
+import pytest
+
+import pio_tpu.templates  # noqa: F401  (registers the engine factory)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.storage import App, RunStatus, Storage
+from pio_tpu.templates.recommendation import PredictedResult, Query
+from pio_tpu.workflow import (
+    build_engine,
+    load_models_for_instance,
+    run_train,
+    variant_from_dict,
+)
+
+
+@pytest.fixture(autouse=True)
+def isolated_storage(tmp_home):
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def _seed_events(app_id: int, n_users=12, n_items=8):
+    """Block structure: users u0..5 love items i0..3; u6..11 love i4..7."""
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    events = []
+    for u in range(n_users):
+        for i in range(n_items):
+            in_block = (u < 6) == (i < 4)
+            rating = 5.0 if in_block else 1.0
+            events.append(
+                Event(
+                    "rate",
+                    "user",
+                    f"u{u}",
+                    "item",
+                    f"i{i}",
+                    properties={"rating": rating},
+                    event_time=t0 + dt.timedelta(minutes=u * 60 + i),
+                )
+            )
+    # one buy event (implicit 4.0) and one unrelated event type
+    events.append(Event("buy", "user", "u0", "item", "i3", event_time=t0))
+    events.append(Event("view", "user", "u0", "item", "i7", event_time=t0))
+    for e in events:
+        le.insert(e, app_id)
+
+
+VARIANT = {
+    "id": "rec-e2e",
+    "engineFactory": "templates.recommendation",
+    "datasource": {"params": {"app_name": "rec-test"}},
+    "algorithms": [
+        {
+            "name": "als",
+            "params": {"rank": 6, "num_iterations": 10, "lambda_": 0.05, "seed": 1},
+        }
+    ],
+}
+
+
+class TestRecommendationEndToEnd:
+    def test_full_lifecycle(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "rec-test"))
+        _seed_events(app_id)
+
+        variant = variant_from_dict(VARIANT)
+        engine, ep = build_engine(variant)
+        ctx = ComputeContext.create(seed=0)
+        instance_id = run_train(engine, ep, variant, ctx=ctx)
+
+        inst = Storage.get_meta_data_engine_instances().get(instance_id)
+        assert inst.status == RunStatus.COMPLETED
+
+        # reload from the model store, as deploy would
+        models = load_models_for_instance(instance_id, engine, ep, ctx)
+        serving = engine.make_serving(ep)
+        pairs = engine.algorithms_with_models(ep, models)
+
+        def query(user, num=4):
+            q = Query(user=user, num=num)
+            preds = [algo.predict(m, q) for algo, m in pairs]
+            return serving.serve(q, preds)
+
+        res = query("u0")
+        assert isinstance(res, PredictedResult)
+        assert len(res.item_scores) == 4
+        # u0 is in the first block: its top items must be i0..i3
+        top_items = {s.item for s in res.item_scores}
+        assert top_items == {"i0", "i1", "i2", "i3"}
+        # scores sorted descending
+        scores = [s.score for s in res.item_scores]
+        assert scores == sorted(scores, reverse=True)
+
+        # second-block user prefers i4..7
+        res2 = query("u11")
+        assert {s.item for s in res2.item_scores} == {"i4", "i5", "i6", "i7"}
+
+        # unknown user → empty result, JSON-able
+        assert query("stranger") == PredictedResult()
+        assert json.loads(json.dumps(res.to_dict()))["itemScores"][0]["item"]
+
+    def test_empty_app_fails_sanity(self):
+        Storage.get_meta_data_apps().insert(App(0, "rec-test"))
+        variant = variant_from_dict(VARIANT)
+        engine, ep = build_engine(variant)
+        with pytest.raises(ValueError, match="TrainingData is empty"):
+            run_train(engine, ep, variant, ctx=ComputeContext.local())
+        insts = Storage.get_meta_data_engine_instances().get_all()
+        assert insts[0].status == RunStatus.FAILED
+
+    def test_eval_folds(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "rec-test"))
+        _seed_events(app_id)
+        variant = variant_from_dict(
+            {
+                **VARIANT,
+                "datasource": {
+                    "params": {"app_name": "rec-test", "eval_k": 3}
+                },
+                # held-out folds are tiny: rank 2 + stronger reg keeps the
+                # normal equations well-conditioned (rank 6 overfits them)
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {"rank": 2, "num_iterations": 15,
+                                   "lambda_": 0.1, "seed": 1},
+                    }
+                ],
+            }
+        )
+        engine, ep = build_engine(variant)
+        folds = engine.eval(ComputeContext.create(seed=0), ep)
+        assert len(folds) == 3
+        # rating predictions on held-out pairs should beat a constant-3 guess
+        sq_err, sq_base, n = 0.0, 0.0, 0
+        for _, qpa in folds:
+            for q, p, actual in qpa:
+                if p.item_scores:
+                    sq_err += (p.item_scores[0].score - actual) ** 2
+                    sq_base += (3.0 - actual) ** 2
+                    n += 1
+        assert n > 50
+        assert sq_err / n < sq_base / n
