@@ -1,0 +1,7 @@
+"""``python -m pio_tpu`` — the `pio` CLI equivalent."""
+
+import sys
+
+from pio_tpu.tools.cli import main
+
+sys.exit(main())

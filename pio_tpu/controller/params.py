@@ -62,6 +62,11 @@ def _check_field_type(name: str, value: Any, ftype: Any) -> Any:
 
 def params_from_dict(cls: Type[P], d: Optional[Mapping[str, Any]]) -> P:
     """Bind a JSON object to a Params dataclass (strict about unknown keys)."""
+    if d is not None and not isinstance(d, Mapping):
+        raise ParamsError(
+            f"{cls.__name__}: params must be a JSON object, "
+            f"got {type(d).__name__}"
+        )
     d = dict(d or {})
     if not dataclasses.is_dataclass(cls):
         raise ParamsError(f"{cls.__name__} must be a dataclass")

@@ -1,0 +1,21 @@
+"""HTTP servers: Event Server (ingest) + Query Server (per-engine serving).
+
+Rebuild of the reference's ``data/.../data/api/EventServer.scala`` and
+``core/.../workflow/CreateServer.scala`` (UNVERIFIED paths; see SURVEY.md).
+"""
+
+from pio_tpu.server.event_server import EventServerService, create_event_server
+from pio_tpu.server.http import JsonHTTPServer, Router
+from pio_tpu.server.query_server import (
+    QueryServerService,
+    create_query_server,
+)
+
+__all__ = [
+    "EventServerService",
+    "JsonHTTPServer",
+    "QueryServerService",
+    "Router",
+    "create_event_server",
+    "create_query_server",
+]

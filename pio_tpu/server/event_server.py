@@ -1,0 +1,268 @@
+"""Event Server — REST ingestion daemon (:7070 by default).
+
+Rebuild of the reference's ``data/.../data/api/EventServer.scala``
+(UNVERIFIED path; see SURVEY.md). Routes:
+
+    GET    /                          alive check
+    POST   /events.json               ingest one event (201 + eventId)
+    GET    /events.json               filtered query (reversed by default)
+    GET    /events/<id>.json          fetch one
+    DELETE /events/<id>.json          delete one
+    POST   /batch/events.json         ≤50 events, per-item statuses
+    GET    /stats.json                per-app counters since start
+    POST   /webhooks/<name>.json      JSON webhook connector
+    POST   /webhooks/<name>.form      form webhook connector
+
+Auth: ``accessKey`` query param (or ``Authorization`` header); the key maps
+to an app and an optional event-name whitelist. ``channel`` selects a named
+sub-stream (must exist; 400 otherwise).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pio_tpu.data.event import Event, EventValidationError
+from pio_tpu.server.http import HTTPError, JsonHTTPServer, Request, Router
+from pio_tpu.server.webhooks import (
+    FORM_CONNECTORS,
+    JSON_CONNECTORS,
+    ConnectorError,
+    parse_form,
+)
+from pio_tpu.storage import Storage
+
+log = logging.getLogger("pio_tpu.eventserver")
+
+MAX_BATCH = 50
+
+#: ingest-path plugin hooks (reference EventServerPlugin): callables
+#: (app_id, channel_id, event_dict) -> None, may raise HTTPError to block.
+INPUT_BLOCKERS: List[Callable] = []
+INPUT_SNIFFERS: List[Callable] = []
+
+
+class _Stats:
+    """Rolling per-app counters (reference ``Stats``/``StatsActor``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.start_time = _dt.datetime.now(_dt.timezone.utc)
+        # (app_id, event, entity_type, status) -> count
+        self.counts: Dict[Tuple[int, str, str, int], int] = {}
+
+    def tick(self, app_id: int, event: str, entity_type: str, status: int):
+        with self._lock:
+            key = (app_id, event, entity_type, status)
+            self.counts[key] = self.counts.get(key, 0) + 1
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            by_app: Dict[int, list] = {}
+            for (app_id, event, etype, status), n in sorted(self.counts.items()):
+                by_app.setdefault(app_id, []).append(
+                    {
+                        "event": event,
+                        "entityType": etype,
+                        "status": status,
+                        "count": n,
+                    }
+                )
+        return {
+            "startTime": self.start_time.isoformat(),
+            "apps": [
+                {"appId": app_id, "counts": counts}
+                for app_id, counts in by_app.items()
+            ],
+        }
+
+
+class EventServerService:
+    """Route handlers, separable from the HTTP loop for direct testing."""
+
+    def __init__(self):
+        self.stats = _Stats()
+        self.router = Router()
+        r = self.router
+        r.add("GET", "/", self.alive)
+        r.add("POST", "/events\\.json", self.create_event)
+        r.add("GET", "/events\\.json", self.find_events)
+        r.add("GET", "/events/([^/]+)\\.json", self.get_event)
+        r.add("DELETE", "/events/([^/]+)\\.json", self.delete_event)
+        r.add("POST", "/batch/events\\.json", self.batch_events)
+        r.add("GET", "/stats\\.json", self.get_stats)
+        r.add("POST", "/webhooks/([^/]+)\\.json", self.webhook_json)
+        r.add("POST", "/webhooks/([^/]+)\\.form", self.webhook_form)
+
+    # -- auth ---------------------------------------------------------------
+    def _auth(self, req: Request) -> Tuple[int, Optional[int], tuple]:
+        """accessKey+channel → (app_id, channel_id, event_whitelist)."""
+        key = req.params.get("accessKey") or req.headers.get("Authorization", "")
+        if key.startswith("Bearer "):
+            key = key[len("Bearer "):]
+        if not key:
+            raise HTTPError(401, "missing accessKey")
+        ak = Storage.get_meta_data_access_keys().get(key)
+        if ak is None:
+            raise HTTPError(401, "invalid accessKey")
+        channel_id = None
+        channel = req.params.get("channel")
+        if channel:
+            chans = Storage.get_meta_data_channels().get_by_app_id(ak.app_id)
+            match = [c for c in chans if c.name == channel]
+            if not match:
+                raise HTTPError(400, f"invalid channel {channel!r}")
+            channel_id = match[0].id
+        return ak.app_id, channel_id, ak.events
+
+    def _check_whitelist(self, event_name: str, whitelist: tuple):
+        if whitelist and event_name not in whitelist:
+            raise HTTPError(
+                403, f"accessKey does not allow event {event_name!r}"
+            )
+
+    # -- handlers -----------------------------------------------------------
+    def alive(self, req: Request):
+        return 200, {"status": "alive"}
+
+    def _ingest_one(self, d: Any, app_id: int, channel_id, whitelist) -> str:
+        if not isinstance(d, dict):
+            raise EventValidationError("event must be a JSON object")
+        event = Event.from_api_dict(d)
+        self._check_whitelist(event.event, whitelist)
+        for blocker in INPUT_BLOCKERS:
+            blocker(app_id, channel_id, d)
+        event_id = Storage.get_levents().insert(event, app_id, channel_id)
+        for sniffer in INPUT_SNIFFERS:
+            try:
+                sniffer(app_id, channel_id, d)
+            except Exception:
+                log.exception("input sniffer failed")
+        self.stats.tick(app_id, event.event, event.entity_type, 201)
+        return event_id
+
+    def create_event(self, req: Request):
+        app_id, channel_id, whitelist = self._auth(req)
+        try:
+            event_id = self._ingest_one(req.body, app_id, channel_id, whitelist)
+        except EventValidationError as e:
+            self.stats.tick(app_id, "<invalid>", "<invalid>", 400)
+            return 400, {"message": str(e)}
+        return 201, {"eventId": event_id}
+
+    def batch_events(self, req: Request):
+        app_id, channel_id, whitelist = self._auth(req)
+        if not isinstance(req.body, list):
+            return 400, {"message": "batch body must be a JSON array"}
+        if len(req.body) > MAX_BATCH:
+            return 400, {
+                "message": f"batch size {len(req.body)} exceeds {MAX_BATCH}"
+            }
+        results = []
+        for d in req.body:
+            try:
+                event_id = self._ingest_one(d, app_id, channel_id, whitelist)
+                results.append({"status": 201, "eventId": event_id})
+            except (EventValidationError, HTTPError) as e:
+                status = e.status if isinstance(e, HTTPError) else 400
+                results.append({"status": status, "message": str(e)})
+        return 200, results
+
+    def get_event(self, req: Request):
+        app_id, channel_id, _ = self._auth(req)
+        event = Storage.get_levents().get(req.path_args[0], app_id, channel_id)
+        if event is None:
+            return 404, {"message": "event not found"}
+        return 200, event.to_api_dict()
+
+    def delete_event(self, req: Request):
+        app_id, channel_id, _ = self._auth(req)
+        found = Storage.get_levents().delete(req.path_args[0], app_id, channel_id)
+        if not found:
+            return 404, {"message": "event not found"}
+        return 200, {"message": "deleted"}
+
+    def find_events(self, req: Request):
+        app_id, channel_id, _ = self._auth(req)
+        p = req.params
+
+        def parse_time(name):
+            v = p.get(name)
+            if v is None:
+                return None
+            try:
+                return _dt.datetime.fromisoformat(v.replace("Z", "+00:00"))
+            except ValueError:
+                raise HTTPError(400, f"cannot parse {name}={v!r}")
+
+        limit = None
+        if "limit" in p:
+            try:
+                limit = int(p["limit"])
+            except ValueError:
+                raise HTTPError(400, f"invalid limit {p['limit']!r}")
+            if limit < -1:
+                raise HTTPError(400, "limit must be >= -1")
+            if limit == -1:
+                limit = None
+        else:
+            limit = 20  # reference default
+        events = Storage.get_levents().find(
+            app_id,
+            channel_id=channel_id,
+            start_time=parse_time("startTime"),
+            until_time=parse_time("untilTime"),
+            entity_type=p.get("entityType"),
+            entity_id=p.get("entityId"),
+            event_names=[p["event"]] if p.get("event") else None,
+            target_entity_type=p.get("targetEntityType"),
+            target_entity_id=p.get("targetEntityId"),
+            limit=limit,
+            reversed_order=p.get("reversed", "true").lower() != "false",
+        )
+        return 200, [e.to_api_dict() for e in events]
+
+    def get_stats(self, req: Request):
+        return 200, self.stats.to_dict()
+
+    def webhook_json(self, req: Request):
+        app_id, channel_id, whitelist = self._auth(req)
+        connector = JSON_CONNECTORS.get(req.path_args[0])
+        if connector is None:
+            return 404, {"message": f"no JSON connector {req.path_args[0]!r}"}
+        if req.body is not None and not isinstance(req.body, dict):
+            return 400, {"message": "webhook payload must be a JSON object"}
+        try:
+            d = connector.to_event_dict(req.body or {})
+            event_id = self._ingest_one(d, app_id, channel_id, whitelist)
+        except (ConnectorError, EventValidationError) as e:
+            return 400, {"message": str(e)}
+        return 201, {"eventId": event_id}
+
+    def webhook_form(self, req: Request):
+        app_id, channel_id, whitelist = self._auth(req)
+        connector = FORM_CONNECTORS.get(req.path_args[0])
+        if connector is None:
+            return 404, {"message": f"no form connector {req.path_args[0]!r}"}
+        form = parse_form(
+            req.raw_body.decode("utf-8", errors="replace")
+            if req.raw_body
+            else ""
+        )
+        try:
+            d = connector.to_event_dict(form)
+            event_id = self._ingest_one(d, app_id, channel_id, whitelist)
+        except (ConnectorError, EventValidationError) as e:
+            return 400, {"message": str(e)}
+        return 201, {"eventId": event_id}
+
+
+def create_event_server(
+    host: str = "0.0.0.0", port: int = 7070
+) -> JsonHTTPServer:
+    """Build (unstarted) server — reference ``EventServer.createEventServer``."""
+    service = EventServerService()
+    return JsonHTTPServer(service.router, host, port, name="pio-tpu-eventserver")

@@ -1,0 +1,163 @@
+"""Minimal threaded HTTP JSON server shared by the Event and Query servers.
+
+The reference runs spray [v0.11] / akka-http [v0.12] actor systems; here a
+stdlib ``ThreadingHTTPServer`` with a route table does the same job with no
+external dependencies. Handlers receive a :class:`Request` and return
+``(status, json_body)``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+log = logging.getLogger("pio_tpu.server")
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    params: Dict[str, str]
+    body: Optional[Any]  # parsed JSON (or raw str for form posts)
+    raw_body: bytes = b""
+    headers: Dict[str, str] = field(default_factory=dict)
+    path_args: Tuple[str, ...] = ()
+
+
+Handler = Callable[[Request], Tuple[int, Any]]
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Router:
+    """Method+regex route table."""
+
+    def __init__(self):
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        self._routes.append((method, re.compile(f"^{pattern}$"), handler))
+
+    def dispatch(self, req: Request) -> Tuple[int, Any]:
+        for method, pattern, handler in self._routes:
+            if method != req.method:
+                continue
+            m = pattern.match(req.path)
+            if m:
+                req.path_args = m.groups()
+                return handler(req)
+        return 404, {"message": f"no route for {req.method} {req.path}"}
+
+
+def _make_handler_class(router: Router, server_name: str):
+    class JsonHandler(BaseHTTPRequestHandler):
+        server_version = server_name
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route to logging, not stderr
+            log.debug("%s %s", self.address_string(), fmt % args)
+
+        def _respond(self, status: int, body: Any):
+            try:
+                payload = json.dumps(body).encode() if body is not None else b""
+            except (TypeError, ValueError):
+                # Un-serializable handler output must still produce an HTTP
+                # response, not a dropped connection.
+                log.exception("response not JSON-serializable")
+                status = 500
+                payload = b'{"message": "response not JSON-serializable"}'
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json; charset=UTF-8")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            if payload:
+                self.wfile.write(payload)
+
+        def _handle(self, method: str):
+            parsed = urlparse(self.path)
+            params = {
+                k: v[0] for k, v in parse_qs(parsed.query).items()
+            }
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            body = None
+            if raw:
+                # Try JSON regardless of Content-Type — real clients (curl
+                # -d without -H) post JSON bodies under the default form
+                # type. Non-JSON bodies stay raw strings; handlers that
+                # need JSON objects reject those with a 400, and the
+                # webhook .form routes read raw_body directly.
+                try:
+                    body = json.loads(raw.decode("utf-8"))
+                except (UnicodeDecodeError, json.JSONDecodeError):
+                    body = raw.decode("utf-8", errors="replace")
+            req = Request(
+                method=method,
+                path=parsed.path,
+                params=params,
+                body=body,
+                raw_body=raw,
+                headers={k: v for k, v in self.headers.items()},
+            )
+            try:
+                status, out = router.dispatch(req)
+            except HTTPError as e:
+                status, out = e.status, {"message": e.message}
+            except Exception:
+                log.exception("unhandled error on %s %s", method, parsed.path)
+                status, out = 500, {"message": "internal server error"}
+            self._respond(status, out)
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_POST(self):
+            self._handle("POST")
+
+        def do_DELETE(self):
+            self._handle("DELETE")
+
+    return JsonHandler
+
+
+class JsonHTTPServer:
+    """Threaded server with programmatic start/stop (tests + CLI)."""
+
+    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0,
+                 name: str = "pio-tpu"):
+        self._httpd = ThreadingHTTPServer(
+            (host, port), _make_handler_class(router, name)
+        )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "JsonHTTPServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._httpd.serve_forever()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,236 @@
+"""Query Server — per-engine HTTP serving daemon (:8000 by default).
+
+Rebuild of the reference's ``core/.../workflow/CreateServer.scala``
+(MasterActor/ServerActor — UNVERIFIED path; see SURVEY.md). Routes:
+
+    GET  /               status (engine, instance, uptime, request counts)
+    POST /queries.json   typed query → serving.serve over all algorithms
+    GET  /stats.json     request count + latency stats
+    POST /reload         hot-swap to the latest COMPLETED engine instance
+    POST /undeploy       stop accepting queries (reference `pio undeploy`)
+
+Queries bind to the algorithm's declared ``query_class`` dataclass (the
+JsonExtractor queryClassTag analog); responses use ``to_dict()`` when the
+prediction provides it. When ``feedback`` is enabled, every response is
+logged back to the event store as a ``predict`` event on entity type
+``pio_pr`` carrying the prId — the reference's feedback loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import logging
+import threading
+import time
+import uuid
+from typing import Any, List, Optional, Tuple
+
+from pio_tpu.controller.engine import Engine, EngineParams
+from pio_tpu.controller.params import ParamsError, params_from_dict
+from pio_tpu.data.event import Event
+from pio_tpu.parallel.context import ComputeContext
+from pio_tpu.server.http import HTTPError, JsonHTTPServer, Request, Router
+from pio_tpu.storage import Storage
+from pio_tpu.workflow.core_workflow import load_models_for_instance
+from pio_tpu.workflow.deploy_common import (
+    resolve_instance_id,
+    resolve_query_class,
+    to_jsonable as _to_jsonable,
+)
+from pio_tpu.workflow.engine_json import EngineVariant, build_engine
+
+log = logging.getLogger("pio_tpu.queryserver")
+
+#: query-path plugin hooks (reference EngineServerPlugin)
+QUERY_BLOCKERS: List = []
+QUERY_SNIFFERS: List = []
+
+
+
+
+class _LatencyStats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.errors = 0
+        self.total_ms = 0.0
+        self.samples: List[float] = []  # bounded reservoir
+
+    def record(self, ms: float, error: bool):
+        with self._lock:
+            self.count += 1
+            if error:
+                self.errors += 1
+            self.total_ms += ms
+            if len(self.samples) < 10000:
+                self.samples.append(ms)
+            else:  # reservoir-ish: overwrite cyclically
+                self.samples[self.count % 10000] = ms
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            xs = sorted(self.samples)
+            q = lambda f: xs[min(int(f * len(xs)), len(xs) - 1)] if xs else None
+            return {
+                "requestCount": self.count,
+                "errorCount": self.errors,
+                "avgMs": self.total_ms / self.count if self.count else None,
+                "p50Ms": q(0.50),
+                "p95Ms": q(0.95),
+                "p99Ms": q(0.99),
+            }
+
+
+class QueryServerService:
+    """The ServerActor analog; MasterActor duties (reload/undeploy) included."""
+
+    def __init__(
+        self,
+        variant: EngineVariant,
+        instance_id: Optional[str] = None,
+        ctx: Optional[ComputeContext] = None,
+        feedback: bool = False,
+        feedback_app_id: Optional[int] = None,
+    ):
+        self.variant = variant
+        self.ctx = ctx or ComputeContext.create()
+        self.feedback = feedback
+        self.feedback_app_id = feedback_app_id
+        self.start_time = _dt.datetime.now(_dt.timezone.utc)
+        self.stats = _LatencyStats()
+        self._swap_lock = threading.Lock()
+        self._deployed = True
+        self._load(instance_id)
+
+        self.router = Router()
+        r = self.router
+        r.add("GET", "/", self.status)
+        r.add("POST", "/queries\\.json", self.query)
+        r.add("GET", "/stats\\.json", self.get_stats)
+        r.add("POST", "/reload", self.reload)
+        r.add("POST", "/undeploy", self.undeploy)
+
+    # -- engine/model lifecycle --------------------------------------------
+    def _load(self, instance_id: Optional[str]) -> None:
+        engine, engine_params = build_engine(self.variant)
+        instance_id = resolve_instance_id(self.variant, instance_id)
+        models = load_models_for_instance(
+            instance_id, engine, engine_params, self.ctx
+        )
+        pairs = engine.algorithms_with_models(engine_params, models)
+        serving = engine.make_serving(engine_params)
+        with self._swap_lock:
+            self.engine, self.engine_params = engine, engine_params
+            self.instance_id = instance_id
+            self.pairs, self.serving = pairs, serving
+        log.info("serving engine instance %s", instance_id)
+
+    # -- handlers -----------------------------------------------------------
+    def status(self, req: Request):
+        return 200, {
+            "status": "deployed" if self._deployed else "undeployed",
+            "engineId": self.variant.engine_id,
+            "engineFactory": self.variant.engine_factory,
+            "engineInstanceId": self.instance_id,
+            "startTime": self.start_time.isoformat(),
+            "requestCount": self.stats.count,
+        }
+
+    def _parse_query(self, body: Any, pairs):
+        if body is None:
+            raise HTTPError(400, "query body required")
+        if not isinstance(body, dict):
+            raise HTTPError(400, "query body must be a JSON object")
+        try:
+            qc = resolve_query_class(pairs)
+        except ValueError as e:
+            raise HTTPError(500, str(e))
+        if qc is None:
+            return body  # raw dict queries
+        try:
+            return params_from_dict(qc, body)
+        except ParamsError as e:
+            raise HTTPError(400, str(e))
+
+    def query(self, req: Request):
+        if not self._deployed:
+            raise HTTPError(503, "undeployed")
+        t0 = time.monotonic()
+        error = True
+        try:
+            # one consistent snapshot — a concurrent /reload must not mix
+            # the old engine's query class with the new engine's models
+            with self._swap_lock:
+                pairs, serving = self.pairs, self.serving
+            query = self._parse_query(req.body, pairs)
+            for blocker in QUERY_BLOCKERS:
+                blocker(req.body)
+            query = serving.supplement(query)
+            predictions = [algo.predict(m, query) for algo, m in pairs]
+            result = serving.serve(query, predictions)
+            out = _to_jsonable(result)
+            pr_id = None
+            if self.feedback:
+                pr_id = uuid.uuid4().hex
+                if isinstance(out, dict):
+                    out = {**out, "prId": pr_id}
+                self._log_feedback(req.body, out, pr_id)
+            for sniffer in QUERY_SNIFFERS:
+                try:
+                    sniffer(req.body, out)
+                except Exception:
+                    log.exception("query sniffer failed")
+            error = False
+            return 200, out
+        finally:
+            self.stats.record((time.monotonic() - t0) * 1e3, error)
+
+    def _log_feedback(self, query_body, result, pr_id: str):
+        """Reference: query server POSTs back to the Event Server with prId;
+        in-process we write straight to the event store."""
+        if self.feedback_app_id is None:
+            return
+        try:
+            Storage.get_levents().insert(
+                Event(
+                    event="predict",
+                    entity_type="pio_pr",
+                    entity_id=pr_id,
+                    properties={"query": query_body, "prediction": result},
+                    pr_id=pr_id,
+                ),
+                self.feedback_app_id,
+            )
+        except Exception:
+            log.exception("feedback logging failed")
+
+    def get_stats(self, req: Request):
+        return 200, self.stats.to_dict()
+
+    def reload(self, req: Request):
+        """Hot-swap to the newest COMPLETED instance (reference /reload)."""
+        self._load(None)
+        return 200, {"engineInstanceId": self.instance_id}
+
+    def undeploy(self, req: Request):
+        self._deployed = False
+        return 200, {"message": "undeployed"}
+
+
+def create_query_server(
+    variant: EngineVariant,
+    host: str = "0.0.0.0",
+    port: int = 8000,
+    instance_id: Optional[str] = None,
+    ctx: Optional[ComputeContext] = None,
+    feedback: bool = False,
+    feedback_app_id: Optional[int] = None,
+) -> Tuple[JsonHTTPServer, QueryServerService]:
+    service = QueryServerService(
+        variant, instance_id, ctx, feedback, feedback_app_id
+    )
+    server = JsonHTTPServer(
+        service.router, host, port, name="pio-tpu-queryserver"
+    )
+    return server, service

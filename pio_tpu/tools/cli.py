@@ -1,0 +1,457 @@
+"""`pio`-equivalent CLI (reference ``tools/.../console/Console.scala``,
+UNVERIFIED path; see SURVEY.md).
+
+Verbs: app, accesskey, train, eval, deploy, undeploy, batchpredict,
+eventserver, import, export, status, version. Unlike the reference there is
+no spark-submit process fork — train runs in-process on the local TPU/mesh.
+
+Usage: ``python -m pio_tpu <verb> ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+import urllib.request
+from typing import Optional
+
+import pio_tpu
+
+
+def _out(s: str = ""):
+    print(s)
+
+
+def _err(s: str) -> int:
+    print(f"[ERROR] {s}", file=sys.stderr)
+    return 1
+
+
+def _storage():
+    from pio_tpu.storage import Storage
+
+    return Storage
+
+
+def _resolve_app(name: str):
+    app = _storage().get_meta_data_apps().get_by_name(name)
+    if app is None:
+        raise SystemExit(_err(f"app {name!r} not found"))
+    return app
+
+
+def _channel_id(app_id: int, channel: Optional[str]):
+    if not channel:
+        return None
+    chans = _storage().get_meta_data_channels().get_by_app_id(app_id)
+    match = [c for c in chans if c.name == channel]
+    if not match:
+        raise SystemExit(_err(f"channel {channel!r} not found"))
+    return match[0].id
+
+
+# ----------------------------------------------------------------- app verbs
+def cmd_app_new(args) -> int:
+    from pio_tpu.storage import AccessKey, App
+
+    apps = _storage().get_meta_data_apps()
+    app_id = apps.insert(App(0, args.name, args.description))
+    if app_id is None:
+        return _err(f"app {args.name!r} already exists")
+    key = _storage().get_meta_data_access_keys().insert(AccessKey("", app_id))
+    _out(f"App created: id={app_id} name={args.name}")
+    _out(f"Access key: {key}")
+    return 0
+
+
+def cmd_app_list(args) -> int:
+    keys = _storage().get_meta_data_access_keys()
+    for app in _storage().get_meta_data_apps().get_all():
+        ks = [k.key for k in keys.get_by_app_id(app.id)]
+        _out(f"id={app.id} name={app.name} accessKeys={','.join(ks) or '-'}")
+    return 0
+
+
+def cmd_app_delete(args) -> int:
+    app = _resolve_app(args.name)
+    store = _storage()
+    for k in store.get_meta_data_access_keys().get_by_app_id(app.id):
+        store.get_meta_data_access_keys().delete(k.key)
+    for c in store.get_meta_data_channels().get_by_app_id(app.id):
+        store.get_meta_data_channels().delete(c.id)
+        _delete_events(app.id, c.id)
+    _delete_events(app.id, None)
+    store.get_meta_data_apps().delete(app.id)
+    _out(f"App {args.name!r} deleted")
+    return 0
+
+
+def _delete_events(app_id, channel_id):
+    from pio_tpu.storage import StorageConfigError
+
+    store = _storage()
+    try:
+        store.get_levents().remove(app_id, channel_id)
+    except StorageConfigError:
+        # bulk-only backend (parquet) has no LEvents; delete via PEvents
+        pe = store.get_pevents()
+        ids = [e.event_id for e in pe.find(app_id, channel_id=channel_id)]
+        if ids:
+            pe.delete(ids, app_id, channel_id)
+
+
+def cmd_app_data_delete(args) -> int:
+    app = _resolve_app(args.name)
+    _delete_events(app.id, _channel_id(app.id, args.channel))
+    _out(f"Event data deleted for app {args.name!r}"
+         + (f" channel {args.channel!r}" if args.channel else ""))
+    return 0
+
+
+def cmd_channel_new(args) -> int:
+    from pio_tpu.storage import Channel
+
+    app = _resolve_app(args.app)
+    cid = _storage().get_meta_data_channels().insert(
+        Channel(0, args.channel, app.id)
+    )
+    if cid is None:
+        return _err(
+            f"cannot create channel {args.channel!r} ({Channel.NAME_CONSTRAINT})"
+        )
+    _out(f"Channel created: id={cid} name={args.channel} app={args.app}")
+    return 0
+
+
+def cmd_channel_delete(args) -> int:
+    app = _resolve_app(args.app)
+    cid = _channel_id(app.id, args.channel)
+    _delete_events(app.id, cid)
+    _storage().get_meta_data_channels().delete(cid)
+    _out(f"Channel {args.channel!r} deleted")
+    return 0
+
+
+# ----------------------------------------------------------- accesskey verbs
+def cmd_accesskey_new(args) -> int:
+    from pio_tpu.storage import AccessKey
+
+    app = _resolve_app(args.app)
+    events = tuple(e for e in (args.events or "").split(",") if e)
+    key = _storage().get_meta_data_access_keys().insert(
+        AccessKey("", app.id, events)
+    )
+    _out(f"Access key: {key}")
+    return 0
+
+
+def cmd_accesskey_list(args) -> int:
+    keys = _storage().get_meta_data_access_keys()
+    items = (
+        keys.get_by_app_id(_resolve_app(args.app).id) if args.app else keys.get_all()
+    )
+    for k in items:
+        _out(f"key={k.key} appId={k.app_id} events={','.join(k.events) or '(all)'}")
+    return 0
+
+
+def cmd_accesskey_delete(args) -> int:
+    if not _storage().get_meta_data_access_keys().delete(args.key):
+        return _err("key not found")
+    _out("Access key deleted")
+    return 0
+
+
+# -------------------------------------------------------------- train / eval
+def _load_variant(path: str):
+    from pio_tpu.workflow import load_variant
+
+    return load_variant(path)
+
+
+def cmd_train(args) -> int:
+    from pio_tpu.parallel.context import ComputeContext
+    from pio_tpu.workflow import WorkflowParams, build_engine, run_train
+
+    variant = _load_variant(args.engine_json)
+    engine, ep = build_engine(variant)
+    wp = WorkflowParams(
+        batch=args.batch,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+        seed=args.seed,
+    )
+    ctx = ComputeContext.create(seed=args.seed)
+    instance_id = run_train(engine, ep, variant, wp, ctx=ctx)
+    _out(f"Training completed: engine instance {instance_id}")
+    return 0
+
+
+def _import_attr(spec: str):
+    mod_name, _, attr = spec.partition(":")
+    mod = importlib.import_module(mod_name)
+    if not attr:
+        return mod
+    obj = getattr(mod, attr)
+    return obj() if callable(obj) else obj
+
+
+def cmd_eval(args) -> int:
+    from pio_tpu.parallel.context import ComputeContext
+    from pio_tpu.workflow import run_evaluation
+
+    evaluation = _import_attr(args.evaluation)
+    generator = (
+        _import_attr(args.engine_params_generator)
+        if args.engine_params_generator
+        else None
+    )
+    if generator is None:
+        generator = getattr(evaluation, "engine_params_generator", None)
+    if generator is None:
+        return _err(
+            "no EngineParamsGenerator: pass --engine-params-generator or set "
+            ".engine_params_generator on the Evaluation"
+        )
+    result = run_evaluation(
+        evaluation,
+        generator,
+        ctx=ComputeContext.create(),
+        evaluation_class=args.evaluation,
+        generator_class=args.engine_params_generator or "",
+    )
+    _out(f"Best params (score {result.best_score}):")
+    _out(result.to_json())
+    return 0
+
+
+# ------------------------------------------------------------------- servers
+def cmd_eventserver(args) -> int:
+    from pio_tpu.server import create_event_server
+
+    server = create_event_server(host=args.ip, port=args.port)
+    _out(f"Event Server listening on {args.ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("shutting down")
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from pio_tpu.server import create_query_server
+
+    variant = _load_variant(args.engine_json)
+    feedback_app_id = None
+    if args.feedback_app:
+        feedback_app_id = _resolve_app(args.feedback_app).id
+    server, service = create_query_server(
+        variant,
+        host=args.ip,
+        port=args.port,
+        instance_id=args.engine_instance_id,
+        feedback=bool(args.feedback_app),
+        feedback_app_id=feedback_app_id,
+    )
+    _out(
+        f"Query Server for instance {service.instance_id} "
+        f"listening on {args.ip}:{server.port}"
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("shutting down")
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    url = f"http://{args.ip}:{args.port}/undeploy"
+    try:
+        req = urllib.request.Request(url, data=b"{}", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            _out(resp.read().decode())
+        return 0
+    except OSError as e:
+        return _err(f"cannot reach query server at {url}: {e}")
+
+
+def cmd_batchpredict(args) -> int:
+    from pio_tpu.workflow.batch_predict import run_batch_predict
+
+    variant = _load_variant(args.engine_json)
+    n = run_batch_predict(
+        variant,
+        args.input,
+        args.output,
+        instance_id=args.engine_instance_id,
+    )
+    _out(f"Batch predict done: {n} queries -> {args.output}")
+    return 0
+
+
+# ------------------------------------------------------------- import/export
+def cmd_import(args) -> int:
+    from pio_tpu.tools.data_io import import_events
+
+    app = _resolve_app(args.app)
+    imported, failed = import_events(
+        args.input, app.id, _channel_id(app.id, args.channel)
+    )
+    _out(f"Imported {imported} events ({failed} failed)")
+    return 0 if failed == 0 else 1
+
+
+def cmd_export(args) -> int:
+    from pio_tpu.tools.data_io import export_events
+
+    app = _resolve_app(args.app)
+    n = export_events(args.output, app.id, _channel_id(app.id, args.channel))
+    _out(f"Exported {n} events -> {args.output}")
+    return 0
+
+
+# -------------------------------------------------------------------- status
+def cmd_status(args) -> int:
+    import jax
+
+    from pio_tpu.storage import pio_home
+
+    _out(f"pio-tpu {pio_tpu.__version__}")
+    _out(f"home: {pio_home()}")
+    try:
+        devices = jax.devices()
+        _out(f"devices: {[str(d) for d in devices]}")
+    except Exception as e:
+        _out(f"devices: unavailable ({e})")
+    checks = _storage().verify_all_data_objects()
+    ok = all(checks.values())
+    for name, healthy in sorted(checks.items()):
+        _out(f"  {'OK ' if healthy else 'FAIL'} {name}")
+    _out("(sanity check " + ("passed)" if ok else "FAILED)"))
+    return 0 if ok else 1
+
+
+def cmd_version(args) -> int:
+    _out(pio_tpu.__version__)
+    return 0
+
+
+# -------------------------------------------------------------------- parser
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio-tpu", description="TPU-native ML server CLI"
+    )
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    app = sub.add_parser("app", help="manage apps").add_subparsers(
+        dest="app_verb", required=True
+    )
+    a = app.add_parser("new")
+    a.add_argument("name")
+    a.add_argument("--description", default=None)
+    a.set_defaults(fn=cmd_app_new)
+    app.add_parser("list").set_defaults(fn=cmd_app_list)
+    a = app.add_parser("delete")
+    a.add_argument("name")
+    a.set_defaults(fn=cmd_app_delete)
+    a = app.add_parser("data-delete")
+    a.add_argument("name")
+    a.add_argument("--channel", default=None)
+    a.set_defaults(fn=cmd_app_data_delete)
+    a = app.add_parser("channel-new")
+    a.add_argument("app")
+    a.add_argument("channel")
+    a.set_defaults(fn=cmd_channel_new)
+    a = app.add_parser("channel-delete")
+    a.add_argument("app")
+    a.add_argument("channel")
+    a.set_defaults(fn=cmd_channel_delete)
+
+    ak = sub.add_parser("accesskey", help="manage access keys").add_subparsers(
+        dest="ak_verb", required=True
+    )
+    a = ak.add_parser("new")
+    a.add_argument("app")
+    a.add_argument("--events", default="")
+    a.set_defaults(fn=cmd_accesskey_new)
+    a = ak.add_parser("list")
+    a.add_argument("app", nargs="?")
+    a.set_defaults(fn=cmd_accesskey_list)
+    a = ak.add_parser("delete")
+    a.add_argument("key")
+    a.set_defaults(fn=cmd_accesskey_delete)
+
+    a = sub.add_parser("train", help="run a training workflow")
+    a.add_argument("--engine-json", default="engine.json")
+    a.add_argument("--batch", default="")
+    a.add_argument("--skip-sanity-check", action="store_true")
+    a.add_argument("--stop-after-read", action="store_true")
+    a.add_argument("--stop-after-prepare", action="store_true")
+    a.add_argument("--seed", type=int, default=0)
+    a.set_defaults(fn=cmd_train)
+
+    a = sub.add_parser("eval", help="run an evaluation sweep")
+    a.add_argument("evaluation", help="module:attr returning an Evaluation")
+    a.add_argument(
+        "engine_params_generator", nargs="?", default=None,
+        help="module:attr returning an EngineParamsGenerator",
+    )
+    a.set_defaults(fn=cmd_eval)
+
+    a = sub.add_parser("deploy", help="serve the trained engine over HTTP")
+    a.add_argument("--engine-json", default="engine.json")
+    a.add_argument("--ip", default="0.0.0.0")
+    a.add_argument("--port", type=int, default=8000)
+    a.add_argument("--engine-instance-id", default=None)
+    a.add_argument(
+        "--feedback-app", default=None,
+        help="app name to log prediction feedback events into",
+    )
+    a.set_defaults(fn=cmd_deploy)
+
+    a = sub.add_parser("undeploy", help="stop a running query server")
+    a.add_argument("--ip", default="127.0.0.1")
+    a.add_argument("--port", type=int, default=8000)
+    a.set_defaults(fn=cmd_undeploy)
+
+    a = sub.add_parser("batchpredict", help="bulk offline scoring")
+    a.add_argument("--engine-json", default="engine.json")
+    a.add_argument("--input", required=True)
+    a.add_argument("--output", required=True)
+    a.add_argument("--engine-instance-id", default=None)
+    a.set_defaults(fn=cmd_batchpredict)
+
+    a = sub.add_parser("eventserver", help="run the event ingestion server")
+    a.add_argument("--ip", default="0.0.0.0")
+    a.add_argument("--port", type=int, default=7070)
+    a.set_defaults(fn=cmd_eventserver)
+
+    a = sub.add_parser("import", help="import JSON-lines events")
+    a.add_argument("--app", required=True)
+    a.add_argument("--input", required=True)
+    a.add_argument("--channel", default=None)
+    a.set_defaults(fn=cmd_import)
+
+    a = sub.add_parser("export", help="export events as JSON-lines")
+    a.add_argument("--app", required=True)
+    a.add_argument("--output", required=True)
+    a.add_argument("--channel", default=None)
+    a.set_defaults(fn=cmd_export)
+
+    sub.add_parser("status", help="storage/device health check").set_defaults(
+        fn=cmd_status
+    )
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

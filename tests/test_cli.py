@@ -1,0 +1,158 @@
+"""CLI tests (reference console arg-parsing tier + quickstart flow pieces).
+
+Run commands in-process via main(argv) against isolated storage.
+"""
+
+import datetime as dt
+import json
+
+import pytest
+
+import pio_tpu.templates  # noqa: F401
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.storage import Storage
+from pio_tpu.tools.cli import main
+
+
+@pytest.fixture(autouse=True)
+def isolated(tmp_home):
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def run(capsys, *argv):
+    code = main(list(argv))
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+class TestAppVerbs:
+    def test_app_lifecycle(self, capsys):
+        code, out, _ = run(capsys, "app", "new", "shop")
+        assert code == 0 and "Access key:" in out
+        key = out.split("Access key:")[1].strip()
+
+        code, out, _ = run(capsys, "app", "list")
+        assert "name=shop" in out and key in out
+
+        code, out, _ = run(capsys, "accesskey", "new", "shop", "--events", "rate,buy")
+        assert code == 0
+
+        code, out, _ = run(capsys, "accesskey", "list", "shop")
+        assert out.count("key=") == 2 and "events=rate,buy" in out
+
+        code, out, _ = run(capsys, "app", "channel-new", "shop", "mobile")
+        assert code == 0
+
+        code, out, err = run(capsys, "app", "channel-new", "shop", "bad name")
+        assert code == 1 and "channel" in err
+
+        code, _, _ = run(capsys, "app", "delete", "shop")
+        assert code == 0
+        code, out, _ = run(capsys, "app", "list")
+        assert "shop" not in out
+
+    def test_duplicate_app(self, capsys):
+        run(capsys, "app", "new", "shop")
+        code, _, err = run(capsys, "app", "new", "shop")
+        assert code == 1 and "already exists" in err
+
+    def test_data_delete(self, capsys):
+        run(capsys, "app", "new", "shop")
+        app = Storage.get_meta_data_apps().get_by_name("shop")
+        Storage.get_levents().insert(Event("rate", "user", "u1"), app.id)
+        assert len(Storage.get_levents().find(app.id)) == 1
+        code, _, _ = run(capsys, "app", "data-delete", "shop")
+        assert code == 0
+        assert Storage.get_levents().find(app.id) == []
+
+
+class TestStatusVersion:
+    def test_version(self, capsys):
+        code, out, _ = run(capsys, "version")
+        assert code == 0 and out.strip()
+
+    def test_status(self, capsys):
+        code, out, _ = run(capsys, "status")
+        assert code == 0
+        assert "sanity check passed" in out
+        assert out.count("OK ") >= 7
+
+
+class TestTrainDeployFlow:
+    def _seed(self, capsys, tmp_path):
+        run(capsys, "app", "new", "cli-test")
+        app = Storage.get_meta_data_apps().get_by_name("cli-test")
+        lines = []
+        t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+        for u in range(8):
+            for i in range(6):
+                rating = 5.0 if (u < 4) == (i < 3) else 1.0
+                lines.append(json.dumps({
+                    "event": "rate", "entityType": "user", "entityId": f"u{u}",
+                    "targetEntityType": "item", "targetEntityId": f"i{i}",
+                    "properties": {"rating": rating},
+                    "eventTime": t0.isoformat(),
+                }))
+        events_file = tmp_path / "events.jsonl"
+        events_file.write_text("\n".join(lines) + "\nnot json\n")
+        engine_json = tmp_path / "engine.json"
+        engine_json.write_text(json.dumps({
+            "id": "cli-rec",
+            "engineFactory": "templates.recommendation",
+            "datasource": {"params": {"app_name": "cli-test"}},
+            "algorithms": [{"name": "als", "params":
+                            {"rank": 4, "num_iterations": 6, "lambda_": 0.1}}],
+        }))
+        return app, events_file, engine_json
+
+    def test_import_train_batchpredict_export(self, capsys, tmp_path):
+        app, events_file, engine_json = self._seed(capsys, tmp_path)
+
+        code, out, _ = run(capsys, "import", "--app", "cli-test",
+                           "--input", str(events_file))
+        assert code == 1  # one bad line
+        assert "Imported 48 events (1 failed)" in out
+
+        code, out, _ = run(capsys, "train", "--engine-json", str(engine_json))
+        assert code == 0 and "Training completed" in out
+
+        queries = tmp_path / "queries.jsonl"
+        queries.write_text(
+            json.dumps({"user": "u1", "num": 2}) + "\n"
+            + json.dumps({"user": "ghost"}) + "\n"
+            + "{bad json\n"
+        )
+        out_file = tmp_path / "preds.jsonl"
+        code, out, _ = run(
+            capsys, "batchpredict", "--engine-json", str(engine_json),
+            "--input", str(queries), "--output", str(out_file),
+        )
+        assert code == 0 and "2 queries" in out
+        lines = [json.loads(l) for l in out_file.read_text().splitlines()]
+        assert len(lines[0]["prediction"]["itemScores"]) == 2
+        assert lines[1]["prediction"]["itemScores"] == []
+        assert "error" in lines[2]
+
+        export_file = tmp_path / "export.jsonl"
+        code, out, _ = run(capsys, "export", "--app", "cli-test",
+                           "--output", str(export_file))
+        assert code == 0 and "Exported 48" in out
+        assert len(export_file.read_text().splitlines()) == 48
+
+    def test_train_stop_after_read(self, capsys, tmp_path):
+        app, events_file, engine_json = self._seed(capsys, tmp_path)
+        run(capsys, "import", "--app", "cli-test", "--input", str(events_file))
+        code, out, _ = run(capsys, "train", "--engine-json", str(engine_json),
+                           "--stop-after-read")
+        assert code == 0
+
+    def test_train_missing_engine_json(self, capsys):
+        with pytest.raises(Exception):
+            run(capsys, "train", "--engine-json", "/nope/engine.json")
+
+    def test_undeploy_unreachable(self, capsys):
+        code, _, err = run(capsys, "undeploy", "--port", "59999")
+        assert code == 1 and "cannot reach" in err

@@ -1,0 +1,310 @@
+"""Server route tests over real HTTP on ephemeral ports (reference
+EventServiceSpec / CreateServer tests, SURVEY.md §4). Memory storage
+backend; recommendation engine for the query server."""
+
+import datetime as dt
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pio_tpu.templates  # noqa: F401
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.server import create_event_server, create_query_server
+from pio_tpu.storage import AccessKey, App, Channel, Storage
+from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+
+@pytest.fixture(autouse=True)
+def mem_storage(tmp_home, monkeypatch):
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "MEM")
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def http(method, url, body=None, headers=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+@pytest.fixture()
+def eventserver():
+    server = create_event_server(host="127.0.0.1", port=0).start()
+    yield f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+@pytest.fixture()
+def app_and_key():
+    app_id = Storage.get_meta_data_apps().insert(App(0, "srv-test"))
+    key = Storage.get_meta_data_access_keys().insert(AccessKey("", app_id))
+    return app_id, key
+
+
+EV = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 4.5},
+    "eventTime": "2026-03-01T10:00:00Z",
+}
+
+
+class TestEventServer:
+    def test_alive(self, eventserver):
+        assert http("GET", f"{eventserver}/")[1] == {"status": "alive"}
+
+    def test_ingest_and_get(self, eventserver, app_and_key):
+        app_id, key = app_and_key
+        status, body = http("POST", f"{eventserver}/events.json?accessKey={key}", EV)
+        assert status == 201 and "eventId" in body
+        eid = body["eventId"]
+        status, got = http(
+            "GET", f"{eventserver}/events/{eid}.json?accessKey={key}"
+        )
+        assert status == 200
+        assert got["event"] == "rate" and got["properties"]["rating"] == 4.5
+        # visible in storage
+        assert len(Storage.get_levents().find(app_id)) == 1
+        # delete
+        assert http("DELETE", f"{eventserver}/events/{eid}.json?accessKey={key}")[0] == 200
+        assert http("GET", f"{eventserver}/events/{eid}.json?accessKey={key}")[0] == 404
+
+    def test_auth_failures(self, eventserver, app_and_key):
+        _, key = app_and_key
+        assert http("POST", f"{eventserver}/events.json", EV)[0] == 401
+        assert http("POST", f"{eventserver}/events.json?accessKey=WRONG", EV)[0] == 401
+        # Authorization header works
+        status, _ = http(
+            "POST", f"{eventserver}/events.json", EV,
+            headers={"Authorization": f"Bearer {key}"},
+        )
+        assert status == 201
+
+    def test_event_whitelist(self, eventserver, app_and_key):
+        app_id, _ = app_and_key
+        limited = Storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ("view",))
+        )
+        assert http("POST", f"{eventserver}/events.json?accessKey={limited}", EV)[0] == 403
+
+    def test_malformed_events(self, eventserver, app_and_key):
+        _, key = app_and_key
+        url = f"{eventserver}/events.json?accessKey={key}"
+        bad = dict(EV)
+        del bad["entityId"]
+        assert http("POST", url, bad)[0] == 400
+        assert http("POST", url, {**EV, "event": "$badname"})[0] == 400
+        assert http("POST", url, {**EV, "eventTime": "yesterday"})[0] == 400
+
+    def test_channels(self, eventserver, app_and_key):
+        app_id, key = app_and_key
+        Storage.get_meta_data_channels().insert(Channel(0, "mobile", app_id))
+        url = f"{eventserver}/events.json?accessKey={key}&channel=mobile"
+        assert http("POST", url, EV)[0] == 201
+        assert http("POST", f"{eventserver}/events.json?accessKey={key}&channel=nope", EV)[0] == 400
+        # channel isolation
+        _, default_events = http("GET", f"{eventserver}/events.json?accessKey={key}")
+        assert default_events == []
+        _, chan_events = http("GET", url)
+        assert len(chan_events) == 1
+
+    def test_batch_partial_failure(self, eventserver, app_and_key):
+        _, key = app_and_key
+        batch = [EV, {"event": "rate"}, {**EV, "entityId": "u2"}]
+        status, results = http(
+            "POST", f"{eventserver}/batch/events.json?accessKey={key}", batch
+        )
+        assert status == 200
+        assert [r["status"] for r in results] == [201, 400, 201]
+        assert "message" in results[1]
+
+    def test_batch_too_large(self, eventserver, app_and_key):
+        _, key = app_and_key
+        status, body = http(
+            "POST", f"{eventserver}/batch/events.json?accessKey={key}", [EV] * 51
+        )
+        assert status == 400 and "exceeds" in body["message"]
+
+    def test_find_filters_and_limit(self, eventserver, app_and_key):
+        _, key = app_and_key
+        url = f"{eventserver}/events.json?accessKey={key}"
+        for i in range(5):
+            http("POST", url, {
+                **EV, "entityId": f"u{i%2}",
+                "eventTime": f"2026-03-0{i+1}T10:00:00Z",
+            })
+        _, out = http("GET", f"{url}&limit=3")
+        assert len(out) == 3
+        # reversed by default: newest first
+        assert out[0]["eventTime"] > out[-1]["eventTime"]
+        _, out = http("GET", f"{url}&entityId=u1&limit=-1&reversed=false")
+        assert len(out) == 2
+        assert out[0]["eventTime"] < out[1]["eventTime"]
+        _, out = http("GET", f"{url}&startTime=2026-03-03T00:00:00Z")
+        assert len(out) == 3
+        assert http("GET", f"{url}&startTime=nope")[0] == 400
+
+    def test_stats(self, eventserver, app_and_key):
+        app_id, key = app_and_key
+        http("POST", f"{eventserver}/events.json?accessKey={key}", EV)
+        http("POST", f"{eventserver}/events.json?accessKey={key}", {"event": "x"})
+        _, stats = http("GET", f"{eventserver}/stats.json")
+        counts = stats["apps"][0]["counts"]
+        assert {"event": "rate", "entityType": "user", "status": 201, "count": 1} in counts
+        assert any(c["status"] == 400 for c in counts)
+
+    def test_webhook_json(self, eventserver, app_and_key):
+        app_id, key = app_and_key
+        payload = {
+            "type": "track", "event": "signup", "userId": "u42",
+            "properties": {"plan": "pro"},
+        }
+        status, body = http(
+            "POST", f"{eventserver}/webhooks/segmentio.json?accessKey={key}", payload
+        )
+        assert status == 201
+        evs = Storage.get_levents().find(app_id)
+        assert evs[0].event == "signup" and evs[0].entity_id == "u42"
+        assert http(
+            "POST", f"{eventserver}/webhooks/nope.json?accessKey={key}", payload
+        )[0] == 404
+        assert http(
+            "POST", f"{eventserver}/webhooks/segmentio.json?accessKey={key}",
+            {"type": "weird"},
+        )[0] == 400
+
+    def test_webhook_form(self, eventserver, app_and_key):
+        app_id, key = app_and_key
+        form = "type=subscribe&fired_at=2026-03-01 10:00:00&data[email]=a@b.c&data[plan]=free"
+        req = urllib.request.Request(
+            f"{eventserver}/webhooks/mailchimp.form?accessKey={key}",
+            data=form.encode(),
+            method="POST",
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 201
+        evs = Storage.get_levents().find(app_id)
+        assert evs[0].event == "subscribe" and evs[0].entity_id == "a@b.c"
+        assert evs[0].properties.get("plan", str) == "free"
+
+
+# ------------------------------------------------------------- query server
+VARIANT = {
+    "id": "rec-srv",
+    "engineFactory": "templates.recommendation",
+    "datasource": {"params": {"app_name": "srv-test"}},
+    "algorithms": [
+        {"name": "als", "params": {"rank": 4, "num_iterations": 6, "lambda_": 0.1}}
+    ],
+}
+
+
+def _train(app_id):
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    for u in range(8):
+        for i in range(6):
+            in_block = (u < 4) == (i < 3)
+            le.insert(
+                Event("rate", "user", f"u{u}", "item", f"i{i}",
+                      properties={"rating": 5.0 if in_block else 1.0},
+                      event_time=t0),
+                app_id,
+            )
+    variant = variant_from_dict(VARIANT)
+    engine, ep = build_engine(variant)
+    ctx = ComputeContext.local()
+    iid = run_train(engine, ep, variant, ctx=ctx)
+    return variant, ctx, iid
+
+
+@pytest.fixture()
+def queryserver(app_and_key):
+    app_id, _ = app_and_key
+    variant, ctx, iid = _train(app_id)
+    server, service = create_query_server(
+        variant, host="127.0.0.1", port=0, ctx=ctx,
+        feedback=True, feedback_app_id=app_id,
+    )
+    server.start()
+    yield f"http://127.0.0.1:{server.port}", service, app_id
+    server.stop()
+
+
+class TestQueryServer:
+    def test_status_page(self, queryserver):
+        url, service, _ = queryserver
+        status, body = http("GET", f"{url}/")
+        assert status == 200
+        assert body["status"] == "deployed"
+        assert body["engineFactory"] == "templates.recommendation"
+        assert body["engineInstanceId"] == service.instance_id
+
+    def test_query_roundtrip(self, queryserver):
+        url, _, _ = queryserver
+        status, body = http("POST", f"{url}/queries.json", {"user": "u1", "num": 3})
+        assert status == 200
+        assert len(body["itemScores"]) == 3
+        items = {s["item"] for s in body["itemScores"]}
+        assert items <= {"i0", "i1", "i2"}  # u1's block
+        assert "prId" in body  # feedback enabled
+
+    def test_feedback_logged(self, queryserver):
+        url, _, app_id = queryserver
+        _, body = http("POST", f"{url}/queries.json", {"user": "u1"})
+        evs = Storage.get_levents().find(app_id, entity_type="pio_pr")
+        assert len(evs) == 1
+        assert evs[0].pr_id == body["prId"]
+        assert evs[0].properties.get("prediction", dict)["prId"] == body["prId"]
+
+    def test_bad_query(self, queryserver):
+        url, _, _ = queryserver
+        status, body = http("POST", f"{url}/queries.json", {"uzer": "u1"})
+        assert status == 400 and "unknown params" in body["message"]
+        assert http("POST", f"{url}/queries.json")[0] == 400
+
+    def test_stats_latency(self, queryserver):
+        url, _, _ = queryserver
+        for _ in range(3):
+            http("POST", f"{url}/queries.json", {"user": "u1"})
+        _, stats = http("GET", f"{url}/stats.json")
+        assert stats["requestCount"] >= 3
+        assert stats["p50Ms"] is not None and stats["p50Ms"] < 1000
+
+    def test_reload_hot_swap(self, queryserver):
+        url, service, app_id = queryserver
+        old_iid = service.instance_id
+        variant, ctx, new_iid = _train(app_id)  # second training run
+        status, body = http("POST", f"{url}/reload", {})
+        assert status == 200
+        assert body["engineInstanceId"] == new_iid != old_iid
+        # still serving
+        assert http("POST", f"{url}/queries.json", {"user": "u1"})[0] == 200
+
+    def test_undeploy(self, queryserver):
+        url, _, _ = queryserver
+        assert http("POST", f"{url}/undeploy", {})[0] == 200
+        assert http("POST", f"{url}/queries.json", {"user": "u1"})[0] == 503
+
+    def test_no_trained_instance_errors(self, app_and_key):
+        variant = variant_from_dict({**VARIANT, "id": "never-trained"})
+        with pytest.raises(RuntimeError, match="no COMPLETED engine instance"):
+            create_query_server(variant, host="127.0.0.1", port=0)
