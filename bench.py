@@ -1,0 +1,100 @@
+"""Benchmark harness — headline metric from BASELINE.json.
+
+Metric: examples/sec/chip on the Recommendation (ALS) template at
+MovieLens-25M scale (25M ratings, 162,541 users, 59,047 items). One
+"example" = one rating edge processed through one full ALS iteration
+(both half-steps). The reference publishes no numbers (BASELINE.md), so
+``vs_baseline`` is measured against our own single-host XLA-CPU run of the
+same program — the "Spark-free CPU ALS reference anchor" from SURVEY.md §6.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Env knobs (for smoke runs): PIO_TPU_BENCH_EDGES, PIO_TPU_BENCH_ITERS,
+PIO_TPU_BENCH_RANK, PIO_TPU_BENCH_CPU_EDGES.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# MovieLens-25M shape (ratings, users, movies)
+ML25M_EDGES = 25_000_000
+ML25M_USERS = 162_541
+ML25M_ITEMS = 59_047
+
+
+def _synth_ratings(n_edges: int, n_users: int, n_items: int, seed: int = 0):
+    """Synthetic MovieLens-like COO ratings (zipf-ish item popularity)."""
+    rng = np.random.default_rng(seed)
+    user_idx = rng.integers(0, n_users, size=n_edges).astype(np.int32)
+    # popularity-skewed items: square a uniform to bias toward low ids
+    item_idx = (rng.random(n_edges) ** 2 * n_items).astype(np.int32)
+    rating = (rng.integers(1, 11, size=n_edges) * 0.5).astype(np.float32)
+    return user_idx, item_idx, rating
+
+
+def _time_train(ctx, u, i, r, n_users, n_items, cfg):
+    """Train twice: first call pays compile, second is the timed run."""
+    from pio_tpu.models.als import train_als
+
+    train_als(ctx, u, i, r, n_users, n_items, cfg)  # warmup/compile
+    t0 = time.perf_counter()
+    train_als(ctx, u, i, r, n_users, n_items, cfg)
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    import jax
+
+    from pio_tpu.models.als import ALSConfig
+    from pio_tpu.parallel.context import ComputeContext, default_mesh
+
+    n_edges = int(os.environ.get("PIO_TPU_BENCH_EDGES", ML25M_EDGES))
+    scale = n_edges / ML25M_EDGES
+    n_users = max(64, int(ML25M_USERS * min(scale, 1.0)))
+    n_items = max(64, int(ML25M_ITEMS * min(scale, 1.0)))
+    iters = int(os.environ.get("PIO_TPU_BENCH_ITERS", 3))
+    rank = int(os.environ.get("PIO_TPU_BENCH_RANK", 16))
+    cfg = ALSConfig(rank=rank, iterations=iters, reg=0.1)
+
+    u, i, r = _synth_ratings(n_edges, n_users, n_items)
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    ctx = ComputeContext(mesh=default_mesh(("data",), devices=devices))
+    dt = _time_train(ctx, u, i, r, n_users, n_items, cfg)
+    rate_per_chip = n_edges * iters / dt / n_chips
+
+    # CPU anchor: same XLA program, single host CPU device, subsampled edges.
+    cpu_edges = int(os.environ.get("PIO_TPU_BENCH_CPU_EDGES",
+                                   min(n_edges, 2_000_000)))
+    cpu_rate = None
+    try:
+        cpu_dev = jax.devices("cpu")[0]
+        sub = slice(0, cpu_edges)
+        cpu_cfg = ALSConfig(rank=rank, iterations=1, reg=0.1)
+        with jax.default_device(cpu_dev):
+            cpu_ctx = ComputeContext(mesh=None)
+            cpu_dt = _time_train(cpu_ctx, u[sub], i[sub], r[sub],
+                                 n_users, n_items, cpu_cfg)
+        cpu_rate = cpu_edges * 1 / cpu_dt
+    except Exception as exc:  # pragma: no cover - CPU backend always present
+        print(f"# cpu anchor failed: {exc}", file=sys.stderr)
+
+    vs_baseline = rate_per_chip / cpu_rate if cpu_rate else 1.0
+    print(json.dumps({
+        "metric": "ALS@MovieLens-25M examples/sec/chip",
+        "value": round(rate_per_chip, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

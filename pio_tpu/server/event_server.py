@@ -100,9 +100,7 @@ class EventServerService:
     # -- auth ---------------------------------------------------------------
     def _auth(self, req: Request) -> Tuple[int, Optional[int], tuple]:
         """accessKey+channel → (app_id, channel_id, event_whitelist)."""
-        key = req.params.get("accessKey") or req.headers.get("Authorization", "")
-        if key.startswith("Bearer "):
-            key = key[len("Bearer "):]
+        key = req.bearer_key()
         if not key:
             raise HTTPError(401, "missing accessKey")
         ak = Storage.get_meta_data_access_keys().get(key)

@@ -27,8 +27,20 @@ class Request:
     params: Dict[str, str]
     body: Optional[Any]  # parsed JSON (or raw str for form posts)
     raw_body: bytes = b""
+    #: header names lowercased (HTTP/2-origin clients send lowercase)
     headers: Dict[str, str] = field(default_factory=dict)
     path_args: Tuple[str, ...] = ()
+    client_addr: str = ""
+
+    def header(self, name: str, default: Optional[str] = None):
+        return self.headers.get(name.lower(), default)
+
+    def bearer_key(self) -> str:
+        """Access key from ?accessKey= or the Authorization header."""
+        key = self.params.get("accessKey") or self.header("Authorization", "")
+        if key.startswith("Bearer "):
+            key = key[len("Bearer "):]
+        return key
 
 
 Handler = Callable[[Request], Tuple[int, Any]]
@@ -90,6 +102,12 @@ def _make_handler_class(router: Router, server_name: str):
             params = {
                 k: v[0] for k, v in parse_qs(parsed.query).items()
             }
+            if self.headers.get("Transfer-Encoding"):
+                # Chunked bodies aren't framed by Content-Length; reading them
+                # naively corrupts keep-alive framing. Reject and close.
+                self.close_connection = True
+                self._respond(411, {"message": "Content-Length required"})
+                return
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length) if length else b""
             body = None
@@ -109,7 +127,8 @@ def _make_handler_class(router: Router, server_name: str):
                 params=params,
                 body=body,
                 raw_body=raw,
-                headers={k: v for k, v in self.headers.items()},
+                headers={k.lower(): v for k, v in self.headers.items()},
+                client_addr=self.client_address[0],
             )
             try:
                 status, out = router.dispatch(req)

@@ -92,11 +92,15 @@ class QueryServerService:
         ctx: Optional[ComputeContext] = None,
         feedback: bool = False,
         feedback_app_id: Optional[int] = None,
+        admin_key: Optional[str] = None,
     ):
         self.variant = variant
         self.ctx = ctx or ComputeContext.create()
         self.feedback = feedback
         self.feedback_app_id = feedback_app_id
+        #: guards /reload and /undeploy; without a key only loopback clients
+        #: may call them (the default bind is 0.0.0.0)
+        self.admin_key = admin_key
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
         self.stats = _LatencyStats()
         self._swap_lock = threading.Lock()
@@ -120,10 +124,14 @@ class QueryServerService:
         )
         pairs = engine.algorithms_with_models(engine_params, models)
         serving = engine.make_serving(engine_params)
+        # resolve once at load — a conflicting query-class config should fail
+        # deploy/reload, not the first query
+        query_class = resolve_query_class(pairs)
         with self._swap_lock:
             self.engine, self.engine_params = engine, engine_params
             self.instance_id = instance_id
             self.pairs, self.serving = pairs, serving
+            self.query_class = query_class
         log.info("serving engine instance %s", instance_id)
 
     # -- handlers -----------------------------------------------------------
@@ -137,15 +145,11 @@ class QueryServerService:
             "requestCount": self.stats.count,
         }
 
-    def _parse_query(self, body: Any, pairs):
+    def _parse_query(self, body: Any, qc):
         if body is None:
             raise HTTPError(400, "query body required")
         if not isinstance(body, dict):
             raise HTTPError(400, "query body must be a JSON object")
-        try:
-            qc = resolve_query_class(pairs)
-        except ValueError as e:
-            raise HTTPError(500, str(e))
         if qc is None:
             return body  # raw dict queries
         try:
@@ -162,8 +166,8 @@ class QueryServerService:
             # one consistent snapshot — a concurrent /reload must not mix
             # the old engine's query class with the new engine's models
             with self._swap_lock:
-                pairs, serving = self.pairs, self.serving
-            query = self._parse_query(req.body, pairs)
+                pairs, serving, qc = self.pairs, self.serving, self.query_class
+            query = self._parse_query(req.body, qc)
             for blocker in QUERY_BLOCKERS:
                 blocker(req.body)
             query = serving.supplement(query)
@@ -208,12 +212,23 @@ class QueryServerService:
     def get_stats(self, req: Request):
         return 200, self.stats.to_dict()
 
+    def _check_admin(self, req: Request):
+        if self.admin_key is not None:
+            if req.bearer_key() != self.admin_key:
+                raise HTTPError(401, "invalid admin accessKey")
+        elif req.client_addr not in ("127.0.0.1", "::1"):
+            raise HTTPError(
+                403, "admin routes are loopback-only without an admin key"
+            )
+
     def reload(self, req: Request):
         """Hot-swap to the newest COMPLETED instance (reference /reload)."""
+        self._check_admin(req)
         self._load(None)
         return 200, {"engineInstanceId": self.instance_id}
 
     def undeploy(self, req: Request):
+        self._check_admin(req)
         self._deployed = False
         return 200, {"message": "undeployed"}
 
@@ -226,9 +241,10 @@ def create_query_server(
     ctx: Optional[ComputeContext] = None,
     feedback: bool = False,
     feedback_app_id: Optional[int] = None,
+    admin_key: Optional[str] = None,
 ) -> Tuple[JsonHTTPServer, QueryServerService]:
     service = QueryServerService(
-        variant, instance_id, ctx, feedback, feedback_app_id
+        variant, instance_id, ctx, feedback, feedback_app_id, admin_key
     )
     server = JsonHTTPServer(
         service.router, host, port, name="pio-tpu-queryserver"

@@ -114,6 +114,11 @@ def cmd_channel_new(args) -> int:
     from pio_tpu.storage import Channel
 
     app = _resolve_app(args.app)
+    chans = _storage().get_meta_data_channels().get_by_app_id(app.id)
+    if any(c.name == args.channel for c in chans):
+        return _err(
+            f"channel {args.channel!r} already exists for app {args.app!r}"
+        )
     cid = _storage().get_meta_data_channels().insert(
         Channel(0, args.channel, app.id)
     )
@@ -255,6 +260,7 @@ def cmd_deploy(args) -> int:
         instance_id=args.engine_instance_id,
         feedback=bool(args.feedback_app),
         feedback_app_id=feedback_app_id,
+        admin_key=args.admin_key,
     )
     _out(
         f"Query Server for instance {service.instance_id} "
@@ -269,11 +275,16 @@ def cmd_deploy(args) -> int:
 
 def cmd_undeploy(args) -> int:
     url = f"http://{args.ip}:{args.port}/undeploy"
+    if args.admin_key:
+        url += f"?accessKey={args.admin_key}"
     try:
         req = urllib.request.Request(url, data=b"{}", method="POST")
         with urllib.request.urlopen(req, timeout=10) as resp:
             _out(resp.read().decode())
         return 0
+    except urllib.error.HTTPError as e:
+        return _err(f"query server refused undeploy: {e.code} "
+                    f"{e.read().decode(errors='replace')}")
     except OSError as e:
         return _err(f"cannot reach query server at {url}: {e}")
 
@@ -410,11 +421,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--feedback-app", default=None,
         help="app name to log prediction feedback events into",
     )
+    a.add_argument(
+        "--admin-key", default=None,
+        help="access key required by /reload and /undeploy; "
+             "without one those routes are loopback-only",
+    )
     a.set_defaults(fn=cmd_deploy)
 
     a = sub.add_parser("undeploy", help="stop a running query server")
     a.add_argument("--ip", default="127.0.0.1")
     a.add_argument("--port", type=int, default=8000)
+    a.add_argument(
+        "--admin-key", default=None,
+        help="admin access key if the server was deployed with one",
+    )
     a.set_defaults(fn=cmd_undeploy)
 
     a = sub.add_parser("batchpredict", help="bulk offline scoring")

@@ -71,7 +71,7 @@ def run_batch_predict(
             for i, (_, q, err) in enumerate(parsed)
             if err is None
         }
-        supplied = sorted(supplemented.items())
+        supplied = list(supplemented.items())  # built in ascending-i order
         per_algo = [
             dict(algo.batch_predict(model, supplied)) for algo, model in pairs
         ]

@@ -20,7 +20,7 @@ def synthetic():
     return dict(U=U, I=I, R=R, mask=mask, u=u_idx, i=i_idx, r=R[u_idx, i_idx])
 
 
-CFG = ALSConfig(rank=8, iterations=12, reg=0.01, edges_per_chunk=512)
+CFG = ALSConfig(rank=8, iterations=12, reg=0.01, blocks_per_chunk=64)
 
 
 class TestALS:
@@ -52,7 +52,7 @@ class TestALS:
             ComputeContext.create(),
             s["u"], s["i"], np.abs(s["r"]), s["U"], s["I"],
             ALSConfig(rank=8, iterations=8, reg=0.1, implicit=True, alpha=10,
-                      edges_per_chunk=512),
+                      blocks_per_chunk=64),
         )
         pred = f.user_factors @ f.item_factors.T
         hu, hi = np.nonzero(~s["mask"])
