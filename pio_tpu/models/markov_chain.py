@@ -1,0 +1,88 @@
+"""First-order Markov chain — TPU-native rebuild of the reference e2 helper.
+
+Reference: ``e2/src/main/scala/o/a/p/e2/engine/MarkovChain.scala``
+(UNVERIFIED path; see SURVEY.md §2.5) — builds a transition model from a
+sparse matrix of transition *counts* and keeps, per state, the top-K
+normalized transition probabilities.
+
+TPU-first formulation: the count matrix is dense ``[S, S]`` (states after
+BiMap dense-coding), built with one scatter-add from the observed
+(from, to, count) triples; row normalization + ``lax.top_k`` produce the
+per-state top-K table in a single jittable program rather than the
+reference's per-row Scala sort.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class MarkovChainModel:
+    """Per-state top-K transition table.
+
+    Attributes:
+        top_indices: [S, K] int32 — destination state codes, by descending
+            probability (padded with -1 where a state has < K successors).
+        top_probs: [S, K] float32 — matching transition probabilities.
+        n_states: S.
+    """
+
+    top_indices: np.ndarray
+    top_probs: np.ndarray
+    n_states: int
+
+    def transitions_of(self, state: int) -> List[Tuple[int, float]]:
+        """(to_state, prob) list for one state, descending probability."""
+        out = []
+        for idx, prob in zip(self.top_indices[state], self.top_probs[state]):
+            if idx < 0 or prob <= 0.0:
+                break
+            out.append((int(idx), float(prob)))
+        return out
+
+
+def train_markov_chain(
+    transitions: Sequence[Tuple[int, int, float]],
+    n_states: int,
+    top_k: int = 10,
+) -> MarkovChainModel:
+    """Build the model from (from_state, to_state, count) triples.
+
+    ≙ reference ``MarkovChain.train(matrix, topCount)``. The sparse triples
+    become one dense scatter-add + row-normalize + top-k on device.
+    """
+    if n_states <= 0:
+        raise ValueError("n_states must be positive")
+    k = min(top_k, n_states)
+
+    counts = np.zeros((n_states, n_states), np.float32)
+    if transitions:
+        tr = np.asarray(transitions, np.float64)
+        frm = tr[:, 0].astype(np.int32)
+        to = tr[:, 1].astype(np.int32)
+        if (frm < 0).any() or (frm >= n_states).any() or (
+            (to < 0).any() or (to >= n_states).any()
+        ):
+            raise ValueError("transition state out of range")
+        np.add.at(counts, (frm, to), tr[:, 2].astype(np.float32))
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def normalize_topk(c):
+        row_sum = jnp.sum(c, axis=1, keepdims=True)
+        probs = jnp.where(row_sum > 0, c / jnp.where(row_sum > 0, row_sum, 1), 0.0)
+        top_p, top_i = jax.lax.top_k(probs, k)
+        # mark zero-probability tail entries as absent
+        top_i = jnp.where(top_p > 0, top_i, -1)
+        return top_i.astype(jnp.int32), top_p.astype(jnp.float32)
+
+    top_i, top_p = normalize_topk(jnp.asarray(counts))
+    return MarkovChainModel(
+        np.asarray(top_i), np.asarray(top_p), n_states
+    )

@@ -1,0 +1,265 @@
+"""Categorical Naive Bayes — TPU-native rebuild of the reference's e2 helper.
+
+Reference: ``e2/src/main/scala/o/a/p/e2/engine/CategoricalNaiveBayes.scala``
+(UNVERIFIED path; see SURVEY.md §2.5) — trains on labeled points whose
+features are *categorical strings per position*, producing per-label priors
+and per-(label, position, value) conditional log-likelihoods with add-one
+smoothing, then predicts the argmax-log-score label.
+
+TPU-first formulation: instead of the reference's nested
+``Map[String, Map[String, Double]]`` lookups per prediction, we encode each
+feature position's vocabulary densely (BiMap-style) and materialize a
+log-likelihood tensor per position ``L_f[label, value]``. Scoring a batch of
+points is then a sum of gathers — and for fully-batched serving,
+``predict_batch`` is a single jittable program (one-hot × log-likelihood
+matmuls ride the MXU for wide vocabularies).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LabeledPoint:
+    """A training example: string label + categorical string features.
+
+    ≙ reference ``LabeledPoint(label: String, features: Seq[String])``.
+    """
+
+    label: str
+    features: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class NaiveBayesModel:
+    """Dense categorical-NB model.
+
+    Attributes:
+        labels: label vocabulary, index = label code.
+        feature_vocabs: per position, value vocabulary (index = value code).
+        priors: [L] float32 log P(label).
+        likelihoods: per position f, [L, V_f] float32 log P(value | label)
+            with add-one smoothing.
+    """
+
+    labels: List[str]
+    feature_vocabs: List[Dict[str, int]]
+    priors: np.ndarray
+    likelihoods: List[np.ndarray]
+
+    def log_score(
+        self,
+        point: LabeledPoint,
+        default_likelihood: Optional[float] = None,
+    ) -> Optional[float]:
+        """Log score of ``point`` under its own label.
+
+        Returns None when the label is unknown, or when a feature value is
+        out-of-vocabulary and no ``default_likelihood`` is given (parity with
+        the reference's ``logScore(point, defaultLikelihood)`` Option result).
+        """
+        self._check_arity([point.features])
+        if point.label not in self._label_index:
+            return None
+        li = self._label_index[point.label]
+        total = float(self.priors[li])
+        for f, value in enumerate(point.features):
+            code = self.feature_vocabs[f].get(value)
+            if code is None:
+                if default_likelihood is None:
+                    return None
+                total += default_likelihood
+            else:
+                total += float(self.likelihoods[f][li, code])
+        return total
+
+    def _check_arity(self, features: Sequence[Sequence[str]]) -> None:
+        want = len(self.feature_vocabs)
+        for f in features:
+            if len(f) != want:
+                raise ValueError(
+                    f"feature tuple has {len(f)} positions, model expects {want}"
+                )
+
+    def predict(self, features: Sequence[str]) -> str:
+        """Label with the highest posterior log score."""
+        self._check_arity([features])
+        scores = self.priors.copy()
+        for f, value in enumerate(features):
+            code = self.feature_vocabs[f].get(value)
+            if code is not None:
+                scores = scores + self.likelihoods[f][:, code]
+        return self.labels[int(np.argmax(scores))]
+
+    def encode_batch(
+        self, points: Sequence[Sequence[str]]
+    ) -> List[np.ndarray]:
+        """Encode feature strings to dense codes (-1 = out-of-vocab)."""
+        self._check_arity(points)
+        cols = []
+        for f, vocab in enumerate(self.feature_vocabs):
+            cols.append(
+                np.fromiter(
+                    (vocab.get(p[f], -1) for p in points),
+                    np.int32,
+                    len(points),
+                )
+            )
+        return cols
+
+    def predict_batch(self, points: Sequence[Sequence[str]]) -> List[str]:
+        """Batched argmax prediction via vectorized jnp gather/sum ops."""
+        if not points:
+            return []
+        import jax.numpy as jnp
+
+        codes = self.encode_batch(points)
+        scores = jnp.broadcast_to(
+            jnp.asarray(self.priors), (len(points), len(self.labels))
+        )
+        for f, col in enumerate(codes):
+            lik = jnp.asarray(self.likelihoods[f])  # [L, V_f]
+            col = jnp.asarray(col)
+            # OOV (-1) contributes 0; clamp index for the gather then mask.
+            gathered = lik[:, jnp.clip(col, 0)].T  # [B, L]
+            scores = scores + jnp.where(
+                (col >= 0)[:, None], gathered, 0.0
+            )
+        best = np.asarray(jnp.argmax(scores, axis=1))
+        return [self.labels[int(i)] for i in best]
+
+    @property
+    def _label_index(self) -> Dict[str, int]:
+        if not hasattr(self, "_label_index_cache"):
+            object.__setattr__(
+                self,
+                "_label_index_cache",
+                {lb: i for i, lb in enumerate(self.labels)},
+            )
+        return self._label_index_cache  # type: ignore[attr-defined]
+
+
+def train_naive_bayes(points: Sequence[LabeledPoint]) -> NaiveBayesModel:
+    """Train categorical NB with add-one (Laplace) smoothing.
+
+    ≙ reference ``CategoricalNaiveBayes.train``. Counting is vectorized:
+    labels/values are dense-coded, then per-position count matrices come from
+    ``np.add.at`` scatter-adds (the host-side analog of the segment-sum the
+    TPU path uses for big corpora).
+    """
+    if not points:
+        raise ValueError("train_naive_bayes needs at least one LabeledPoint")
+    n_features = len(points[0].features)
+    for p in points:
+        if len(p.features) != n_features:
+            raise ValueError(
+                "all LabeledPoints must have the same number of features"
+            )
+
+    labels: List[str] = []
+    label_index: Dict[str, int] = {}
+    y = np.empty(len(points), np.int32)
+    for i, p in enumerate(points):
+        if p.label not in label_index:
+            label_index[p.label] = len(labels)
+            labels.append(p.label)
+        y[i] = label_index[p.label]
+    n_labels = len(labels)
+
+    label_counts = np.bincount(y, minlength=n_labels).astype(np.float64)
+    priors = np.log(label_counts / len(points)).astype(np.float32)
+
+    feature_vocabs: List[Dict[str, int]] = []
+    likelihoods: List[np.ndarray] = []
+    for f in range(n_features):
+        vocab: Dict[str, int] = {}
+        codes = np.empty(len(points), np.int32)
+        for i, p in enumerate(points):
+            v = p.features[f]
+            if v not in vocab:
+                vocab[v] = len(vocab)
+            codes[i] = vocab[v]
+        counts = np.zeros((n_labels, len(vocab)), np.float64)
+        np.add.at(counts, (y, codes), 1.0)
+        # add-one smoothing over the observed vocabulary
+        lik = np.log(
+            (counts + 1.0)
+            / (label_counts[:, None] + len(vocab))
+        ).astype(np.float32)
+        feature_vocabs.append(vocab)
+        likelihoods.append(lik)
+
+    return NaiveBayesModel(labels, feature_vocabs, priors, likelihoods)
+
+
+# --------------------------------------------------------- multinomial NB
+@dataclasses.dataclass
+class MultinomialNBModel:
+    """MLlib-``NaiveBayes``-parity model over numeric count features.
+
+    Scoring a batch is ``log_prior + X @ log_theta.T`` — one MXU matmul.
+
+    Attributes:
+        log_prior: [C] float32.
+        log_theta: [C, D] float32 — smoothed log feature weights.
+    """
+
+    log_prior: np.ndarray
+    log_theta: np.ndarray
+
+    @property
+    def n_classes(self) -> int:
+        return len(self.log_prior)
+
+    def scores(self, X: np.ndarray) -> np.ndarray:
+        return X.astype(np.float32) @ self.log_theta.T + self.log_prior
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return np.argmax(self.scores(X), axis=1).astype(np.int32)
+
+
+def train_multinomial_nb(
+    X: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    lambda_: float = 1.0,
+) -> MultinomialNBModel:
+    """Multinomial NB with Laplace smoothing ``lambda_``.
+
+    ≙ the reference classification template's ``NaiveBayes.train(data,
+    lambda)`` call into MLlib (examples/scala-parallel-classification,
+    UNVERIFIED; SURVEY.md §2.5). Feature aggregation per class is a
+    segment-sum over the class codes — the TPU analog of MLlib's
+    ``combineByKey`` over label keys.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.int32)
+    if (X < 0).any():
+        raise ValueError("multinomial NB requires non-negative features")
+
+    @jax.jit
+    def fit(Xj, yj):
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(yj, jnp.float32), yj, num_segments=n_classes
+        )
+        feat_sums = jax.ops.segment_sum(Xj, yj, num_segments=n_classes)
+        log_prior = jnp.log(counts / counts.sum())
+        smoothed = feat_sums + lambda_
+        log_theta = jnp.log(
+            smoothed / smoothed.sum(axis=1, keepdims=True)
+        )
+        return log_prior, log_theta
+
+    log_prior, log_theta = fit(jnp.asarray(X), jnp.asarray(y))
+    return MultinomialNBModel(
+        log_prior=np.asarray(log_prior, np.float32),
+        log_theta=np.asarray(log_theta, np.float32),
+    )

@@ -1,0 +1,74 @@
+"""Binary one-hot vectorizer — TPU-native rebuild of the reference e2 helper.
+
+Reference: ``e2/src/main/scala/o/a/p/e2/engine/BinaryVectorizer.scala``
+(UNVERIFIED path; see SURVEY.md §2.5) — learns a ``(field, value) → index``
+map from property maps restricted to selected fields, then turns a property
+map into a binary feature vector.
+
+TPU-first notes: the learned index is a plain dict (host side); vectorized
+encoding of a *batch* of property maps produces a dense ``[B, D]`` float32
+matrix ready to shard over the mesh ``data`` axis — downstream models
+(logreg, NB) consume it directly as MXU matmul input.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class BinaryVectorizer:
+    """(field, value) → dense one-hot index."""
+
+    index: Dict[Tuple[str, str], int]
+    fields: Tuple[str, ...]
+
+    @property
+    def dim(self) -> int:
+        return len(self.index)
+
+    @classmethod
+    def fit(
+        cls,
+        maps: Sequence[Mapping[str, str]],
+        fields: Sequence[str],
+    ) -> "BinaryVectorizer":
+        """Learn the index from observed (field, value) pairs.
+
+        ≙ reference ``BinaryVectorizer.apply(RDD[HashMap], properties)``.
+        Insertion order is deterministic (first-seen), so vectors are stable
+        across runs for identical input order.
+        """
+        fset = tuple(fields)
+        index: Dict[Tuple[str, str], int] = {}
+        for m in maps:
+            for f in fset:
+                if f in m:
+                    key = (f, str(m[f]))
+                    if key not in index:
+                        index[key] = len(index)
+        return cls(index=index, fields=fset)
+
+    def to_vector(self, m: Mapping[str, str]) -> List[float]:
+        """One property map → binary vector (list of 0.0/1.0)."""
+        vec = [0.0] * len(self.index)
+        for f in self.fields:
+            if f in m:
+                i = self.index.get((f, str(m[f])))
+                if i is not None:
+                    vec[i] = 1.0
+        return vec
+
+    def to_matrix(self, maps: Sequence[Mapping[str, str]]):
+        """Batch encode → np.float32 [B, D] (input to sharded models)."""
+        import numpy as np
+
+        out = np.zeros((len(maps), len(self.index)), np.float32)
+        for b, m in enumerate(maps):
+            for f in self.fields:
+                if f in m:
+                    i = self.index.get((f, str(m[f])))
+                    if i is not None:
+                        out[b, i] = 1.0
+        return out

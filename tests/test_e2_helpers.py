@@ -1,0 +1,168 @@
+"""Tests for the e2 reusable model helpers (reference e2/ subproject).
+
+Mirrors the reference's ``CategoricalNaiveBayesTest``, ``MarkovChainTest``,
+``BinaryVectorizerTest`` and ``CrossValidationTest`` (SURVEY.md §4).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from pio_tpu.controller.cross_validation import split_data
+from pio_tpu.models.markov_chain import train_markov_chain
+from pio_tpu.models.naive_bayes import LabeledPoint, train_naive_bayes
+from pio_tpu.models.vectorizer import BinaryVectorizer
+
+
+# ------------------------------------------------------- CategoricalNaiveBayes
+def _tennis_points():
+    # classic play-tennis toy set: features = (outlook, temperature)
+    rows = [
+        ("yes", "sunny", "hot"),
+        ("yes", "overcast", "mild"),
+        ("yes", "overcast", "hot"),
+        ("yes", "rain", "mild"),
+        ("no", "rain", "cool"),
+        ("no", "sunny", "hot"),
+    ]
+    return [LabeledPoint(lab, (o, t)) for lab, o, t in rows]
+
+
+class TestNaiveBayes:
+    def test_priors(self):
+        model = train_naive_bayes(_tennis_points())
+        pri = {l: math.exp(p) for l, p in zip(model.labels, model.priors)}
+        assert pri["yes"] == pytest.approx(4 / 6)
+        assert pri["no"] == pytest.approx(2 / 6)
+
+    def test_likelihood_add_one_smoothing(self):
+        model = train_naive_bayes(_tennis_points())
+        li = model.labels.index("yes")
+        f0 = model.feature_vocabs[0]
+        # P(overcast | yes) = (2 + 1) / (4 + |V|=3)
+        assert math.exp(
+            model.likelihoods[0][li, f0["overcast"]]
+        ) == pytest.approx(3 / 7)
+        # P(rain | no) = (1 + 1) / (2 + 3)
+        ln = model.labels.index("no")
+        assert math.exp(
+            model.likelihoods[0][ln, f0["rain"]]
+        ) == pytest.approx(2 / 5)
+
+    def test_predict(self):
+        model = train_naive_bayes(_tennis_points())
+        assert model.predict(("overcast", "hot")) == "yes"
+        # unseen combination falls back to priors+smoothing; cool only ever "no"
+        assert model.predict(("rain", "cool")) == "no"
+
+    def test_predict_batch_matches_scalar(self):
+        model = train_naive_bayes(_tennis_points())
+        queries = [
+            ("sunny", "hot"),
+            ("overcast", "mild"),
+            ("rain", "cool"),
+            ("nowhere", "hot"),  # OOV feature → contributes nothing
+        ]
+        batch = model.predict_batch(queries)
+        # scalar path ignores OOV values the same way
+        assert batch[:3] == [model.predict(q) for q in queries[:3]]
+        assert batch[3] == model.predict(("nowhere", "hot"))
+
+    def test_log_score_option_semantics(self):
+        model = train_naive_bayes(_tennis_points())
+        known = LabeledPoint("yes", ("sunny", "hot"))
+        assert model.log_score(known) is not None
+        oov = LabeledPoint("yes", ("blizzard", "hot"))
+        assert model.log_score(oov) is None  # OOV without default → None
+        with_default = model.log_score(oov, default_likelihood=-10.0)
+        assert with_default is not None and with_default < model.log_score(known)
+        assert model.log_score(LabeledPoint("maybe", ("sunny", "hot"))) is None
+
+    def test_ragged_features_rejected(self):
+        with pytest.raises(ValueError):
+            train_naive_bayes(
+                [LabeledPoint("a", ("x",)), LabeledPoint("b", ("x", "y"))]
+            )
+
+
+# --------------------------------------------------------------- MarkovChain
+class TestMarkovChain:
+    def test_row_normalization_and_order(self):
+        model = train_markov_chain(
+            [(0, 1, 3.0), (0, 2, 1.0), (1, 0, 2.0)], n_states=3, top_k=2
+        )
+        t0 = model.transitions_of(0)
+        assert t0[0][0] == 1 and t0[0][1] == pytest.approx(0.75)
+        assert t0[1][0] == 2 and t0[1][1] == pytest.approx(0.25)
+        t1 = model.transitions_of(1)
+        assert t1 == [(0, pytest.approx(1.0))]
+
+    def test_dangling_state_has_no_transitions(self):
+        model = train_markov_chain([(0, 1, 1.0)], n_states=3, top_k=2)
+        assert model.transitions_of(2) == []
+
+    def test_duplicate_triples_accumulate(self):
+        model = train_markov_chain(
+            [(0, 1, 1.0), (0, 1, 1.0), (0, 2, 2.0)], n_states=3, top_k=3
+        )
+        probs = dict(model.transitions_of(0))
+        assert probs[1] == pytest.approx(0.5)
+        assert probs[2] == pytest.approx(0.5)
+
+    def test_out_of_range_rejected(self):
+        with pytest.raises(ValueError):
+            train_markov_chain([(0, 5, 1.0)], n_states=3)
+
+
+# ----------------------------------------------------------- BinaryVectorizer
+class TestBinaryVectorizer:
+    def test_fit_and_vectorize(self):
+        maps = [
+            {"color": "red", "size": "L", "ignored": "x"},
+            {"color": "blue"},
+        ]
+        vz = BinaryVectorizer.fit(maps, fields=["color", "size"])
+        assert vz.dim == 3  # (color,red) (size,L) (color,blue)
+        v = vz.to_vector({"color": "blue", "size": "L"})
+        assert v[vz.index[("color", "blue")]] == 1.0
+        assert v[vz.index[("size", "L")]] == 1.0
+        assert sum(v) == 2.0
+
+    def test_unseen_value_is_zero(self):
+        vz = BinaryVectorizer.fit([{"a": "1"}], fields=["a"])
+        assert vz.to_vector({"a": "2"}) == [0.0]
+
+    def test_to_matrix(self):
+        maps = [{"a": "x"}, {"a": "y"}, {"b": "z"}]
+        vz = BinaryVectorizer.fit(maps, fields=["a", "b"])
+        m = vz.to_matrix(maps)
+        assert m.shape == (3, 3)
+        assert m.sum() == 3.0
+        assert (m.sum(axis=1) == 1.0).all()
+
+
+# ------------------------------------------------------------ cross-validation
+class TestSplitData:
+    def test_folds_partition_data(self):
+        data = list(range(10))
+        folds = split_data(
+            3,
+            data,
+            to_training_data=list,
+            to_query_actual=lambda d: (d, d * 2),
+        )
+        assert len(folds) == 3
+        all_test = []
+        for i, (train, info, qa) in enumerate(folds):
+            assert info == {"fold": i}
+            test_elems = [q for q, _ in qa]
+            assert set(train) | set(test_elems) == set(data)
+            assert not set(train) & set(test_elems)
+            all_test += test_elems
+        # every element is tested exactly once across folds
+        assert sorted(all_test) == data
+
+    def test_k_must_be_at_least_two(self):
+        with pytest.raises(ValueError):
+            split_data(1, [1], list, lambda d: (d, d))
