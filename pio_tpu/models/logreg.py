@@ -1,0 +1,146 @@
+"""Softmax / logistic regression — TPU-native classification trainer.
+
+Rebuild of the reference classification template's training substrate:
+MLlib's ``LogisticRegressionWithLBFGS`` / ``NaiveBayes``
+(``examples/scala-parallel-classification``, UNVERIFIED paths; SURVEY.md
+§2.6) runs full-batch gradient aggregation via Spark ``treeAggregate`` over
+executor partitions.
+
+TPU-first formulation: examples are sharded over the mesh ``data`` axis
+(NamedSharding); parameters stay replicated. The per-device partial gradient
+reduction that ``treeAggregate`` did over netty becomes the ``psum`` XLA
+inserts over ICI when a mean over the sharded batch dimension flows into
+replicated outputs — no hand-written collectives. The whole optimization
+loop is a single compiled program (``lax.scan`` over iterations), so HBM
+never round-trips to host between steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LogRegConfig:
+    iterations: int = 100
+    learning_rate: float = 0.1
+    reg: float = 0.0  # L2 on weights (not bias)
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class LogRegModel:
+    """weights [D, C] float32, bias [C] float32, plus class count."""
+
+    weights: np.ndarray
+    bias: np.ndarray
+    n_classes: int
+
+    def logits(self, X: np.ndarray) -> np.ndarray:
+        return X.astype(np.float32) @ self.weights + self.bias
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Argmax class codes for a [B, D] feature matrix."""
+        return np.argmax(self.logits(X), axis=1).astype(np.int32)
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        z = self.logits(X)
+        z = z - z.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=1, keepdims=True)
+
+
+def train_logreg(
+    ctx,
+    X: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    config: LogRegConfig = LogRegConfig(),
+) -> LogRegModel:
+    """Full-batch softmax regression with Adam, data-parallel over the mesh.
+
+    Args:
+        ctx: ComputeContext (mesh + batch axis); mesh=None → single device.
+        X: [N, D] features (host numpy).
+        y: [N] int class codes.
+        n_classes: C.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.int32)
+    n, d = X.shape
+
+    mesh = ctx.mesh if ctx is not None else None
+    axis = ctx.batch_axis if ctx is not None else "data"
+    n_dev = ctx.num_devices if ctx is not None else 1
+
+    # pad batch to a multiple of the device count; padded rows carry 0 weight
+    n_pad = (-n) % max(n_dev, 1)
+    if n_pad:
+        X = np.concatenate([X, np.zeros((n_pad, d), np.float32)])
+        y = np.concatenate([y, np.zeros(n_pad, np.int32)])
+    mask = np.concatenate(
+        [np.ones(n, np.float32), np.zeros(n_pad, np.float32)]
+    )
+
+    tx = optax.adam(config.learning_rate)
+    w_key = jax.random.PRNGKey(config.seed)
+    params = {
+        # small seeded init: breaks symmetry and makes `seed` a live knob
+        "w": 0.01 * jax.random.normal(w_key, (d, n_classes), jnp.float32),
+        "b": jnp.zeros((n_classes,), jnp.float32),
+    }
+
+    def loss_fn(params, Xs, ys, ms):
+        logits = (
+            jnp.dot(Xs, params["w"], preferred_element_type=jnp.float32)
+            + params["b"]
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, ys)
+        # mean over real rows only; over sharded inputs this contraction is
+        # where XLA inserts the cross-device psum (≙ treeAggregate)
+        data_loss = jnp.sum(ce * ms) / jnp.sum(ms)
+        return data_loss + config.reg * jnp.sum(params["w"] ** 2)
+
+    def fit(params, Xs, ys, ms):
+        opt_state = tx.init(params)
+
+        def step(carry, _):
+            params, opt_state = carry
+            grads = jax.grad(loss_fn)(params, Xs, ys, ms)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), None
+
+        (params, _), _ = jax.lax.scan(
+            step, (params, opt_state), None, length=config.iterations
+        )
+        return params
+
+    if mesh is not None:
+        shard = NamedSharding(mesh, P(axis))
+        repl = NamedSharding(mesh, P())
+        Xs = jax.device_put(jnp.asarray(X), shard)
+        ys = jax.device_put(jnp.asarray(y), shard)
+        ms = jax.device_put(jnp.asarray(mask), shard)
+        fitted = jax.jit(
+            fit,
+            in_shardings=(repl, shard, shard, shard),
+            out_shardings=repl,
+        )(jax.device_put(params, repl), Xs, ys, ms)
+    else:
+        fitted = jax.jit(fit)(
+            params, jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)
+        )
+
+    return LogRegModel(
+        weights=np.asarray(fitted["w"]),
+        bias=np.asarray(fitted["b"]),
+        n_classes=n_classes,
+    )

@@ -4,8 +4,11 @@ Importing this package registers every bundled engine factory:
 
 - ``templates.recommendation`` — explicit ALS recommender
   (≙ examples/scala-parallel-recommendation)
+- ``templates.classification`` — NB / logreg attribute classifier
+  (≙ examples/scala-parallel-classification)
 """
 
+from pio_tpu.templates import classification  # noqa: F401  (registers factory)
 from pio_tpu.templates import recommendation  # noqa: F401  (registers factory)
 
-__all__ = ["recommendation"]
+__all__ = ["classification", "recommendation"]

@@ -1,0 +1,284 @@
+"""Classification template — label prediction from entity attributes.
+
+Rebuild of the reference's ``examples/scala-parallel-classification``
+(DataSource.scala reads ``$set`` user properties ``attr0..attr2`` + ``plan``
+label via ``PEventStore.aggregateProperties``; NaiveBayesAlgorithm.scala
+trains MLlib multinomial NB — UNVERIFIED paths; see SURVEY.md §2.5).
+
+Two algorithms, selectable in engine.json (≙ the template's NB default and
+its documented LogisticRegressionWithLBFGS variant):
+
+- ``naivebayes`` — multinomial NB; counting is a segment-sum, scoring one
+  MXU matmul (pio_tpu/models/naive_bayes.py).
+- ``logreg`` — softmax regression, full-batch Adam over the mesh ``data``
+  axis; the treeAggregate gradient reduction becomes an XLA psum
+  (pio_tpu/models/logreg.py).
+
+engine.json:
+
+    {
+      "id": "classification",
+      "engineFactory": "templates.classification",
+      "datasource": {"params": {"app_name": "myapp"}},
+      "algorithms": [{"name": "naivebayes", "params": {"lambda_": 1.0}}]
+    }
+
+Query ``{"attr0": 2, "attr1": 0, "attr2": 0}`` → ``{"label": "..."}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+    register_engine,
+)
+from pio_tpu.controller.cross_validation import split_data
+from pio_tpu.data.bimap import BiMap
+from pio_tpu.models.logreg import LogRegConfig, LogRegModel, train_logreg
+from pio_tpu.models.naive_bayes import (
+    MultinomialNBModel,
+    train_multinomial_nb,
+)
+from pio_tpu.parallel.context import ComputeContext
+from pio_tpu.storage import Storage
+from pio_tpu.templates.common import resolve_app
+
+
+# --------------------------------------------------------------- data source
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    app_id: int = 0
+    channel: str = ""
+    entity_type: str = "user"
+    #: numeric feature attributes read off each entity's PropertyMap
+    attrs: Tuple[str, ...] = ("attr0", "attr1", "attr2")
+    #: label attribute (reference template's "plan")
+    label_attr: str = "plan"
+    eval_k: int = 0
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    features: np.ndarray  # [n, d] float32
+    labels: np.ndarray  # [n] str objects
+
+    def sanity_check(self) -> None:
+        if len(self.labels) == 0:
+            raise ValueError(
+                "TrainingData is empty - no entities with the required "
+                "attributes. Did you $set properties for this app?"
+            )
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class ClassificationDataSource(DataSource):
+    """aggregateProperties → dense feature matrix + label column
+    (≙ reference DataSource.readTraining)."""
+
+    params_class = DataSourceParams
+
+    def _read(self) -> TrainingData:
+        p: DataSourceParams = self.params
+        app_id, channel_id = resolve_app(p)
+        required = list(p.attrs) + [p.label_attr]
+        props = Storage.get_pevents().aggregate_properties(
+            app_id,
+            entity_type=p.entity_type,
+            channel_id=channel_id,
+            required=required,
+        )
+        feats = np.zeros((len(props), len(p.attrs)), np.float32)
+        labels = np.empty(len(props), object)
+        for i, (eid, pm) in enumerate(sorted(props.items())):
+            for j, a in enumerate(p.attrs):
+                feats[i, j] = float(pm.get(a))
+            labels[i] = str(pm.get(p.label_attr))
+        return TrainingData(features=feats, labels=labels)
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        return self._read()
+
+    def read_eval(self, ctx: ComputeContext):
+        p: DataSourceParams = self.params
+        if p.eval_k <= 0:
+            return []
+        td = self._read()
+        rows = list(zip(td.features, td.labels))
+        return split_data(
+            p.eval_k,
+            rows,
+            to_training_data=lambda rs: TrainingData(
+                features=np.array([f for f, _ in rs], np.float32).reshape(
+                    len(rs), td.features.shape[1]
+                ),
+                labels=np.array([l for _, l in rs], object),
+            ),
+            to_query_actual=lambda r: (
+                Query(attrs=tuple(float(x) for x in r[0])),
+                str(r[1]),
+            ),
+        )
+
+
+# --------------------------------------------------------------- preparator
+@dataclasses.dataclass
+class PreparedData:
+    features: np.ndarray  # [n, d] float32
+    label_codes: np.ndarray  # [n] int32
+    label_index: BiMap
+
+
+class ClassificationPreparator(Preparator):
+    """String labels → dense codes (BiMap); features pass through."""
+
+    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
+        label_index = BiMap.string_int(td.labels.tolist())
+        fwd = label_index.to_dict()
+        codes = np.fromiter(
+            (fwd[l] for l in td.labels.tolist()), np.int32, len(td)
+        )
+        return PreparedData(td.features, codes, label_index)
+
+
+# ----------------------------------------------------------------- algorithm
+@dataclasses.dataclass(frozen=True)
+class Query:
+    attrs: Tuple[float, ...] = ()
+    # individual attr fields for engine.json-style queries
+    attr0: Optional[float] = None
+    attr1: Optional[float] = None
+    attr2: Optional[float] = None
+
+    def vector(self, dim: int) -> np.ndarray:
+        if self.attrs:
+            vals = self.attrs
+        else:
+            vals = tuple(
+                v for v in (self.attr0, self.attr1, self.attr2)
+                if v is not None
+            )
+        if len(vals) != dim:
+            raise ValueError(
+                f"query has {len(vals)} attrs, model expects {dim}"
+            )
+        return np.asarray(vals, np.float32)[None, :]
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    label: str = ""
+
+    def to_dict(self) -> dict:
+        return {"label": self.label}
+
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesParams(Params):
+    lambda_: float = 1.0  # Laplace smoothing (reference param "lambda")
+
+
+@dataclasses.dataclass
+class NBClassifierModel:
+    nb: MultinomialNBModel
+    label_index: BiMap
+    dim: int
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    """Multinomial NB (≙ reference NaiveBayesAlgorithm → MLlib NaiveBayes)."""
+
+    params_class = NaiveBayesParams
+    query_class = Query
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> NBClassifierModel:
+        p: NaiveBayesParams = self.params
+        nb = train_multinomial_nb(
+            pd.features,
+            pd.label_codes,
+            n_classes=len(pd.label_index),
+            lambda_=p.lambda_,
+        )
+        return NBClassifierModel(nb, pd.label_index, pd.features.shape[1])
+
+    def predict(self, model: NBClassifierModel, query: Query) -> PredictedResult:
+        x = query.vector(model.dim)
+        code = int(model.nb.predict(x)[0])
+        return PredictedResult(label=model.label_index.inverse[code])
+
+
+@dataclasses.dataclass(frozen=True)
+class LogRegParams(Params):
+    iterations: int = 200
+    learning_rate: float = 0.1
+    reg: float = 0.0
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class LogRegClassifierModel:
+    lr: LogRegModel
+    label_index: BiMap
+    dim: int
+
+
+class LogisticRegressionAlgorithm(Algorithm):
+    """Sharded softmax regression (≙ LogisticRegressionWithLBFGS variant)."""
+
+    params_class = LogRegParams
+    query_class = Query
+
+    def train(
+        self, ctx: ComputeContext, pd: PreparedData
+    ) -> LogRegClassifierModel:
+        p: LogRegParams = self.params
+        lr = train_logreg(
+            ctx,
+            pd.features,
+            pd.label_codes,
+            n_classes=len(pd.label_index),
+            config=LogRegConfig(
+                iterations=p.iterations,
+                learning_rate=p.learning_rate,
+                reg=p.reg,
+                seed=p.seed,
+            ),
+        )
+        return LogRegClassifierModel(lr, pd.label_index, pd.features.shape[1])
+
+    def predict(
+        self, model: LogRegClassifierModel, query: Query
+    ) -> PredictedResult:
+        x = query.vector(model.dim)
+        code = int(model.lr.predict(x)[0])
+        return PredictedResult(label=model.label_index.inverse[code])
+
+
+class ClassificationServing(FirstServing):
+    pass
+
+
+@register_engine("templates.classification")
+def classification_engine() -> Engine:
+    return Engine(
+        ClassificationDataSource,
+        ClassificationPreparator,
+        {
+            "naivebayes": NaiveBayesAlgorithm,
+            "logreg": LogisticRegressionAlgorithm,
+        },
+        ClassificationServing,
+    )

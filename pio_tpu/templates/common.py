@@ -1,0 +1,37 @@
+"""Shared template plumbing.
+
+The reference templates each re-declare app-name lookup inside their
+DataSource (``examples/scala-parallel-*/DataSource.scala``, UNVERIFIED;
+SURVEY.md §2.5); here it is one helper shared by every bundled template.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from pio_tpu.storage import Storage
+
+
+def resolve_app(params) -> Tuple[int, Optional[int]]:
+    """(app_id, channel_id) from datasource params.
+
+    ``params`` needs ``app_name``/``app_id`` and optionally ``channel``
+    attributes (every bundled DataSourceParams has them).
+    """
+    app_id = params.app_id
+    if params.app_name:
+        app = Storage.get_meta_data_apps().get_by_name(params.app_name)
+        if app is None:
+            raise ValueError(f"app {params.app_name!r} not found")
+        app_id = app.id
+    if not app_id:
+        raise ValueError("datasource params need app_name or app_id")
+    channel_id = None
+    channel = getattr(params, "channel", "")
+    if channel:
+        chans = Storage.get_meta_data_channels().get_by_app_id(app_id)
+        match = [c for c in chans if c.name == channel]
+        if not match:
+            raise ValueError(f"channel {channel!r} not found")
+        channel_id = match[0].id
+    return app_id, channel_id

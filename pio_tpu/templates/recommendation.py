@@ -41,6 +41,7 @@ from pio_tpu.models.als import ALSConfig, ALSFactors, top_n, train_als
 from pio_tpu.parallel.context import ComputeContext
 from pio_tpu.storage import Storage
 from pio_tpu.storage.frame import EventFrame
+from pio_tpu.templates.common import resolve_app
 
 
 # --------------------------------------------------------------- data source
@@ -74,25 +75,6 @@ class TrainingData(SanityCheck):
         return len(self.ratings)
 
 
-def _resolve_app(params: DataSourceParams) -> Tuple[int, Optional[int]]:
-    app_id = params.app_id
-    if params.app_name:
-        app = Storage.get_meta_data_apps().get_by_name(params.app_name)
-        if app is None:
-            raise ValueError(f"app {params.app_name!r} not found")
-        app_id = app.id
-    if not app_id:
-        raise ValueError("datasource params need app_name or app_id")
-    channel_id = None
-    if params.channel:
-        chans = Storage.get_meta_data_channels().get_by_app_id(app_id)
-        match = [c for c in chans if c.name == params.channel]
-        if not match:
-            raise ValueError(f"channel {params.channel!r} not found")
-        channel_id = match[0].id
-    return app_id, channel_id
-
-
 class RecommendationDataSource(DataSource):
     """PEvents bulk read → columnar ratings
     (≙ reference DataSource.readTraining via PEventStore.find)."""
@@ -101,7 +83,7 @@ class RecommendationDataSource(DataSource):
 
     def _read_frame(self) -> Tuple[EventFrame, "DataSourceParams"]:
         p: DataSourceParams = self.params
-        app_id, channel_id = _resolve_app(p)
+        app_id, channel_id = resolve_app(p)
         frame = Storage.get_pevents().find_frame(
             app_id,
             channel_id=channel_id,

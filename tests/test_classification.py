@@ -1,0 +1,195 @@
+"""Classification template tests: NB/logreg models + end-to-end lifecycle.
+
+Mirrors the reference's scala-parallel-classification quickstart scenario
+(SURVEY.md §4): $set user attributes → aggregateProperties → train →
+query label.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+import pio_tpu.templates  # noqa: F401  (registers engine factories)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.models.logreg import LogRegConfig, train_logreg
+from pio_tpu.models.naive_bayes import train_multinomial_nb
+from pio_tpu.storage import App, Storage
+from pio_tpu.templates.classification import PredictedResult, Query
+from pio_tpu.workflow import (
+    build_engine,
+    load_models_for_instance,
+    run_train,
+    variant_from_dict,
+)
+
+
+# ------------------------------------------------------------ model level
+class TestMultinomialNB:
+    def test_separable_counts(self):
+        # class 0 heavy on feature 0, class 1 heavy on feature 1
+        X = np.array(
+            [[8, 1], [9, 0], [7, 2], [1, 9], [0, 8], [2, 7]], np.float32
+        )
+        y = np.array([0, 0, 0, 1, 1, 1], np.int32)
+        model = train_multinomial_nb(X, y, n_classes=2)
+        assert model.predict(np.array([[10, 1]], np.float32))[0] == 0
+        assert model.predict(np.array([[1, 10]], np.float32))[0] == 1
+
+    def test_priors_reflect_imbalance(self):
+        X = np.ones((4, 1), np.float32)
+        y = np.array([0, 0, 0, 1], np.int32)
+        model = train_multinomial_nb(X, y, n_classes=2)
+        assert np.exp(model.log_prior[0]) == pytest.approx(0.75)
+
+    def test_negative_features_rejected(self):
+        with pytest.raises(ValueError):
+            train_multinomial_nb(
+                np.array([[-1.0]], np.float32), np.zeros(1, np.int32), 1
+            )
+
+
+class TestLogReg:
+    def test_learns_linear_boundary(self):
+        rng = np.random.default_rng(0)
+        n = 256
+        X = rng.normal(size=(n, 2)).astype(np.float32)
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.int32)
+        ctx = ComputeContext.create(seed=0)
+        model = train_logreg(
+            ctx, X, y, n_classes=2,
+            config=LogRegConfig(iterations=300, learning_rate=0.3),
+        )
+        acc = (model.predict(X) == y).mean()
+        assert acc > 0.95
+
+    def test_single_device_path(self):
+        X = np.array([[0.0], [1.0], [2.0], [3.0]], np.float32)
+        y = np.array([0, 0, 1, 1], np.int32)
+        model = train_logreg(
+            None, X, y, n_classes=2,
+            config=LogRegConfig(iterations=200, learning_rate=0.5),
+        )
+        assert (model.predict(X) == y).all()
+
+    def test_proba_sums_to_one(self):
+        X = np.array([[1.0, 2.0]], np.float32)
+        y = np.array([0], np.int32)
+        model = train_logreg(
+            None, X, y, n_classes=3,
+            config=LogRegConfig(iterations=5),
+        )
+        assert model.predict_proba(X).sum() == pytest.approx(1.0, abs=1e-5)
+
+
+# ------------------------------------------------------------- end-to-end
+@pytest.fixture(autouse=True)
+def isolated_storage(tmp_home):
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def _seed_users(app_id: int):
+    """Plan is decided by the dominant attribute (deterministic pattern)."""
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    rng = np.random.default_rng(7)
+    n = 0
+    for plan, hot in (("basic", 0), ("premium", 1), ("pro", 2)):
+        for k in range(8):
+            attrs = rng.integers(0, 3, size=3)
+            attrs[hot] += 6  # dominant attribute determines the plan
+            props = {f"attr{j}": int(attrs[j]) for j in range(3)}
+            props["plan"] = plan
+            le.insert(
+                Event(
+                    "$set", "user", f"u{n}",
+                    properties=props,
+                    event_time=t0 + dt.timedelta(minutes=n),
+                ),
+                app_id,
+            )
+            n += 1
+    # one user missing the label → must be excluded by required= filter
+    le.insert(
+        Event("$set", "user", "unlabeled", properties={"attr0": 1, "attr1": 1,
+                                                       "attr2": 1},
+              event_time=t0),
+        app_id,
+    )
+
+
+def _variant(algo):
+    return variant_from_dict({
+        "id": "cls-e2e",
+        "engineFactory": "templates.classification",
+        "datasource": {"params": {"app_name": "cls-test"}},
+        "algorithms": [algo],
+    })
+
+
+class TestClassificationEndToEnd:
+    @pytest.mark.parametrize(
+        "algo",
+        [
+            {"name": "naivebayes", "params": {"lambda_": 1.0}},
+            {
+                "name": "logreg",
+                "params": {"iterations": 300, "learning_rate": 0.3},
+            },
+        ],
+        ids=["naivebayes", "logreg"],
+    )
+    def test_full_lifecycle(self, algo):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "cls-test"))
+        _seed_users(app_id)
+
+        variant = _variant(algo)
+        engine, ep = build_engine(variant)
+        ctx = ComputeContext.create(seed=0)
+        instance_id = run_train(engine, ep, variant, ctx=ctx)
+        models = load_models_for_instance(instance_id, engine, ep, ctx)
+        serving = engine.make_serving(ep)
+        pairs = engine.algorithms_with_models(ep, models)
+
+        def serve(q):
+            return serving.serve(q, [a.predict(m, q) for a, m in pairs])
+
+        # dominant attr0 → basic, attr1 → premium, attr2 → pro
+        cases = [
+            (Query(attrs=(9.0, 1.0, 1.0)), "basic"),
+            (Query(attrs=(1.0, 9.0, 1.0)), "premium"),
+            (Query(attrs=(1.0, 1.0, 9.0)), "pro"),
+        ]
+        for query, want in cases:
+            result = serve(query)
+            assert isinstance(result, PredictedResult)
+            assert result.label == want
+
+    def test_attr_fields_query_form(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "cls-test"))
+        _seed_users(app_id)
+        v = _variant({"name": "naivebayes", "params": {}})
+        engine, ep = build_engine(v)
+        ctx = ComputeContext.create(seed=0)
+        instance_id = run_train(engine, ep, v, ctx=ctx)
+        models = load_models_for_instance(instance_id, engine, ep, ctx)
+        serving = engine.make_serving(ep)
+        pairs = engine.algorithms_with_models(ep, models)
+        q = Query(attr0=9.0, attr1=1.0, attr2=1.0)
+        result = serving.serve(q, [a.predict(m, q) for a, m in pairs])
+        assert result.label == "basic"
+
+    def test_wrong_arity_query_raises(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "cls-test"))
+        _seed_users(app_id)
+        v = _variant({"name": "naivebayes", "params": {}})
+        engine, ep = build_engine(v)
+        ctx = ComputeContext.create(seed=0)
+        instance_id = run_train(engine, ep, v, ctx=ctx)
+        models = load_models_for_instance(instance_id, engine, ep, ctx)
+        pairs = engine.algorithms_with_models(ep, models)
+        with pytest.raises(ValueError):
+            [a.predict(m, Query(attrs=(1.0,))) for a, m in pairs]
