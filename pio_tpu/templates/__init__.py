@@ -6,9 +6,20 @@ Importing this package registers every bundled engine factory:
   (≙ examples/scala-parallel-recommendation)
 - ``templates.classification`` — NB / logreg attribute classifier
   (≙ examples/scala-parallel-classification)
+- ``templates.similarproduct`` — implicit-ALS cosine similar items
+  (≙ examples/scala-parallel-similarproduct)
+- ``templates.ecommerce`` — personalized recs + business rules
+  (≙ examples/scala-parallel-ecommercerecommendation)
 """
 
 from pio_tpu.templates import classification  # noqa: F401  (registers factory)
+from pio_tpu.templates import ecommerce  # noqa: F401  (registers factory)
 from pio_tpu.templates import recommendation  # noqa: F401  (registers factory)
+from pio_tpu.templates import similarproduct  # noqa: F401  (registers factory)
 
-__all__ = ["classification", "recommendation"]
+__all__ = [
+    "classification",
+    "ecommerce",
+    "recommendation",
+    "similarproduct",
+]

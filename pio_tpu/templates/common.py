@@ -7,9 +7,30 @@ SURVEY.md §2.5); here it is one helper shared by every bundled template.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import dataclasses
+from typing import FrozenSet, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from pio_tpu.storage import Storage
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {
+            "itemScores": [
+                {"item": s.item, "score": s.score} for s in self.item_scores
+            ]
+        }
 
 
 def resolve_app(params) -> Tuple[int, Optional[int]]:
@@ -35,3 +56,64 @@ def resolve_app(params) -> Tuple[int, Optional[int]]:
             raise ValueError(f"channel {channel!r} not found")
         channel_id = match[0].id
     return app_id, channel_id
+
+
+# ------------------------------------------------ shared item-scoring rules
+def l2_normalize_rows(f: np.ndarray) -> np.ndarray:
+    """Row-normalize factors for cosine scoring; zero rows stay zero."""
+    norms = np.linalg.norm(f, axis=1, keepdims=True)
+    return np.where(norms > 0, f / np.where(norms > 0, norms, 1), 0.0).astype(
+        np.float32
+    )
+
+
+def business_rule_mask(
+    n_items: int,
+    item_index,
+    categories_per_item: Sequence[FrozenSet[str]],
+    categories: Tuple[str, ...] = (),
+    white_list: Tuple[str, ...] = (),
+    black_list: Tuple[str, ...] = (),
+) -> np.ndarray:
+    """Boolean keep-mask from the standard template filters
+    (≙ the reference templates' categories/whiteList/blackList handling)."""
+    mask = np.ones(n_items, bool)
+    if categories:
+        wanted = set(categories)
+        mask &= np.fromiter(
+            (bool(wanted & c) for c in categories_per_item),
+            bool,
+            len(categories_per_item),
+        )
+    if white_list:
+        white = np.zeros(n_items, bool)
+        for i in white_list:
+            c = item_index.get(i)
+            if c is not None:
+                white[c] = True
+        mask &= white
+    for i in black_list:
+        c = item_index.get(i)
+        if c is not None:
+            mask[c] = False
+    return mask
+
+
+def top_item_scores(
+    scores: np.ndarray, mask: np.ndarray, num: int, item_index
+) -> PredictedResult:
+    """Masked top-N → PredictedResult (argpartition, not full sort)."""
+    scores = np.where(mask, scores, -np.inf)
+    n = min(num, int(mask.sum()))
+    if n <= 0:
+        return PredictedResult()
+    idx = np.argpartition(-scores, n - 1)[:n]
+    idx = idx[np.argsort(-scores[idx])]
+    inv = item_index.inverse
+    return PredictedResult(
+        tuple(
+            ItemScore(inv[int(i)], float(scores[i]))
+            for i in idx
+            if np.isfinite(scores[i])
+        )
+    )

@@ -1,0 +1,234 @@
+"""E-Commerce Recommendation template — personalized recs with business rules.
+
+Rebuild of the reference's ``examples/scala-parallel-ecommercerecommendation``
+(ECommAlgorithm.scala — UNVERIFIED paths; SURVEY.md §2.5): implicit ALS on
+view events plus serve-time business logic:
+
+- known user  → personalized scores (user factor · item factors);
+- unknown/cold user → fallback to the user's most recent views (queried from
+  the *live* event store at predict time, like the reference's LEventStore
+  lookup), scored by cosine similarity;
+- ``unseen_only`` → exclude items the user has already seen (recent
+  view/buy events, live lookup);
+- "unavailable items" constraint entity: the latest ``$set`` on
+  ``constraint/unavailableItems`` (property ``items``) is honored at serve
+  time, so ops can pull items without retraining;
+- category / whiteList / blackList masks as in the Similar-Product template.
+
+TPU-first serving: all rules are boolean masks over one scores vector from a
+single matvec against the item-factor matrix.
+
+Query ``{"user": "u1", "num": 4, "categories": [...], "whiteList": [...],
+"blackList": [...]}`` → ``{"itemScores": [...]}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+import numpy as np
+
+from pio_tpu.controller import (
+    Algorithm,
+    Engine,
+    FirstServing,
+    Params,
+    register_engine,
+)
+from pio_tpu.data.bimap import BiMap
+from pio_tpu.models.als import ALSConfig, train_als
+from pio_tpu.parallel.context import ComputeContext
+from pio_tpu.storage import Storage
+from pio_tpu.templates.common import (
+    PredictedResult,
+    business_rule_mask,
+    l2_normalize_rows,
+    top_item_scores,
+)
+from pio_tpu.templates.similarproduct import (
+    PreparedData,
+    SimilarProductDataSource,
+    SimilarProductPreparator,
+)
+
+
+# ------------------------------------------------- data source / preparator
+# The e-commerce template reads the same training inputs as Similar-Product
+# (view edges + item categories); buy/seen handling happens at serve time
+# against the live event store, mirroring the reference's split.
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(
+    SimilarProductDataSource.params_class  # type: ignore[misc]
+):
+    pass
+
+
+class ECommerceDataSource(SimilarProductDataSource):
+    params_class = DataSourceParams
+
+
+class ECommercePreparator(SimilarProductPreparator):
+    pass
+
+
+# ----------------------------------------------------------------- algorithm
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str = ""
+    num: int = 10
+    categories: Tuple[str, ...] = ()
+    white_list: Tuple[str, ...] = ()
+    black_list: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    app_name: str = ""  # live event-store lookups at serve time
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+    #: exclude items the user has recently seen (view/buy)
+    unseen_only: bool = False
+    seen_events: Tuple[str, ...] = ("buy", "view")
+    #: events used for the cold-user fallback basket
+    similar_events: Tuple[str, ...] = ("view",)
+    #: how many recent events the serve-time lookups read
+    num_recent_events: int = 10
+
+
+@dataclasses.dataclass
+class ECommModel:
+    user_factors: np.ndarray  # [n_users, rank]
+    norm_item_factors: np.ndarray  # [n_items, rank], L2-normalized
+    item_factors: np.ndarray  # [n_items, rank], raw (personalized scores)
+    user_index: BiMap
+    item_index: BiMap
+    categories: List[FrozenSet[str]]
+    app_id: int
+
+
+class ECommAlgorithm(Algorithm):
+    """Implicit ALS + serve-time business rules
+    (≙ reference ECommAlgorithm)."""
+
+    params_class = ECommAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> ECommModel:
+        p: ECommAlgorithmParams = self.params
+        app = Storage.get_meta_data_apps().get_by_name(p.app_name)
+        if app is None:
+            raise ValueError(
+                f"ECommAlgorithm params need app_name (got {p.app_name!r})"
+            )
+        factors = train_als(
+            ctx,
+            pd.user_codes,
+            pd.item_codes,
+            np.ones(len(pd.item_codes), np.float32),
+            n_users=len(pd.user_index),
+            n_items=len(pd.item_index),
+            config=ALSConfig(
+                rank=p.rank,
+                iterations=p.num_iterations,
+                reg=p.lambda_,
+                implicit=True,
+                alpha=p.alpha,
+                seed=p.seed,
+            ),
+        )
+        f = factors.item_factors
+        return ECommModel(
+            user_factors=factors.user_factors,
+            norm_item_factors=l2_normalize_rows(f),
+            item_factors=f.astype(np.float32),
+            user_index=pd.user_index,
+            item_index=pd.item_index,
+            categories=pd.categories,
+            app_id=app.id,
+        )
+
+    # ------------------------------------------------ live event-store reads
+    def _recent_items(
+        self, model: ECommModel, user: str, event_names: Tuple[str, ...],
+        limit: int,
+    ) -> List[str]:
+        events = Storage.get_levents().find(
+            model.app_id,
+            entity_type="user",
+            entity_id=user,
+            event_names=list(event_names),
+            limit=limit,
+            reversed_order=True,
+        )
+        return [
+            e.target_entity_id for e in events if e.target_entity_id
+        ]
+
+    def _unavailable_items(self, model: ECommModel) -> Set[str]:
+        props = Storage.get_levents().aggregate_properties(
+            model.app_id, entity_type="constraint"
+        )
+        pm = props.get("unavailableItems")
+        if pm is None:
+            return set()
+        return set(pm.get_opt("items") or [])
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        p: ECommAlgorithmParams = self.params
+        ucode = model.user_index.get(query.user)
+        if ucode is not None:
+            scores = model.item_factors @ model.user_factors[ucode]
+        else:
+            # cold user: basket = recent views from the live event store
+            recent = self._recent_items(
+                model, query.user, p.similar_events, p.num_recent_events
+            )
+            codes = [
+                c
+                for c in (model.item_index.get(i) for i in recent)
+                if c is not None
+            ]
+            if not codes:
+                return PredictedResult()
+            basket = model.norm_item_factors[np.asarray(codes, np.int32)]
+            scores = model.norm_item_factors @ basket.mean(axis=0)
+
+        mask = business_rule_mask(
+            len(scores),
+            model.item_index,
+            model.categories,
+            categories=query.categories,
+            white_list=query.white_list,
+            black_list=query.black_list,
+        )
+        for i in self._unavailable_items(model):
+            c = model.item_index.get(i)
+            if c is not None:
+                mask[c] = False
+        if p.unseen_only:
+            for i in self._recent_items(
+                model, query.user, p.seen_events, p.num_recent_events
+            ):
+                c = model.item_index.get(i)
+                if c is not None:
+                    mask[c] = False
+
+        return top_item_scores(scores, mask, query.num, model.item_index)
+
+
+class ECommerceServing(FirstServing):
+    pass
+
+
+@register_engine("templates.ecommerce")
+def ecommerce_engine() -> Engine:
+    return Engine(
+        ECommerceDataSource,
+        ECommercePreparator,
+        {"ecomm": ECommAlgorithm},
+        ECommerceServing,
+    )

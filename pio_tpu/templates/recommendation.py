@@ -41,7 +41,7 @@ from pio_tpu.models.als import ALSConfig, ALSFactors, top_n, train_als
 from pio_tpu.parallel.context import ComputeContext
 from pio_tpu.storage import Storage
 from pio_tpu.storage.frame import EventFrame
-from pio_tpu.templates.common import resolve_app
+from pio_tpu.templates.common import ItemScore, PredictedResult, resolve_app
 
 
 # --------------------------------------------------------------- data source
@@ -177,24 +177,6 @@ class Query:
     user: str
     num: int = 10
     item: str = ""  # when set, score just this item (used by eval)
-
-
-@dataclasses.dataclass(frozen=True)
-class ItemScore:
-    item: str
-    score: float
-
-
-@dataclasses.dataclass(frozen=True)
-class PredictedResult:
-    item_scores: Tuple[ItemScore, ...] = ()
-
-    def to_dict(self) -> dict:
-        return {
-            "itemScores": [
-                {"item": s.item, "score": s.score} for s in self.item_scores
-            ]
-        }
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,251 @@
+"""Similar-Product template — items similar to a basket of query items.
+
+Rebuild of the reference's ``examples/scala-parallel-similarproduct``
+(DataSource.scala reads ``$set item`` entities with ``categories`` + user
+``view`` events; ALSAlgorithm.scala calls MLlib ``ALS.trainImplicit`` and
+answers queries by cosine similarity over ``productFeatures`` with
+category/whiteList/blackList filters — UNVERIFIED paths; SURVEY.md §2.5).
+
+TPU-first serving: item factors are L2-normalized once at train time, so a
+query is ``mean(normalized factors of basket) @ normalized_factorsᵀ`` — one
+MXU matvec over all items — followed by masked top-N. Business-rule filters
+(categories, white/black lists, the basket itself) become boolean masks on
+the score vector, not per-item Python loops.
+
+engine.json:
+
+    {
+      "id": "similarproduct",
+      "engineFactory": "templates.similarproduct",
+      "datasource": {"params": {"app_name": "myapp"}},
+      "algorithms": [{"name": "als", "params":
+          {"rank": 10, "num_iterations": 10, "lambda_": 0.01, "seed": 3}}]
+    }
+
+Query ``{"items": ["i1"], "num": 4, "categories": ["c"], "whiteList": [],
+"blackList": []}`` → ``{"itemScores": [{"item": "i5", "score": 0.9}, ...]}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+from pio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+    register_engine,
+)
+from pio_tpu.data.bimap import BiMap
+from pio_tpu.models.als import ALSConfig, train_als
+from pio_tpu.parallel.context import ComputeContext
+from pio_tpu.storage import Storage
+from pio_tpu.templates.common import (
+    ItemScore,
+    PredictedResult,
+    business_rule_mask,
+    l2_normalize_rows,
+    resolve_app,
+    top_item_scores,
+)
+
+
+# --------------------------------------------------------------- data source
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    app_id: int = 0
+    channel: str = ""
+    view_event: str = "view"
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    user_ids: np.ndarray  # [n] str objects (view edge sources)
+    item_ids: np.ndarray  # [n] str objects (view edge targets)
+    #: item entity id → categories (from $set item events)
+    item_categories: Dict[str, FrozenSet[str]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def sanity_check(self) -> None:
+        if len(self.item_ids) == 0:
+            raise ValueError(
+                "TrainingData is empty - no view events found. "
+                "Did you import events for this app?"
+            )
+
+    def __len__(self):
+        return len(self.item_ids)
+
+
+class SimilarProductDataSource(DataSource):
+    """View edges + item category properties
+    (≙ reference DataSource.readTraining)."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        p: DataSourceParams = self.params
+        app_id, channel_id = resolve_app(p)
+        pe = Storage.get_pevents()
+        frame = pe.find_frame(
+            app_id,
+            channel_id=channel_id,
+            event_names=[p.view_event],
+            entity_type="user",
+            target_entity_type="item",
+        )
+        props = pe.aggregate_properties(
+            app_id, entity_type="item", channel_id=channel_id
+        )
+        cats = {
+            eid: frozenset(pm.get_opt("categories") or [])
+            for eid, pm in props.items()
+        }
+        return TrainingData(
+            user_ids=frame.entity_id,
+            item_ids=frame.target_entity_id,
+            item_categories=cats,
+        )
+
+
+# --------------------------------------------------------------- preparator
+@dataclasses.dataclass
+class PreparedData:
+    user_index: BiMap
+    item_index: BiMap
+    user_codes: np.ndarray  # [n] int32
+    item_codes: np.ndarray  # [n] int32
+    #: per item code, the item's categories
+    categories: List[FrozenSet[str]] = dataclasses.field(default_factory=list)
+
+
+class SimilarProductPreparator(Preparator):
+    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
+        user_index = BiMap.string_int(td.user_ids.tolist())
+        # include items that only appear as $set entities so category-only
+        # items still get factor rows (cold but filterable)
+        all_items = td.item_ids.tolist() + sorted(td.item_categories)
+        item_index = BiMap.string_int(all_items)
+        ufwd, ifwd = user_index.to_dict(), item_index.to_dict()
+        user_codes = np.fromiter(
+            (ufwd[u] for u in td.user_ids.tolist()), np.int32, len(td)
+        )
+        item_codes = np.fromiter(
+            (ifwd[i] for i in td.item_ids.tolist()), np.int32, len(td)
+        )
+        inv = item_index.inverse
+        categories = [
+            td.item_categories.get(inv[c], frozenset())
+            for c in range(len(item_index))
+        ]
+        return PreparedData(
+            user_index, item_index, user_codes, item_codes, categories
+        )
+
+
+# ----------------------------------------------------------------- algorithm
+@dataclasses.dataclass(frozen=True)
+class Query:
+    items: Tuple[str, ...] = ()
+    num: int = 10
+    categories: Tuple[str, ...] = ()
+    white_list: Tuple[str, ...] = ()
+    black_list: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+
+
+@dataclasses.dataclass
+class SimilarProductModel:
+    #: L2-normalized item factors [n_items, rank]
+    norm_factors: np.ndarray
+    item_index: BiMap
+    categories: List[FrozenSet[str]]
+
+
+class SimilarProductAlgorithm(Algorithm):
+    """Implicit ALS + cosine over item factors
+    (≙ reference ALSAlgorithm.train → MLlib ALS.trainImplicit)."""
+
+    params_class = ALSAlgorithmParams
+    query_class = Query
+
+    def train(
+        self, ctx: ComputeContext, pd: PreparedData
+    ) -> SimilarProductModel:
+        p: ALSAlgorithmParams = self.params
+        factors = train_als(
+            ctx,
+            pd.user_codes,
+            pd.item_codes,
+            np.ones(len(pd.item_codes), np.float32),  # implicit: r=1 per view
+            n_users=len(pd.user_index),
+            n_items=len(pd.item_index),
+            config=ALSConfig(
+                rank=p.rank,
+                iterations=p.num_iterations,
+                reg=p.lambda_,
+                implicit=True,
+                alpha=p.alpha,
+                seed=p.seed,
+            ),
+        )
+        return SimilarProductModel(
+            l2_normalize_rows(factors.item_factors),
+            pd.item_index,
+            pd.categories,
+        )
+
+    def predict(
+        self, model: SimilarProductModel, query: Query
+    ) -> PredictedResult:
+        codes = [
+            c
+            for c in (model.item_index.get(i) for i in query.items)
+            if c is not None
+        ]
+        if not codes:
+            return PredictedResult()  # all query items unknown
+        basket = model.norm_factors[np.asarray(codes, np.int32)]
+        scores = model.norm_factors @ basket.mean(axis=0)
+
+        mask = business_rule_mask(
+            len(scores),
+            model.item_index,
+            model.categories,
+            categories=query.categories,
+            white_list=query.white_list,
+            black_list=query.black_list,
+        )
+        mask[np.asarray(codes, np.int32)] = False  # never return the basket
+        return top_item_scores(scores, mask, query.num, model.item_index)
+
+
+class SimilarProductServing(FirstServing):
+    pass
+
+
+@register_engine("templates.similarproduct")
+def similarproduct_engine() -> Engine:
+    return Engine(
+        SimilarProductDataSource,
+        SimilarProductPreparator,
+        {"als": SimilarProductAlgorithm},
+        SimilarProductServing,
+    )

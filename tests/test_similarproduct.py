@@ -1,0 +1,199 @@
+"""Similar-Product + E-Commerce template tests.
+
+Mirror the reference's similarproduct / ecommercerecommendation quickstart
+behavior (SURVEY.md §4): view events + item $set categories → implicit ALS →
+similar-item / personalized queries with business-rule filters.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+import pio_tpu.templates  # noqa: F401  (registers engine factories)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.storage import App, Storage
+from pio_tpu.templates import ecommerce, similarproduct
+from pio_tpu.workflow import (
+    build_engine,
+    load_models_for_instance,
+    run_train,
+    variant_from_dict,
+)
+
+
+@pytest.fixture(autouse=True)
+def isolated_storage(tmp_home):
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def _seed_views(app_id: int, n_users=12, n_items=8):
+    """Two view blocks: u0-5 view i0-3 ('tech'), u6-11 view i4-7 ('food')."""
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    for i in range(n_items):
+        cat = "tech" if i < 4 else "food"
+        le.insert(
+            Event(
+                "$set", "item", f"i{i}",
+                properties={"categories": [cat]},
+                event_time=t0,
+            ),
+            app_id,
+        )
+    k = 0
+    for u in range(n_users):
+        for i in range(n_items):
+            if (u < 6) == (i < 4):
+                le.insert(
+                    Event(
+                        "view", "user", f"u{u}", "item", f"i{i}",
+                        event_time=t0 + dt.timedelta(minutes=k),
+                    ),
+                    app_id,
+                )
+                k += 1
+
+
+def _train(factory, algo, app_name="sp-test"):
+    variant = variant_from_dict({
+        "id": "sp-e2e",
+        "engineFactory": factory,
+        "datasource": {"params": {"app_name": app_name}},
+        "algorithms": [algo],
+    })
+    engine, ep = build_engine(variant)
+    ctx = ComputeContext.create(seed=0)
+    instance_id = run_train(engine, ep, variant, ctx=ctx)
+    models = load_models_for_instance(instance_id, engine, ep, ctx)
+    serving = engine.make_serving(ep)
+    pairs = engine.algorithms_with_models(ep, models)
+
+    def serve(q):
+        return serving.serve(q, [a.predict(m, q) for a, m in pairs])
+
+    return serve
+
+
+SP_ALGO = {
+    "name": "als",
+    "params": {"rank": 6, "num_iterations": 10, "lambda_": 0.05, "seed": 1},
+}
+
+
+class TestSimilarProduct:
+    def _serve(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "sp-test"))
+        _seed_views(app_id)
+        return _train("templates.similarproduct", SP_ALGO)
+
+    def test_similar_items_stay_in_block(self):
+        serve = self._serve()
+        res = serve(similarproduct.Query(items=("i0",), num=3))
+        items = {s.item for s in res.item_scores}
+        assert items == {"i1", "i2", "i3"}  # same co-view block, sans i0
+        scores = [s.score for s in res.item_scores]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_category_filter(self):
+        serve = self._serve()
+        res = serve(
+            similarproduct.Query(items=("i0",), num=8, categories=("food",))
+        )
+        assert {s.item for s in res.item_scores} <= {"i4", "i5", "i6", "i7"}
+
+    def test_white_and_black_list(self):
+        serve = self._serve()
+        res = serve(
+            similarproduct.Query(
+                items=("i0",), num=8,
+                white_list=("i1", "i2"), black_list=("i2",),
+            )
+        )
+        assert {s.item for s in res.item_scores} == {"i1"}
+
+    def test_unknown_basket_empty(self):
+        serve = self._serve()
+        assert serve(similarproduct.Query(items=("nope",))).item_scores == ()
+
+    def test_multi_item_basket(self):
+        serve = self._serve()
+        res = serve(similarproduct.Query(items=("i4", "i5"), num=2))
+        assert {s.item for s in res.item_scores} == {"i6", "i7"}
+
+
+EC_ALGO = {
+    "name": "ecomm",
+    "params": {
+        "app_name": "ec-test",
+        "rank": 6,
+        "num_iterations": 10,
+        "lambda_": 0.05,
+        "seed": 1,
+    },
+}
+
+
+class TestECommerce:
+    def _setup(self, algo=EC_ALGO):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "ec-test"))
+        _seed_views(app_id)
+        return app_id, _train("templates.ecommerce", algo, app_name="ec-test")
+
+    def test_personalized_block(self):
+        _, serve = self._setup()
+        res = serve(ecommerce.Query(user="u0", num=4))
+        assert {s.item for s in res.item_scores} == {"i0", "i1", "i2", "i3"}
+
+    def test_cold_user_falls_back_to_recent_views(self):
+        app_id, serve = self._setup()
+        # "newbie" never made it into training, but viewed food items since
+        le = Storage.get_levents()
+        t = dt.datetime(2026, 3, 2, tzinfo=dt.timezone.utc)
+        for i in (4, 5):
+            le.insert(
+                Event("view", "user", "newbie", "item", f"i{i}",
+                      event_time=t),
+                app_id,
+            )
+        res = serve(ecommerce.Query(user="newbie", num=8))
+        assert res.item_scores  # fallback produced recs
+        top2 = {s.item for s in res.item_scores[:2]}
+        assert top2 <= {"i4", "i5", "i6", "i7"}
+
+    def test_cold_user_no_history_empty(self):
+        _, serve = self._setup()
+        assert serve(ecommerce.Query(user="ghost")).item_scores == ()
+
+    def test_unavailable_items_filtered_live(self):
+        app_id, serve = self._setup()
+        res = serve(ecommerce.Query(user="u0", num=4))
+        assert "i0" in {s.item for s in res.item_scores}
+        # ops marks i0 unavailable — no retrain needed
+        Storage.get_levents().insert(
+            Event(
+                "$set", "constraint", "unavailableItems",
+                properties={"items": ["i0"]},
+                event_time=dt.datetime(2026, 3, 3, tzinfo=dt.timezone.utc),
+            ),
+            app_id,
+        )
+        res = serve(ecommerce.Query(user="u0", num=4))
+        assert "i0" not in {s.item for s in res.item_scores}
+
+    def test_unseen_only_excludes_seen(self):
+        algo = dict(EC_ALGO, params=dict(
+            EC_ALGO["params"], unseen_only=True, num_recent_events=50
+        ))
+        _, serve = self._setup(algo)
+        # u0 has viewed i0..i3 → with unseen_only those are excluded
+        res = serve(ecommerce.Query(user="u0", num=8))
+        assert {s.item for s in res.item_scores} <= {"i4", "i5", "i6", "i7"}
+
+    def test_blacklist(self):
+        _, serve = self._setup()
+        res = serve(ecommerce.Query(user="u0", num=4, black_list=("i1",)))
+        assert "i1" not in {s.item for s in res.item_scores}
