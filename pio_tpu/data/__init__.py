@@ -10,6 +10,12 @@ from pio_tpu.data.datamap import DataMap, PropertyMap
 from pio_tpu.data.event import Event, EventValidationError, validate_event
 from pio_tpu.data.bimap import BiMap
 from pio_tpu.data.aggregation import aggregate_properties, fold_properties
+from pio_tpu.data.cleaning import (
+    EventWindow,
+    SelfCleaningDataSource,
+    clean_events,
+    parse_duration,
+)
 
 __all__ = [
     "DataMap",
@@ -20,4 +26,8 @@ __all__ = [
     "BiMap",
     "aggregate_properties",
     "fold_properties",
+    "EventWindow",
+    "SelfCleaningDataSource",
+    "clean_events",
+    "parse_duration",
 ]

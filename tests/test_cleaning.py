@@ -1,0 +1,184 @@
+"""SelfCleaningDataSource compaction tests (reference core/SelfCleaningDataSource).
+
+Key invariant: a compacted store aggregates to the SAME PropertyMaps as the
+original stream — compression must be semantically invisible to serving.
+"""
+
+import datetime as dt
+
+import pytest
+
+from pio_tpu.data import (
+    Event,
+    EventWindow,
+    aggregate_properties,
+    clean_events,
+    parse_duration,
+)
+from pio_tpu.data.cleaning import SelfCleaningDataSource
+from pio_tpu.storage import App, Storage
+
+T0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+
+
+def _t(minutes):
+    return T0 + dt.timedelta(minutes=minutes)
+
+
+class TestParseDuration:
+    @pytest.mark.parametrize(
+        "text,seconds",
+        [
+            ("30 days", 30 * 86400),
+            ("12h", 12 * 3600),
+            ("90 minutes", 5400),
+            ("1 week", 604800),
+            ("45s", 45),
+        ],
+    )
+    def test_ok(self, text, seconds):
+        assert parse_duration(text).total_seconds() == seconds
+
+    def test_bad(self):
+        with pytest.raises(ValueError):
+            parse_duration("fortnight")
+
+
+class TestCleanEvents:
+    def test_duration_drops_old_plain_events(self):
+        events = [
+            Event("view", "user", "u1", "item", "i1", event_time=_t(0)),
+            Event("view", "user", "u1", "item", "i2", event_time=_t(100)),
+        ]
+        out = clean_events(
+            events,
+            EventWindow(duration="30 minutes"),
+            now=_t(120),
+        )
+        assert [e.target_entity_id for e in out] == ["i2"]
+
+    def test_duration_does_not_touch_special_events(self):
+        events = [
+            Event("$set", "user", "u1", properties={"a": 1},
+                  event_time=_t(0)),
+            Event("view", "user", "u1", "item", "i1", event_time=_t(0)),
+        ]
+        out = clean_events(
+            events, EventWindow(duration="1 minutes"), now=_t(120)
+        )
+        assert [e.event for e in out] == ["$set"]
+
+    def test_compress_folds_set_chain(self):
+        events = [
+            Event("$set", "user", "u1", properties={"a": 1, "b": 1},
+                  event_time=_t(0)),
+            Event("$set", "user", "u1", properties={"a": 2},
+                  event_time=_t(1)),
+            Event("$unset", "user", "u1", properties={"b": None},
+                  event_time=_t(2)),
+        ]
+        out = clean_events(events, EventWindow(compress_properties=True))
+        assert len(out) == 1
+        e = out[0]
+        assert e.event == "$set"
+        assert e.properties.to_dict() == {"a": 2}
+        assert e.event_time == _t(2)  # last_updated watermark preserved
+
+    def test_compress_drops_deleted_entities(self):
+        events = [
+            Event("$set", "user", "u1", properties={"a": 1},
+                  event_time=_t(0)),
+            Event("$delete", "user", "u1", event_time=_t(1)),
+        ]
+        out = clean_events(events, EventWindow(compress_properties=True))
+        assert out == []
+
+    def test_compress_preserves_aggregation_semantics(self):
+        events = [
+            Event("$set", "user", "u1", properties={"a": 1, "b": 2},
+                  event_time=_t(0)),
+            Event("$unset", "user", "u1", properties={"a": None},
+                  event_time=_t(1)),
+            Event("$set", "user", "u2", properties={"x": "y"},
+                  event_time=_t(2)),
+            Event("$delete", "user", "u3", event_time=_t(3)),
+        ]
+        before = aggregate_properties(events)
+        after = aggregate_properties(
+            clean_events(events, EventWindow(compress_properties=True))
+        )
+        assert {k: v.to_dict() for k, v in before.items()} == {
+            k: v.to_dict() for k, v in after.items()
+        }
+
+    def test_remove_duplicates_list_properties(self):
+        # list/dict-valued properties must hash via the canonical JSON key
+        e = Event("$set", "item", "i1",
+                  properties={"categories": ["a", "b"]}, event_time=_t(0))
+        out = clean_events([e, e], EventWindow(remove_duplicates=True))
+        assert len(out) == 1
+
+    def test_remove_duplicates(self):
+        e = dict(event_time=_t(0))
+        events = [
+            Event("view", "user", "u1", "item", "i1", **e),
+            Event("view", "user", "u1", "item", "i1", **e),
+            Event("view", "user", "u1", "item", "i2", **e),
+        ]
+        out = clean_events(events, EventWindow(remove_duplicates=True))
+        assert len(out) == 2
+
+    def test_no_window_flags_is_identity(self):
+        events = [
+            Event("view", "user", "u1", "item", "i1", event_time=_t(1)),
+            Event("$set", "user", "u1", properties={"a": 1},
+                  event_time=_t(0)),
+        ]
+        out = clean_events(events, EventWindow())
+        assert [e.event for e in out] == ["$set", "view"]  # time-sorted
+
+
+class TestSelfCleaningDataSource:
+    def test_cleans_persisted_store(self, tmp_home):
+        Storage.reset()
+        try:
+            app_id = Storage.get_meta_data_apps().insert(App(0, "clean-test"))
+            le = Storage.get_levents()
+            le.insert(Event("$set", "user", "u1", properties={"a": 1},
+                            event_time=_t(0)), app_id)
+            le.insert(Event("$set", "user", "u1", properties={"a": 2},
+                            event_time=_t(1)), app_id)
+            le.insert(Event("view", "user", "u1", "item", "i1",
+                            event_time=_t(0)), app_id)
+            le.insert(Event("view", "user", "u1", "item", "i2",
+                            event_time=_t(100)), app_id)
+
+            ds = SelfCleaningDataSource()
+            ds.event_window = EventWindow(
+                duration="30 minutes", compress_properties=True
+            )
+            removed = ds.clean_persisted_events(app_id, now=_t(120))
+            assert removed == 2  # old view + one folded $set
+
+            left = Storage.get_pevents().find(app_id)
+            by_event = sorted(e.event for e in left)
+            assert by_event == ["$set", "view"]
+            props = Storage.get_pevents().aggregate_properties(
+                app_id, entity_type="user"
+            )
+            assert props["u1"].to_dict() == {"a": 2}
+        finally:
+            Storage.reset()
+
+    def test_no_window_noop(self, tmp_home):
+        Storage.reset()
+        try:
+            app_id = Storage.get_meta_data_apps().insert(App(0, "clean-test"))
+            Storage.get_levents().insert(
+                Event("view", "user", "u1", "item", "i1", event_time=_t(0)),
+                app_id,
+            )
+            assert SelfCleaningDataSource().clean_persisted_events(app_id) == 0
+            assert len(Storage.get_pevents().find(app_id)) == 1
+        finally:
+            Storage.reset()
