@@ -4,18 +4,39 @@ Rebuild of the reference's ``data/.../data/api/EventServer.scala`` and
 ``core/.../workflow/CreateServer.scala`` (UNVERIFIED paths; see SURVEY.md).
 """
 
+from pio_tpu.server.admin import AdminService, create_admin_server
+from pio_tpu.server.plugins import (
+    EngineServerPlugin,
+    EventServerPlugin,
+    clear_plugins,
+    installed_plugins,
+    load_plugins_from_env,
+    register_plugin,
+)
+from pio_tpu.server.dashboard import DashboardService, create_dashboard
 from pio_tpu.server.event_server import EventServerService, create_event_server
-from pio_tpu.server.http import JsonHTTPServer, Router
+from pio_tpu.server.http import JsonHTTPServer, RawResponse, Router
 from pio_tpu.server.query_server import (
     QueryServerService,
     create_query_server,
 )
 
 __all__ = [
+    "AdminService",
+    "DashboardService",
+    "EngineServerPlugin",
+    "EventServerPlugin",
+    "clear_plugins",
+    "installed_plugins",
+    "load_plugins_from_env",
+    "register_plugin",
     "EventServerService",
     "JsonHTTPServer",
     "QueryServerService",
+    "RawResponse",
     "Router",
+    "create_admin_server",
+    "create_dashboard",
     "create_event_server",
     "create_query_server",
 ]

@@ -1,0 +1,121 @@
+"""Dashboard server — evaluation results UI.
+
+Rebuild of the reference's ``tools/.../tools/dashboard/`` (Dashboard.scala,
+DashboardService + Twirl templates, CORS support — UNVERIFIED paths;
+SURVEY.md §2.4): a web UI listing completed ``EvaluationInstances`` newest
+first with metric scores and the parameters that produced them.
+
+Routes:
+
+- ``GET /``                      — HTML table of completed evaluations;
+- ``GET /instances.json``        — same data as JSON;
+- ``GET /instances/<id>.json``   — one instance incl. full evaluator results;
+- ``GET /instances/<id>.html``   — the instance's stored HTML report.
+
+All responses carry ``Access-Control-Allow-Origin: *`` (reference
+``CorsSupport``).
+"""
+
+from __future__ import annotations
+
+import html as _html
+import json
+from typing import Any, Tuple
+
+from pio_tpu.server.http import JsonHTTPServer, RawResponse, Request, Router
+from pio_tpu.storage import RunStatus, Storage
+
+_CORS = {"Access-Control-Allow-Origin": "*"}
+
+
+def _html_response(page: str) -> RawResponse:
+    return RawResponse(page, headers=dict(_CORS))
+
+
+def _instance_summary(inst) -> dict:
+    return {
+        "id": inst.id,
+        "status": inst.status,
+        "startTime": inst.start_time.isoformat(),
+        "endTime": inst.end_time.isoformat(),
+        "evaluationClass": inst.evaluation_class,
+        "engineParamsGeneratorClass": inst.engine_params_generator_class,
+        "batch": inst.batch,
+        "evaluatorResults": inst.evaluator_results,
+    }
+
+
+class DashboardService:
+    """≙ reference ``DashboardService`` routes."""
+
+    def __init__(self):
+        self.router = Router()
+        self.router.add("GET", "/", self.index)
+        self.router.add("GET", "/instances\\.json", self.list_json)
+        self.router.add("GET", "/instances/([^/]+)\\.json", self.get_json)
+        self.router.add("GET", "/instances/([^/]+)\\.html", self.get_html)
+
+    def _completed(self):
+        return Storage.get_meta_data_evaluation_instances().get_completed()
+
+    def index(self, req: Request) -> Tuple[int, Any]:
+        rows = []
+        for i in self._completed():
+            rows.append(
+                "<tr>"
+                f"<td><a href='/instances/{_html.escape(i.id)}.html'>"
+                f"{_html.escape(i.id)}</a></td>"
+                f"<td>{_html.escape(i.evaluation_class)}</td>"
+                f"<td>{_html.escape(i.start_time.isoformat())}</td>"
+                f"<td>{_html.escape(i.end_time.isoformat())}</td>"
+                f"<td>{_html.escape(i.evaluator_results)}</td>"
+                "</tr>"
+            )
+        page = (
+            "<!doctype html><html><head><title>pio-tpu dashboard</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+            "padding:.4em .8em;text-align:left}</style></head><body>"
+            "<h1>Evaluation Dashboard</h1>"
+            "<table><tr><th>Instance</th><th>Evaluation</th><th>Start</th>"
+            "<th>End</th><th>Result</th></tr>"
+            + "".join(rows)
+            + "</table></body></html>"
+        )
+        return 200, _html_response(page)
+
+    def list_json(self, req: Request) -> Tuple[int, Any]:
+        return 200, [_instance_summary(i) for i in self._completed()]
+
+    def _find(self, instance_id: str):
+        return Storage.get_meta_data_evaluation_instances().get(instance_id)
+
+    def get_json(self, req: Request) -> Tuple[int, Any]:
+        inst = self._find(req.path_args[0])
+        if inst is None:
+            return 404, {"message": "evaluation instance not found"}
+        out = _instance_summary(inst)
+        try:
+            out["results"] = json.loads(inst.evaluator_results_json or "null")
+        except json.JSONDecodeError:
+            out["results"] = None
+        return 200, out
+
+    def get_html(self, req: Request) -> Tuple[int, Any]:
+        inst = self._find(req.path_args[0])
+        if inst is None:
+            return 404, {"message": "evaluation instance not found"}
+        body = inst.evaluator_results_html or (
+            "<html><body><pre>"
+            + _html.escape(inst.evaluator_results_json or "(no results)")
+            + "</pre></body></html>"
+        )
+        return 200, _html_response(body)
+
+
+def create_dashboard(
+    host: str = "0.0.0.0", port: int = 9000
+) -> JsonHTTPServer:
+    """Build (unstarted) dashboard — reference ``Dashboard.main``."""
+    service = DashboardService()
+    return JsonHTTPServer(service.router, host, port, name="pio-tpu-dashboard")

@@ -43,6 +43,15 @@ class Request:
         return key
 
 
+@dataclass
+class RawResponse:
+    """Non-JSON handler output (HTML pages, plain text, extra headers)."""
+
+    body: str
+    content_type: str = "text/html; charset=UTF-8"
+    headers: Dict[str, str] = field(default_factory=dict)
+
+
 Handler = Callable[[Request], Tuple[int, Any]]
 
 
@@ -82,6 +91,16 @@ def _make_handler_class(router: Router, server_name: str):
             log.debug("%s %s", self.address_string(), fmt % args)
 
         def _respond(self, status: int, body: Any):
+            if isinstance(body, RawResponse):
+                payload = body.body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", body.content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in body.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+                return
             try:
                 payload = json.dumps(body).encode() if body is not None else b""
             except (TypeError, ValueError):

@@ -246,6 +246,32 @@ def cmd_eventserver(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    from pio_tpu.server import create_dashboard
+
+    server = create_dashboard(host=args.ip, port=args.port)
+    _out(f"Dashboard listening on {args.ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("shutting down")
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from pio_tpu.server import create_admin_server
+
+    server = create_admin_server(
+        host=args.ip, port=args.port, admin_key=args.admin_key
+    )
+    _out(f"Admin API listening on {args.ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("shutting down")
+    return 0
+
+
 def cmd_deploy(args) -> int:
     from pio_tpu.server import create_query_server
 
@@ -448,6 +474,21 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--ip", default="0.0.0.0")
     a.add_argument("--port", type=int, default=7070)
     a.set_defaults(fn=cmd_eventserver)
+
+    a = sub.add_parser("dashboard", help="run the evaluation dashboard")
+    a.add_argument("--ip", default="0.0.0.0")
+    a.add_argument("--port", type=int, default=9000)
+    a.set_defaults(fn=cmd_dashboard)
+
+    a = sub.add_parser("adminserver", help="run the admin REST API")
+    a.add_argument("--ip", default="0.0.0.0")
+    a.add_argument("--port", type=int, default=7071)
+    a.add_argument(
+        "--admin-key", default=None,
+        help="access key required for mutating routes; without one they "
+             "are loopback-only",
+    )
+    a.set_defaults(fn=cmd_adminserver)
 
     a = sub.add_parser("import", help="import JSON-lines events")
     a.add_argument("--app", required=True)

@@ -1,0 +1,148 @@
+"""Dashboard + Admin API route tests over real HTTP (reference
+tools/dashboard + tools/admin; SURVEY.md §2.4)."""
+
+import datetime as dt
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pio_tpu.data import Event
+from pio_tpu.server import create_admin_server, create_dashboard
+from pio_tpu.storage import App, RunStatus, Storage
+from pio_tpu.storage.records import EvaluationInstance
+
+
+@pytest.fixture(autouse=True)
+def isolated_storage(tmp_home):
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def http(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            if "json" in ctype:
+                return resp.status, json.loads(raw or b"null"), resp.headers
+            return resp.status, raw.decode(), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), e.headers
+
+
+def _eval_instance(iid, status=RunStatus.COMPLETED, **kw):
+    t = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    return EvaluationInstance(
+        id=iid,
+        status=status,
+        start_time=t,
+        end_time=t + dt.timedelta(minutes=5),
+        evaluation_class="my.Evaluation",
+        evaluator_results=kw.get("results", "metric=0.9"),
+        evaluator_results_json=kw.get("json_", '{"best": {"score": 0.9}}'),
+        evaluator_results_html=kw.get("html", "<html><b>ok</b></html>"),
+    )
+
+
+@pytest.fixture()
+def dashboard():
+    server = create_dashboard(host="127.0.0.1", port=0).start()
+    yield f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+@pytest.fixture()
+def admin():
+    server = create_admin_server(host="127.0.0.1", port=0).start()
+    yield f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+class TestDashboard:
+    def test_index_lists_completed_only(self, dashboard):
+        evals = Storage.get_meta_data_evaluation_instances()
+        evals.insert(_eval_instance("done-1"))
+        evals.insert(_eval_instance("running-1", status=RunStatus.RUNNING))
+        status, body, headers = http("GET", dashboard + "/")
+        assert status == 200
+        assert "done-1" in body and "running-1" not in body
+        assert headers["Access-Control-Allow-Origin"] == "*"
+        assert "text/html" in headers["Content-Type"]
+
+    def test_instances_json(self, dashboard):
+        Storage.get_meta_data_evaluation_instances().insert(
+            _eval_instance("done-2")
+        )
+        status, body, _ = http("GET", dashboard + "/instances.json")
+        assert status == 200
+        assert [i["id"] for i in body] == ["done-2"]
+        assert body[0]["evaluationClass"] == "my.Evaluation"
+
+    def test_instance_detail_json_and_html(self, dashboard):
+        Storage.get_meta_data_evaluation_instances().insert(
+            _eval_instance("d3")
+        )
+        status, body, _ = http("GET", dashboard + "/instances/d3.json")
+        assert status == 200
+        assert body["results"] == {"best": {"score": 0.9}}
+        status, page, _ = http("GET", dashboard + "/instances/d3.html")
+        assert status == 200 and "<b>ok</b>" in page
+
+    def test_missing_instance_404(self, dashboard):
+        status, _, _ = http("GET", dashboard + "/instances/nope.json")
+        assert status == 404
+
+
+class TestAdmin:
+    def test_alive(self, admin):
+        status, body, _ = http("GET", admin + "/")
+        assert status == 200 and body["status"] == "alive"
+
+    def test_status_ok(self, admin):
+        status, body, _ = http("GET", admin + "/cmd/status")
+        assert status == 200 and body["status"] == "ok"
+
+    def test_app_lifecycle(self, admin):
+        # create
+        status, body, _ = http("POST", admin + "/cmd/app", {"name": "shop"})
+        assert status == 201
+        assert body["name"] == "shop" and len(body["accessKeys"]) == 1
+        # duplicate rejected
+        status, _, _ = http("POST", admin + "/cmd/app", {"name": "shop"})
+        assert status == 409
+        # list
+        status, body, _ = http("GET", admin + "/cmd/app")
+        assert [a["name"] for a in body["apps"]] == ["shop"]
+        assert len(body["apps"][0]["accessKeys"]) == 1
+        # seed an event, then data-delete clears it
+        app = Storage.get_meta_data_apps().get_by_name("shop")
+        Storage.get_levents().insert(
+            Event("view", "user", "u1", "item", "i1"), app.id
+        )
+        status, _, _ = http("DELETE", admin + f"/cmd/app/shop/data")
+        assert status == 200
+        assert Storage.get_pevents().find(app.id) == []
+        # full delete removes the app
+        status, _, _ = http("DELETE", admin + "/cmd/app/shop")
+        assert status == 200
+        assert Storage.get_meta_data_apps().get_by_name("shop") is None
+
+    def test_bad_create_body(self, admin):
+        status, _, _ = http("POST", admin + "/cmd/app", {"nom": "x"})
+        assert status == 400
+
+    def test_non_numeric_id_is_400(self, admin):
+        status, body, _ = http(
+            "POST", admin + "/cmd/app", {"name": "x", "id": "abc"}
+        )
+        assert status == 400 and "integer" in body["message"]
+
+    def test_delete_missing_app_404(self, admin):
+        status, _, _ = http("DELETE", admin + "/cmd/app/ghost")
+        assert status == 404
