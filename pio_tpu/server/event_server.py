@@ -96,6 +96,7 @@ class EventServerService:
         r.add("GET", "/stats\\.json", self.get_stats)
         r.add("POST", "/webhooks/([^/]+)\\.json", self.webhook_json)
         r.add("POST", "/webhooks/([^/]+)\\.form", self.webhook_form)
+        r.add("GET", "/plugins\\.json", self.list_plugins)
 
     # -- auth ---------------------------------------------------------------
     def _auth(self, req: Request) -> Tuple[int, Optional[int], tuple]:
@@ -132,7 +133,11 @@ class EventServerService:
         event = Event.from_api_dict(d)
         self._check_whitelist(event.event, whitelist)
         for blocker in INPUT_BLOCKERS:
-            blocker(app_id, channel_id, d)
+            try:
+                blocker(app_id, channel_id, d)
+            except ValueError as e:
+                # input blockers veto with ValueError → client 400
+                raise EventValidationError(str(e))
         event_id = Storage.get_levents().insert(event, app_id, channel_id)
         for sniffer in INPUT_SNIFFERS:
             try:
@@ -223,6 +228,11 @@ class EventServerService:
         )
         return 200, [e.to_api_dict() for e in events]
 
+    def list_plugins(self, req: Request):
+        from pio_tpu.server.plugins import installed_plugins
+
+        return 200, installed_plugins()
+
     def get_stats(self, req: Request):
         return 200, self.stats.to_dict()
 
@@ -262,5 +272,8 @@ def create_event_server(
     host: str = "0.0.0.0", port: int = 7070
 ) -> JsonHTTPServer:
     """Build (unstarted) server — reference ``EventServer.createEventServer``."""
+    from pio_tpu.server.plugins import load_plugins_from_env
+
+    load_plugins_from_env()
     service = EventServerService()
     return JsonHTTPServer(service.router, host, port, name="pio-tpu-eventserver")

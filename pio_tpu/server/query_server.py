@@ -114,6 +114,7 @@ class QueryServerService:
         r.add("GET", "/stats\\.json", self.get_stats)
         r.add("POST", "/reload", self.reload)
         r.add("POST", "/undeploy", self.undeploy)
+        r.add("GET", "/plugins\\.json", self.list_plugins)
 
     # -- engine/model lifecycle --------------------------------------------
     def _load(self, instance_id: Optional[str]) -> None:
@@ -157,6 +158,11 @@ class QueryServerService:
         except ParamsError as e:
             raise HTTPError(400, str(e))
 
+    def list_plugins(self, req: Request):
+        from pio_tpu.server.plugins import installed_plugins
+
+        return 200, installed_plugins()
+
     def query(self, req: Request):
         if not self._deployed:
             raise HTTPError(503, "undeployed")
@@ -169,7 +175,11 @@ class QueryServerService:
                 pairs, serving, qc = self.pairs, self.serving, self.query_class
             query = self._parse_query(req.body, qc)
             for blocker in QUERY_BLOCKERS:
-                blocker(req.body)
+                try:
+                    blocker(req.body)
+                except ValueError as e:
+                    # output blockers veto with ValueError → client 400
+                    raise HTTPError(400, str(e))
             query = serving.supplement(query)
             predictions = [algo.predict(m, query) for algo, m in pairs]
             result = serving.serve(query, predictions)
@@ -243,6 +253,9 @@ def create_query_server(
     feedback_app_id: Optional[int] = None,
     admin_key: Optional[str] = None,
 ) -> Tuple[JsonHTTPServer, QueryServerService]:
+    from pio_tpu.server.plugins import load_plugins_from_env
+
+    load_plugins_from_env()
     service = QueryServerService(
         variant, instance_id, ctx, feedback, feedback_app_id, admin_key
     )

@@ -1,0 +1,223 @@
+"""Server plugin hook tests (reference EventServerPlugin/EngineServerPlugin)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pio_tpu.templates  # noqa: F401
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.server import (
+    EngineServerPlugin,
+    EventServerPlugin,
+    clear_plugins,
+    create_event_server,
+    create_query_server,
+    installed_plugins,
+    register_plugin,
+)
+from pio_tpu.server.plugins import (
+    INPUT_BLOCKER,
+    OUTPUT_BLOCKER,
+    OUTPUT_SNIFFER,
+    load_plugins_from_env,
+)
+from pio_tpu.storage import AccessKey, App, Storage
+from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+
+@pytest.fixture(autouse=True)
+def isolated(tmp_home):
+    Storage.reset()
+    clear_plugins()
+    yield
+    clear_plugins()
+    Storage.reset()
+
+
+def http(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+class Blocklist(EventServerPlugin):
+    plugin_name = "blocklist"
+    plugin_description = "rejects banned entity ids"
+    plugin_type = INPUT_BLOCKER
+
+    def __init__(self):
+        self.seen = []
+
+    def process(self, event, app_id, channel_id):
+        self.seen.append(event.get("entityId"))
+        if event.get("entityId") == "banned":
+            raise ValueError("entity is banned")
+
+
+class ResponseTap(EngineServerPlugin):
+    plugin_name = "tap"
+    plugin_description = "records responses"
+    plugin_type = OUTPUT_SNIFFER
+
+    def __init__(self):
+        self.outputs = []
+
+    def process(self, query, prediction):
+        self.outputs.append((query, prediction))
+
+
+class TestEventServerPlugins:
+    def test_input_blocker_rejects(self):
+        plugin = Blocklist()
+        register_plugin(plugin)
+        app_id = Storage.get_meta_data_apps().insert(App(0, "plg"))
+        key = Storage.get_meta_data_access_keys().insert(AccessKey("", app_id))
+        server = create_event_server(host="127.0.0.1", port=0).start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            ok = {"event": "view", "entityType": "user", "entityId": "fine"}
+            status, _ = http("POST", f"{base}/events.json?accessKey={key}", ok)
+            assert status == 201
+            bad = {"event": "view", "entityType": "user", "entityId": "banned"}
+            status, body = http(
+                "POST", f"{base}/events.json?accessKey={key}", bad
+            )
+            assert status == 400 and "banned" in body["message"]
+            assert plugin.seen == ["fine", "banned"]
+            # nothing persisted for the blocked event
+            assert len(Storage.get_pevents().find(app_id)) == 1
+            # plugins listed
+            status, listing = http("GET", f"{base}/plugins.json")
+            assert listing["eventServerPlugins"][0]["name"] == "blocklist"
+        finally:
+            server.stop()
+
+
+class TestEngineServerPlugins:
+    def test_output_sniffer_sees_responses(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "plg-q"))
+        le = Storage.get_levents()
+        import datetime as dt
+
+        t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+        for u in range(4):
+            for i in range(4):
+                if (u < 2) == (i < 2):
+                    le.insert(
+                        Event("rate", "user", f"u{u}", "item", f"i{i}",
+                              properties={"rating": 5.0},
+                              event_time=t0),
+                        app_id,
+                    )
+        variant = variant_from_dict({
+            "id": "plg-e2e",
+            "engineFactory": "templates.recommendation",
+            "datasource": {"params": {"app_name": "plg-q"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 4, "num_iterations": 5, "lambda_": 0.1}}],
+        })
+        engine, ep = build_engine(variant)
+        run_train(engine, ep, variant, ctx=ComputeContext.create(seed=0))
+
+        tap = ResponseTap()
+        register_plugin(tap)
+        server, _service = create_query_server(
+            variant, host="127.0.0.1", port=0
+        )
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            status, body = http(
+                "POST", f"{base}/queries.json", {"user": "u0", "num": 2}
+            )
+            assert status == 200 and body["itemScores"]
+            assert len(tap.outputs) == 1
+            query, out = tap.outputs[0]
+            assert query == {"user": "u0", "num": 2}
+            assert out["itemScores"]
+            status, listing = http("GET", f"{base}/plugins.json")
+            assert listing["engineServerPlugins"][0]["name"] == "tap"
+        finally:
+            server.stop()
+
+
+class QueryVeto(EngineServerPlugin):
+    plugin_name = "veto"
+    plugin_type = OUTPUT_BLOCKER
+
+    def process(self, query, prediction):
+        if isinstance(query, dict) and query.get("user") == "blocked":
+            raise ValueError("user is blocked")
+
+
+class TestOutputBlocker:
+    def test_veto_is_client_400(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "plg-b"))
+        le = Storage.get_levents()
+        import datetime as dt
+
+        t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+        for u in range(4):
+            for i in range(4):
+                le.insert(
+                    Event("rate", "user", f"u{u}", "item", f"i{i}",
+                          properties={"rating": 3.0}, event_time=t0),
+                    app_id,
+                )
+        variant = variant_from_dict({
+            "id": "plg-b",
+            "engineFactory": "templates.recommendation",
+            "datasource": {"params": {"app_name": "plg-b"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 4, "num_iterations": 3, "lambda_": 0.1}}],
+        })
+        engine, ep = build_engine(variant)
+        run_train(engine, ep, variant, ctx=ComputeContext.create(seed=0))
+        register_plugin(QueryVeto())
+        server, _svc = create_query_server(variant, host="127.0.0.1", port=0)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            status, body = http(
+                "POST", f"{base}/queries.json", {"user": "blocked"}
+            )
+            assert status == 400 and "blocked" in body["message"]
+            status, _ = http(
+                "POST", f"{base}/queries.json", {"user": "u0"}
+            )
+            assert status == 200
+        finally:
+            server.stop()
+
+
+class TestEnvDiscovery:
+    def test_load_plugins_from_env(self, monkeypatch, tmp_path):
+        mod = tmp_path / "my_test_plugin.py"
+        mod.write_text(
+            "from pio_tpu.server import EventServerPlugin, register_plugin\n"
+            "class P(EventServerPlugin):\n"
+            "    plugin_name = 'envp'\n"
+            "    def process(self, event, app_id, channel_id):\n"
+            "        pass\n"
+            "register_plugin(P())\n"
+        )
+        monkeypatch.syspath_prepend(str(tmp_path))
+        monkeypatch.setenv("PIO_TPU_PLUGINS", "my_test_plugin")
+        loaded = load_plugins_from_env()
+        assert loaded == ["my_test_plugin"]
+        names = [
+            p["name"] for p in installed_plugins()["eventServerPlugins"]
+        ]
+        assert "envp" in names
+
+    def test_bad_module_is_logged_not_fatal(self, monkeypatch):
+        monkeypatch.setenv("PIO_TPU_PLUGINS", "definitely_not_a_module")
+        assert load_plugins_from_env() == []
