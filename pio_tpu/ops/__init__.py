@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the framework's sparse hot paths.
+
+The reference's compute substrate (Spark/MLlib) has no custom kernels — its
+hot loops are RDD shuffles and JVM math. Here the XLA-resistant ops get
+hand-written Pallas TPU kernels with plain-XLA fallbacks for CPU:
+
+- :func:`embedding_bag` — weighted embedding-bag lookup (TF-IDF × table,
+  feature-bag × table) streaming rows HBM→VMEM via an async-DMA ring.
+"""
+
+from pio_tpu.ops.embedding import embedding_bag, pack_bags
+
+__all__ = ["embedding_bag", "pack_bags"]

@@ -68,10 +68,19 @@ _engine_plugins: List[EngineServerPlugin] = []
 
 
 def register_plugin(plugin) -> None:
-    """Install a plugin instance into the matching server hook list."""
+    """Install a plugin instance into the matching server hook list.
+
+    Rejects unknown ``plugin_type`` values — a typo'd blocker silently
+    installed as a sniffer would stop blocking.
+    """
     from pio_tpu.server import event_server, query_server
 
     if isinstance(plugin, EventServerPlugin):
+        if plugin.plugin_type not in (INPUT_BLOCKER, INPUT_SNIFFER):
+            raise ValueError(
+                f"EventServerPlugin.plugin_type must be {INPUT_BLOCKER!r} "
+                f"or {INPUT_SNIFFER!r}, got {plugin.plugin_type!r}"
+            )
         _event_plugins.append(plugin)
         hook = lambda app_id, channel_id, d: plugin.process(d, app_id, channel_id)
         if plugin.plugin_type == INPUT_BLOCKER:
@@ -79,15 +88,17 @@ def register_plugin(plugin) -> None:
         else:
             event_server.INPUT_SNIFFERS.append(hook)
     elif isinstance(plugin, EngineServerPlugin):
+        if plugin.plugin_type not in (OUTPUT_BLOCKER, OUTPUT_SNIFFER):
+            raise ValueError(
+                f"EngineServerPlugin.plugin_type must be {OUTPUT_BLOCKER!r} "
+                f"or {OUTPUT_SNIFFER!r}, got {plugin.plugin_type!r}"
+            )
         _engine_plugins.append(plugin)
+        hook = lambda body, out: plugin.process(body, out)
         if plugin.plugin_type == OUTPUT_BLOCKER:
-            query_server.QUERY_BLOCKERS.append(
-                lambda body: plugin.process(body, None)
-            )
+            query_server.QUERY_BLOCKERS.append(hook)
         else:
-            query_server.QUERY_SNIFFERS.append(
-                lambda body, out: plugin.process(body, out)
-            )
+            query_server.QUERY_SNIFFERS.append(hook)
     else:
         raise TypeError(
             "plugin must be an EventServerPlugin or EngineServerPlugin"
@@ -128,11 +139,18 @@ def load_plugins_from_env(env_var: str = "PIO_TPU_PLUGINS") -> List[str]:
     Modules self-register via :func:`register_plugin` at import time — the
     Python analog of META-INF/services discovery. Returns the modules loaded.
     """
+    import sys
+
     loaded = []
     for name in filter(None, os.environ.get(env_var, "").split(",")):
         name = name.strip()
         try:
-            importlib.import_module(name)
+            if name in sys.modules:
+                # a cached import would skip the module's register_plugin
+                # calls (e.g. after clear_plugins() on redeploy) — re-run it
+                importlib.reload(sys.modules[name])
+            else:
+                importlib.import_module(name)
             loaded.append(name)
         except Exception:
             log.exception("failed to load plugin module %s", name)

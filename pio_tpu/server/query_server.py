@@ -174,16 +174,17 @@ class QueryServerService:
             with self._swap_lock:
                 pairs, serving, qc = self.pairs, self.serving, self.query_class
             query = self._parse_query(req.body, qc)
-            for blocker in QUERY_BLOCKERS:
-                try:
-                    blocker(req.body)
-                except ValueError as e:
-                    # output blockers veto with ValueError → client 400
-                    raise HTTPError(400, str(e))
             query = serving.supplement(query)
             predictions = [algo.predict(m, query) for algo, m in pairs]
             result = serving.serve(query, predictions)
             out = _to_jsonable(result)
+            for blocker in QUERY_BLOCKERS:
+                try:
+                    # output blockers see (query, prediction) and veto the
+                    # response with ValueError → client 400
+                    blocker(req.body, out)
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
             pr_id = None
             if self.feedback:
                 pr_id = uuid.uuid4().hex

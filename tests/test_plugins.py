@@ -153,9 +153,16 @@ class QueryVeto(EngineServerPlugin):
     plugin_name = "veto"
     plugin_type = OUTPUT_BLOCKER
 
+    def __init__(self):
+        self.predictions = []
+
     def process(self, query, prediction):
+        # blockers run post-predict: the response must be visible here
+        self.predictions.append(prediction)
         if isinstance(query, dict) and query.get("user") == "blocked":
             raise ValueError("user is blocked")
+        if prediction is None:
+            raise ValueError("blocker saw no prediction")
 
 
 class TestOutputBlocker:
@@ -181,7 +188,8 @@ class TestOutputBlocker:
         })
         engine, ep = build_engine(variant)
         run_train(engine, ep, variant, ctx=ComputeContext.create(seed=0))
-        register_plugin(QueryVeto())
+        veto = QueryVeto()
+        register_plugin(veto)
         server, _svc = create_query_server(variant, host="127.0.0.1", port=0)
         server.start()
         base = f"http://127.0.0.1:{server.port}"
@@ -194,8 +202,37 @@ class TestOutputBlocker:
                 "POST", f"{base}/queries.json", {"user": "u0"}
             )
             assert status == 200
+            # blocker received real predictions, not None
+            assert len(veto.predictions) == 2
+            assert all(p is not None for p in veto.predictions)
         finally:
             server.stop()
+
+
+class TestPluginTypeValidation:
+    def test_unknown_event_plugin_type_rejected(self):
+        class Typo(EventServerPlugin):
+            plugin_name = "typo"
+            plugin_type = "input_blocker"  # not the INPUT_BLOCKER constant
+
+            def process(self, event, app_id, channel_id):
+                raise ValueError("should never install")
+
+        with pytest.raises(ValueError, match="plugin_type"):
+            register_plugin(Typo())
+        assert installed_plugins()["eventServerPlugins"] == []
+
+    def test_unknown_engine_plugin_type_rejected(self):
+        class Typo(EngineServerPlugin):
+            plugin_name = "typo"
+            plugin_type = "OutputBlocker"
+
+            def process(self, query, prediction):
+                raise ValueError("should never install")
+
+        with pytest.raises(ValueError, match="plugin_type"):
+            register_plugin(Typo())
+        assert installed_plugins()["engineServerPlugins"] == []
 
 
 class TestEnvDiscovery:
@@ -217,6 +254,27 @@ class TestEnvDiscovery:
             p["name"] for p in installed_plugins()["eventServerPlugins"]
         ]
         assert "envp" in names
+
+    def test_reload_after_clear_reregisters(self, monkeypatch, tmp_path):
+        # import caching must not leave the registry empty on a second load
+        mod = tmp_path / "my_reload_plugin.py"
+        mod.write_text(
+            "from pio_tpu.server import EventServerPlugin, register_plugin\n"
+            "class P(EventServerPlugin):\n"
+            "    plugin_name = 'reloaded'\n"
+            "    def process(self, event, app_id, channel_id):\n"
+            "        pass\n"
+            "register_plugin(P())\n"
+        )
+        monkeypatch.syspath_prepend(str(tmp_path))
+        monkeypatch.setenv("PIO_TPU_PLUGINS", "my_reload_plugin")
+        assert load_plugins_from_env() == ["my_reload_plugin"]
+        clear_plugins()
+        assert load_plugins_from_env() == ["my_reload_plugin"]
+        names = [
+            p["name"] for p in installed_plugins()["eventServerPlugins"]
+        ]
+        assert names.count("reloaded") == 1
 
     def test_bad_module_is_logged_not_fatal(self, monkeypatch):
         monkeypatch.setenv("PIO_TPU_PLUGINS", "definitely_not_a_module")
