@@ -1,0 +1,179 @@
+"""Sparse-input MLP classifier — the text-classification training substrate.
+
+The reference's text pipeline trains MLlib NaiveBayes / LogisticRegression
+on Spark-side sparse TF-IDF vectors (upstream text-classification template —
+UNVERIFIED; SURVEY.md §2.5). The TPU-first redesign is a small MLP whose
+first layer consumes the document **as a bag**: hidden activations are
+``relu(embedding_bag(W_in, ids, tfidf) + b)`` — the Pallas streamed
+sparse×dense matmul (pio_tpu/ops/embedding.py) — followed by a dense
+softmax head on the MXU.
+
+Parallelism: examples (bags) are sharded over the mesh ``data`` axis;
+parameters are replicated. The loss mean over the sharded batch is where
+XLA inserts the gradient ``psum`` over ICI (≙ Spark ``treeAggregate``).
+The whole Adam loop is one compiled ``lax.scan`` — zero host round-trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    hidden: int = 128
+    iterations: int = 200
+    learning_rate: float = 1e-2
+    reg: float = 0.0  # L2 on the dense head
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class MLPModel:
+    """Trained sparse-input MLP (host numpy copies of the params)."""
+
+    w_in: np.ndarray  # [V, H] embedding/input layer
+    b_in: np.ndarray  # [H]
+    w_out: np.ndarray  # [H, C]
+    b_out: np.ndarray  # [C]
+    n_classes: int
+
+    def logits(self, ids: np.ndarray, weights: np.ndarray) -> np.ndarray:
+        """[B, L] bags → [B, C] logits (device path via embedding_bag)."""
+        import jax.numpy as jnp
+
+        from pio_tpu.ops.embedding import embedding_bag
+
+        h = embedding_bag(
+            jnp.asarray(self.w_in), jnp.asarray(ids), jnp.asarray(weights)
+        )
+        h = jnp.maximum(h + self.b_in, 0.0)
+        return np.asarray(h @ self.w_out + self.b_out)
+
+    def predict(self, ids: np.ndarray, weights: np.ndarray) -> np.ndarray:
+        return np.argmax(self.logits(ids, weights), axis=1).astype(np.int32)
+
+    def predict_proba(self, ids: np.ndarray, weights: np.ndarray):
+        z = self.logits(ids, weights)
+        z = z - z.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=1, keepdims=True)
+
+
+def train_mlp(
+    ctx,
+    ids: np.ndarray,
+    weights: np.ndarray,
+    y: np.ndarray,
+    n_features: int,
+    n_classes: int,
+    config: MLPConfig = MLPConfig(),
+) -> MLPModel:
+    """Full-batch Adam on the sparse-input MLP, data-parallel over the mesh.
+
+    Args:
+        ctx: ComputeContext (mesh + batch axis); mesh=None → single device.
+        ids/weights: [N, L] packed bags (pio_tpu.ops.pack_bags layout).
+        y: [N] int class codes.
+        n_features: embedding-table rows V (vectorizer.n_features).
+        n_classes: C.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pio_tpu.ops.embedding import embedding_bag
+
+    ids = np.asarray(ids, np.int32)
+    weights = np.asarray(weights, np.float32)
+    y = np.asarray(y, np.int32)
+    n = len(y)
+
+    mesh = ctx.mesh if ctx is not None else None
+    axis = ctx.batch_axis if ctx is not None else "data"
+    n_dev = ctx.num_devices if ctx is not None else 1
+
+    # pad batch to a device multiple; padded rows carry mask 0
+    n_pad = (-n) % max(n_dev, 1)
+    if n_pad:
+        ids = np.concatenate([ids, np.zeros((n_pad, ids.shape[1]), np.int32)])
+        weights = np.concatenate(
+            [weights, np.zeros((n_pad, weights.shape[1]), np.float32)]
+        )
+        y = np.concatenate([y, np.zeros(n_pad, np.int32)])
+    mask = np.concatenate(
+        [np.ones(n, np.float32), np.zeros(n_pad, np.float32)]
+    )
+
+    H, C, V = config.hidden, n_classes, n_features
+    k1, k2 = jax.random.split(jax.random.PRNGKey(config.seed))
+    params = {
+        "w_in": jax.random.normal(k1, (V, H), jnp.float32)
+        * (1.0 / np.sqrt(max(V, 1))),
+        "b_in": jnp.zeros((H,), jnp.float32),
+        "w_out": jax.random.normal(k2, (H, C), jnp.float32)
+        * (1.0 / np.sqrt(H)),
+        "b_out": jnp.zeros((C,), jnp.float32),
+    }
+    tx = optax.adam(config.learning_rate)
+
+    def loss_fn(params, ids_s, w_s, ys, ms):
+        h = embedding_bag(params["w_in"], ids_s, w_s)
+        h = jnp.maximum(h + params["b_in"], 0.0)
+        logits = (
+            jnp.dot(h, params["w_out"], preferred_element_type=jnp.float32)
+            + params["b_out"]
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, ys)
+        # the masked mean over the sharded batch is the psum point
+        data_loss = jnp.sum(ce * ms) / jnp.sum(ms)
+        return data_loss + config.reg * jnp.sum(params["w_out"] ** 2)
+
+    def fit(params, ids_s, w_s, ys, ms):
+        opt_state = tx.init(params)
+
+        def step(carry, _):
+            params, opt_state = carry
+            grads = jax.grad(loss_fn)(params, ids_s, w_s, ys, ms)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), None
+
+        (params, _), _ = jax.lax.scan(
+            step, (params, opt_state), None, length=config.iterations
+        )
+        return params
+
+    if mesh is not None:
+        shard = NamedSharding(mesh, P(axis))
+        repl = NamedSharding(mesh, P())
+        fitted = jax.jit(
+            fit,
+            in_shardings=(repl, shard, shard, shard, shard),
+            out_shardings=repl,
+        )(
+            jax.device_put(params, repl),
+            jax.device_put(jnp.asarray(ids), shard),
+            jax.device_put(jnp.asarray(weights), shard),
+            jax.device_put(jnp.asarray(y), shard),
+            jax.device_put(jnp.asarray(mask), shard),
+        )
+    else:
+        fitted = jax.jit(fit)(
+            params,
+            jnp.asarray(ids),
+            jnp.asarray(weights),
+            jnp.asarray(y),
+            jnp.asarray(mask),
+        )
+
+    return MLPModel(
+        w_in=np.asarray(fitted["w_in"]),
+        b_in=np.asarray(fitted["b_in"]),
+        w_out=np.asarray(fitted["w_out"]),
+        b_out=np.asarray(fitted["b_out"]),
+        n_classes=n_classes,
+    )

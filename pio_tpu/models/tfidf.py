@@ -1,0 +1,88 @@
+"""TF-IDF featurization — TPU-native rebuild of the MLlib HashingTF/IDF path.
+
+The reference's text-classification template (upstream
+``template-scala-parallel-textclassification``; the in-repo analog is the
+MLlib ``HashingTF``/``IDF``/``NaiveBayes`` pipeline — UNVERIFIED; SURVEY.md
+§2.5) featurizes documents on Spark as sparse vectors. The TPU rebuild keeps
+documents **sparse on purpose**: a document becomes a (token-id, tf-idf
+weight) bag that feeds :func:`pio_tpu.ops.embedding_bag`, so the first
+model layer is a streamed sparse×dense matmul instead of a materialized
+``[B, V]`` one-hot matrix.
+
+Vocabulary is learned (top-``max_features`` by document frequency) rather
+than hashed — hashing collisions cost accuracy and buy nothing on TPU where
+the table row count only affects HBM footprint. Id 0 is reserved as the
+padding row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+_TOKEN_RE = re.compile(r"[A-Za-z0-9']+")
+
+
+def tokenize(text: str) -> List[str]:
+    """Lowercase word tokens (letters/digits/apostrophes)."""
+    return _TOKEN_RE.findall(text.lower())
+
+
+@dataclasses.dataclass
+class TfIdfVectorizer:
+    """vocab: token → id (1-based; 0 is the pad row), idf: [V+1] float32."""
+
+    vocab: Dict[str, int]
+    idf: np.ndarray
+
+    @property
+    def n_features(self) -> int:
+        """Table row count including the pad row."""
+        return len(self.idf)
+
+    @classmethod
+    def fit(
+        cls, docs: Sequence[str], max_features: int = 65536
+    ) -> "TfIdfVectorizer":
+        """Learn vocab + smoothed idf: ``log((1+N)/(1+df)) + 1``."""
+        df: Counter = Counter()
+        for doc in docs:
+            df.update(set(tokenize(doc)))
+        # deterministic order: by (-df, token)
+        top = sorted(df.items(), key=lambda kv: (-kv[1], kv[0]))
+        top = top[:max_features]
+        vocab = {tok: i + 1 for i, (tok, _) in enumerate(top)}
+        n = len(docs)
+        idf = np.zeros(len(vocab) + 1, np.float32)
+        for tok, i in vocab.items():
+            idf[i] = np.log((1.0 + n) / (1.0 + df[tok])) + 1.0
+        return cls(vocab=vocab, idf=idf)
+
+    def transform_doc(self, doc: str) -> Tuple[List[int], List[float]]:
+        """One document → (token ids, L2-normalized tf-idf weights)."""
+        tf: Counter = Counter(
+            self.vocab[t] for t in tokenize(doc) if t in self.vocab
+        )
+        if not tf:
+            return [], []
+        ids = sorted(tf)
+        w = np.array([tf[i] for i in ids], np.float32) * self.idf[ids]
+        norm = float(np.linalg.norm(w))
+        if norm > 0:
+            w = w / norm
+        return ids, w.tolist()
+
+    def transform(
+        self, docs: Sequence[str], max_len: int | None = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Documents → padded (ids [B, L], weights [B, L]) bag arrays."""
+        from pio_tpu.ops.embedding import pack_bags
+
+        bags = [self.transform_doc(d) for d in docs]
+        return pack_bags(
+            [b[0] for b in bags], [b[1] for b in bags], max_len=max_len
+        )

@@ -197,9 +197,13 @@ def _embedding_bag_xla(
     )
 
 
-def _use_pallas() -> bool:
+def _use_pallas(table) -> bool:
+    # Mosaic single-row DMA slices must be lane-aligned: D % 128. Smaller
+    # tables are cheap XLA gathers anyway (they fit VMEM).
     try:
-        return jax.default_backend() == "tpu"
+        return (
+            jax.default_backend() == "tpu" and table.shape[1] % 128 == 0
+        )
     except Exception:  # pragma: no cover
         return False
 
@@ -212,7 +216,7 @@ def embedding_bag(table, ids, weights):
     ``ids`` int32 [B, L] (pad with any valid row + weight 0), ``weights``
     [B, L]. Differentiable in ``table`` and ``weights``.
     """
-    if _use_pallas():
+    if _use_pallas(table):
         return _embedding_bag_pallas(table, ids, weights)
     return _embedding_bag_xla(table, ids, weights)
 

@@ -10,16 +10,20 @@ Importing this package registers every bundled engine factory:
   (≙ examples/scala-parallel-similarproduct)
 - ``templates.ecommerce`` — personalized recs + business rules
   (≙ examples/scala-parallel-ecommercerecommendation)
+- ``templates.textclassification`` — TF-IDF + sparse-input MLP / NB
+  (≙ upstream text-classification template; BASELINE.json config #4)
 """
 
 from pio_tpu.templates import classification  # noqa: F401  (registers factory)
 from pio_tpu.templates import ecommerce  # noqa: F401  (registers factory)
 from pio_tpu.templates import recommendation  # noqa: F401  (registers factory)
 from pio_tpu.templates import similarproduct  # noqa: F401  (registers factory)
+from pio_tpu.templates import textclassification  # noqa: F401  (registers factory)
 
 __all__ = [
     "classification",
     "ecommerce",
     "recommendation",
     "similarproduct",
+    "textclassification",
 ]

@@ -1,0 +1,192 @@
+"""Text-classification template tests: TF-IDF, sparse MLP, e2e lifecycle.
+
+Mirrors the upstream text-classification quickstart scenario: $set documents
+(text + label) → TF-IDF bags → train → query label (BASELINE.json config #4).
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+import pio_tpu.templates  # noqa: F401  (registers engine factories)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.models.mlp import MLPConfig, train_mlp
+from pio_tpu.models.tfidf import TfIdfVectorizer, tokenize
+from pio_tpu.ops.embedding import pack_bags
+from pio_tpu.storage import App, Storage
+from pio_tpu.templates.textclassification import PredictedResult, Query
+from pio_tpu.workflow import (
+    build_engine,
+    load_models_for_instance,
+    run_train,
+    variant_from_dict,
+)
+
+
+# --------------------------------------------------------------- featurizer
+class TestTfIdf:
+    def test_tokenize(self):
+        assert tokenize("Hello, TPU-world! it's 42") == [
+            "hello", "tpu", "world", "it's", "42",
+        ]
+
+    def test_fit_reserves_pad_row(self):
+        vec = TfIdfVectorizer.fit(["a b", "b c"])
+        assert 0 not in vec.vocab.values()
+        assert vec.n_features == len(vec.vocab) + 1
+
+    def test_rare_tokens_weigh_more(self):
+        docs = ["common rare", "common", "common other"]
+        vec = TfIdfVectorizer.fit(docs)
+        ids, w = vec.transform_doc("common rare")
+        weights = dict(zip(ids, w))
+        assert weights[vec.vocab["rare"]] > weights[vec.vocab["common"]]
+
+    def test_transform_l2_normalized(self):
+        vec = TfIdfVectorizer.fit(["x y z", "x q"])
+        _, w = vec.transform_doc("x y z q")
+        assert np.linalg.norm(w) == pytest.approx(1.0, abs=1e-5)
+
+    def test_unknown_tokens_dropped(self):
+        vec = TfIdfVectorizer.fit(["alpha beta"])
+        ids, w = vec.transform_doc("gamma delta")
+        assert ids == [] and w == []
+
+    def test_max_features_caps_vocab(self):
+        docs = [f"tok{i} shared" for i in range(20)]
+        vec = TfIdfVectorizer.fit(docs, max_features=5)
+        assert len(vec.vocab) == 5
+        assert "shared" in vec.vocab  # highest df survives the cap
+
+
+# --------------------------------------------------------------- MLP model
+class TestSparseMLP:
+    def test_learns_separable_bags(self):
+        # docs about class 0 use tokens {1,2}, class 1 uses {3,4}
+        rng = np.random.default_rng(0)
+        n = 64
+        y = (np.arange(n) % 2).astype(np.int32)
+        bags = [
+            ([1, 2], [1.0, 1.0]) if c == 0 else ([3, 4], [1.0, 1.0])
+            for c in y
+        ]
+        ids, w = pack_bags([b[0] for b in bags], [b[1] for b in bags])
+        ctx = ComputeContext.create(seed=0)
+        model = train_mlp(
+            ctx, ids, w, y, n_features=5, n_classes=2,
+            config=MLPConfig(hidden=16, iterations=150, learning_rate=0.05),
+        )
+        q_ids, q_w = pack_bags([[1, 2], [3, 4]], [[1.0, 1.0], [1.0, 1.0]])
+        pred = model.predict(q_ids, q_w)
+        assert pred[0] == 0 and pred[1] == 1
+        proba = model.predict_proba(q_ids, q_w)
+        assert proba.shape == (2, 2)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_single_device_path(self):
+        ids, w = pack_bags([[1], [2]], [[1.0], [1.0]])
+        y = np.array([0, 1], np.int32)
+        model = train_mlp(
+            ComputeContext.local(), ids, w, y, n_features=3, n_classes=2,
+            config=MLPConfig(hidden=8, iterations=50),
+        )
+        assert model.w_in.shape == (3, 8)
+
+
+# --------------------------------------------------------------- end-to-end
+@pytest.fixture(autouse=True)
+def isolated_storage(tmp_home):
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+DOCS = {
+    "sports": [
+        "the team won the final match with a late goal",
+        "striker scores twice as the league season opens",
+        "coach praises the defence after a clean sheet win",
+        "fans cheer the home team at the stadium tonight",
+        "the match ended in a draw after extra time",
+        "player transfers dominate the football league news",
+    ],
+    "tech": [
+        "the new chip doubles matrix multiply throughput",
+        "compiler updates speed up the neural network training",
+        "a software release adds faster tensor kernels",
+        "the datacenter deploys accelerators for machine learning",
+        "researchers benchmark the model on new hardware",
+        "the framework compiles programs for the accelerator",
+    ],
+}
+
+
+def _seed_docs(app_id: int):
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 4, 1, tzinfo=dt.timezone.utc)
+    n = 0
+    for label, docs in DOCS.items():
+        for text in docs:
+            le.insert(
+                Event(
+                    "$set", "content", f"doc{n}",
+                    properties={"text": text, "label": label},
+                    event_time=t0 + dt.timedelta(minutes=n),
+                ),
+                app_id,
+            )
+            n += 1
+
+
+def _variant(algo):
+    return variant_from_dict({
+        "id": "text-e2e",
+        "engineFactory": "templates.textclassification",
+        "datasource": {"params": {"app_name": "text-test"}},
+        "algorithms": [algo],
+    })
+
+
+class TestTextClassificationEndToEnd:
+    @pytest.mark.parametrize(
+        "algo",
+        [
+            {"name": "mlp", "params": {
+                "hidden": 32, "iterations": 200, "learning_rate": 0.05}},
+            {"name": "nb", "params": {"lambda_": 0.5}},
+        ],
+        ids=["mlp", "nb"],
+    )
+    def test_full_lifecycle(self, algo):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "text-test"))
+        _seed_docs(app_id)
+
+        variant = _variant(algo)
+        engine, ep = build_engine(variant)
+        ctx = ComputeContext.create(seed=0)
+        instance_id = run_train(engine, ep, variant, ctx=ctx)
+        models = load_models_for_instance(instance_id, engine, ep, ctx)
+        serving = engine.make_serving(ep)
+        pairs = engine.algorithms_with_models(ep, models)
+
+        def serve(q):
+            return serving.serve(q, [a.predict(m, q) for a, m in pairs])
+
+        cases = [
+            (Query(text="the team plays a match in the league"), "sports"),
+            (Query(text="the compiler speeds up tensor kernels"), "tech"),
+        ]
+        for query, want in cases:
+            result = serve(query)
+            assert isinstance(result, PredictedResult)
+            assert result.label == want
+            assert 0.0 <= result.confidence <= 1.0
+
+    def test_empty_app_raises_sanity(self):
+        Storage.get_meta_data_apps().insert(App(0, "text-test"))
+        v = _variant({"name": "nb", "params": {}})
+        engine, ep = build_engine(v)
+        with pytest.raises(ValueError, match="empty"):
+            run_train(engine, ep, v, ctx=ComputeContext.create(seed=0))
