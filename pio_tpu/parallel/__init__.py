@@ -2,9 +2,51 @@
 
 Where the reference scales via Spark RDD partitioning + shuffle +
 treeAggregate (SURVEY.md §2.6), this package provides the TPU-native
-vocabulary: device meshes, named shardings, and pjit-visible collectives.
+vocabulary: device meshes (dp/tp/sp/ep/pp axes), named shardings, ring
+attention for sequence parallelism, pipeline scheduling, and multi-host
+process-group bring-up over ICI/DCN.
+
+Ring-attention/pipeline symbols are lazily re-exported: those modules import
+jax at module level, and eagerly loading them here would make every consumer
+of :mod:`pio_tpu.parallel` (controller, storage, the event server) pay the
+multi-second jax import at startup.
 """
 
 from pio_tpu.parallel.context import ComputeContext, default_mesh
+from pio_tpu.parallel.distributed import maybe_initialize
+from pio_tpu.parallel.mesh import AXIS_ORDER, MeshSpec, build_mesh, mesh_axis_size
 
-__all__ = ["ComputeContext", "default_mesh"]
+_LAZY = {
+    "pipeline_apply": "pio_tpu.parallel.pipeline",
+    "stage_slice": "pio_tpu.parallel.pipeline",
+    "ring_attention": "pio_tpu.parallel.ring_attention",
+    "ring_attention_sharded": "pio_tpu.parallel.ring_attention",
+}
+
+__all__ = [
+    "AXIS_ORDER",
+    "ComputeContext",
+    "MeshSpec",
+    "build_mesh",
+    "default_mesh",
+    "maybe_initialize",
+    "mesh_axis_size",
+    *sorted(_LAZY),
+]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module = importlib.import_module(_LAZY[name])
+        # Rebind every lazy symbol of this module into the package namespace:
+        # the import above also set the *submodule itself* as a package
+        # attribute (e.g. ``ring_attention`` the module shadowing
+        # ``ring_attention`` the function), and plain attribute hits bypass
+        # this hook.
+        for sym, mod_name in _LAZY.items():
+            if mod_name == _LAZY[name]:
+                globals()[sym] = getattr(module, sym)
+        return globals()[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

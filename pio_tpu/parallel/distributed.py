@@ -1,0 +1,92 @@
+"""Multi-host process-group bring-up — the NCCL/MPI-shaped hole, TPU-way.
+
+The reference moves inter-node bytes through Spark shuffle/broadcast over
+netty plus storage-client RPC (SURVEY.md §2.6); its "process group" is the
+Spark driver↔executor registration protocol. The TPU rebuild has no
+driver/worker split: every host runs the same program, and
+``jax.distributed.initialize`` forms the group (GCS/coordinator handshake),
+after which XLA collectives ride ICI within a slice and DCN across slices.
+
+This module is the thin, env-driven wrapper the CLI and workflow call so a
+multi-host ``pio train`` is: run the same command on every host.
+
+Env contract (all optional — absent means single-host):
+
+- ``PIO_TPU_COORDINATOR``    — ``host:port`` of process 0.
+- ``PIO_TPU_NUM_PROCESSES``  — world size.
+- ``PIO_TPU_PROCESS_ID``     — this host's rank.
+
+On TPU pods with a metadata server, plain ``jax.distributed.initialize()``
+autodetects all three; the env vars are for CPU fleets and tests.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def maybe_initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host process group if one is configured.
+
+    Returns True when running multi-host (group joined or already up),
+    False for the single-host path. Idempotent.
+    """
+    global _initialized
+    import jax
+
+    if _initialized or jax.process_count() > 1:
+        return jax.process_count() > 1
+
+    coordinator = coordinator or os.environ.get("PIO_TPU_COORDINATOR")
+    num_str = os.environ.get("PIO_TPU_NUM_PROCESSES")
+    num_processes = num_processes or (int(num_str) if num_str else None)
+    pid_str = os.environ.get("PIO_TPU_PROCESS_ID")
+    process_id = process_id if process_id is not None else (
+        int(pid_str) if pid_str else None
+    )
+
+    if coordinator is None:
+        return False  # single host
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    return process_index() == 0
+
+
+def host_local_to_global(mesh, pspec, host_arrays):
+    """Assemble per-host shards into one global sharded array (pytree).
+
+    Each host passes the rows *it* loaded (e.g. its shard of the event
+    store); the result is a global ``jax.Array`` laid out per ``pspec`` —
+    the multi-host analog of ``ComputeContext.shard_batch``. The reference's
+    counterpart is executors scanning their own storage partitions into RDD
+    blocks (HBase/JDBC region-aligned scans).
+    """
+    import jax
+
+    def one(x):
+        return jax.make_array_from_process_local_data(
+            jax.sharding.NamedSharding(mesh, pspec), x
+        )
+
+    return jax.tree.map(one, host_arrays)

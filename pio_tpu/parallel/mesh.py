@@ -1,0 +1,118 @@
+"""Multi-axis device meshes — dp / tp(ep) / sp / pp layout for the framework.
+
+The reference's only parallelism axis is Spark RDD partitioning (data
+parallelism; SURVEY.md §2.6 — its executors know no tensor/pipeline/sequence
+split). The TPU rebuild makes the full mesh vocabulary first-class so model
+families beyond MLlib-parity (two-tower retrieval, sequence recommenders)
+shard naturally:
+
+- ``data``   — batch dimension (≙ Spark partitions / treeAggregate).
+- ``model``  — tensor-parallel weight shards AND expert/vocab-sharded
+  embedding tables (EP rides the same axis: experts/vocab rows are laid out
+  along ``model`` and addressed with all_to_all / psum).
+- ``seq``    — sequence/context parallelism (ring attention,
+  pio_tpu/parallel/ring_attention.py).
+- ``pipe``   — pipeline stages (pio_tpu/parallel/pipeline.py).
+
+Axis *order* puts ``data`` outermost and ``model`` innermost so that the
+highest-traffic collectives (tensor-parallel psum/all_gather, per-layer) ride
+contiguous ICI neighbours while low-frequency gradient reductions span the
+outer (possibly DCN) dimension — the standard layout recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: canonical axis order, outermost → innermost
+AXIS_ORDER = ("data", "pipe", "seq", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape: axis name → size (-1 = absorb remainder).
+
+    Exactly one axis may be -1; it takes every device the named axes leave
+    over. Axes not mentioned get size 1 (so shardings over them are no-ops
+    and the same program runs on any mesh).
+    """
+
+    data: int = -1
+    pipe: int = 1
+    seq: int = 1
+    model: int = 1
+
+    def sizes(self, n_devices: int) -> Dict[str, int]:
+        fixed = {
+            name: getattr(self, name)
+            for name in AXIS_ORDER
+            if getattr(self, name) != -1
+        }
+        free = [n for n in AXIS_ORDER if getattr(self, n) == -1]
+        if len(free) > 1:
+            raise ValueError(f"at most one -1 axis, got {free}")
+        prod = math.prod(fixed.values())
+        if free:
+            if n_devices % prod:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            fixed[free[0]] = n_devices // prod
+        elif prod != n_devices:
+            raise ValueError(
+                f"mesh spec {fixed} needs {prod} devices, have {n_devices}"
+            )
+        return {name: fixed[name] for name in AXIS_ORDER}
+
+
+def build_mesh(spec: MeshSpec = MeshSpec(), devices=None):
+    """Materialize a ``jax.sharding.Mesh`` for the spec.
+
+    Single-host: devices are reshaped in row-major order, which for a TPU
+    slice keeps the innermost (``model``) axis on adjacent ICI neighbours.
+    Multi-host (``jax.process_count() > 1``): the outermost non-trivial axis
+    is laid out across hosts via ``mesh_utils.create_hybrid_device_mesh`` so
+    its collectives ride DCN and everything inner stays on ICI.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    sizes = spec.sizes(len(devices))
+    shape = tuple(sizes[n] for n in AXIS_ORDER)
+
+    if jax.process_count() > 1 and devices == jax.devices():
+        from jax.experimental import mesh_utils
+
+        per_host = len(devices) // jax.process_count()
+        # split the outermost axes onto DCN until a host's devices are used up
+        dcn_shape, ici_shape, budget = [], [], jax.process_count()
+        for s in shape:
+            g = math.gcd(s, budget)
+            dcn_shape.append(g)
+            ici_shape.append(s // g)
+            budget //= g
+        if budget != 1:
+            raise ValueError(
+                f"mesh {sizes} cannot be split over "
+                f"{jax.process_count()} hosts × {per_host} devices"
+            )
+        arr = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), tuple(dcn_shape), devices=devices
+        )
+        return Mesh(arr, AXIS_ORDER)
+
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def mesh_axis_size(mesh, axis: str) -> int:
+    """Size of a named axis (1 when the mesh lacks it or is None)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(axis, 1))
