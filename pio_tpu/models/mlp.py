@@ -40,18 +40,51 @@ class MLPModel:
     w_out: np.ndarray  # [H, C]
     b_out: np.ndarray  # [C]
     n_classes: int
+    #: serving cache: device-resident params + jitted logits fn. The query
+    #: server calls logits() per request; re-shipping the [V, H] table every
+    #: time would put a multi-MB host→device copy on the hot path.
+    _serve_cache: Optional[tuple] = dataclasses.field(
+        default=None, init=False, repr=False, compare=False
+    )
+
+    def _serving_fn(self):
+        if self._serve_cache is None:
+            import jax
+            import jax.numpy as jnp
+
+            from pio_tpu.ops.embedding import embedding_bag
+
+            params = tuple(
+                jnp.asarray(p)
+                for p in (self.w_in, self.b_in, self.w_out, self.b_out)
+            )
+
+            @jax.jit
+            def fwd(params, ids, weights):
+                w_in, b_in, w_out, b_out = params
+                h = embedding_bag(w_in, ids, weights)
+                h = jnp.maximum(h + b_in, 0.0)
+                return (
+                    jnp.dot(h, w_out, preferred_element_type=jnp.float32)
+                    + b_out
+                )
+
+            self._serve_cache = (fwd, params)
+        return self._serve_cache
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_serve_cache"] = None  # jitted fn/device buffers don't pickle
+        return state
 
     def logits(self, ids: np.ndarray, weights: np.ndarray) -> np.ndarray:
         """[B, L] bags → [B, C] logits (device path via embedding_bag)."""
         import jax.numpy as jnp
 
-        from pio_tpu.ops.embedding import embedding_bag
-
-        h = embedding_bag(
-            jnp.asarray(self.w_in), jnp.asarray(ids), jnp.asarray(weights)
+        fwd, params = self._serving_fn()
+        return np.asarray(
+            fwd(params, jnp.asarray(ids), jnp.asarray(weights))
         )
-        h = jnp.maximum(h + self.b_in, 0.0)
-        return np.asarray(h @ self.w_out + self.b_out)
 
     def predict(self, ids: np.ndarray, weights: np.ndarray) -> np.ndarray:
         return np.argmax(self.logits(ids, weights), axis=1).astype(np.int32)

@@ -219,6 +219,18 @@ class MultinomialNBModel:
     def scores(self, X: np.ndarray) -> np.ndarray:
         return X.astype(np.float32) @ self.log_theta.T + self.log_prior
 
+    def scores_bags(self, ids: np.ndarray, weights: np.ndarray) -> np.ndarray:
+        """Log-posterior scores from packed sparse bags (no densify).
+
+        ``[B, L]`` ids/weights → ``[B, C]``: log_prior + Σ_l w_l ·
+        log_theta[:, id_l]. Pad slots (weight 0) contribute nothing.
+        """
+        gathered = self.log_theta[:, ids]  # [C, B, L]
+        return (
+            np.einsum("cbl,bl->bc", gathered, weights.astype(np.float32))
+            + self.log_prior
+        )
+
     def predict(self, X: np.ndarray) -> np.ndarray:
         return np.argmax(self.scores(X), axis=1).astype(np.int32)
 
@@ -259,6 +271,62 @@ def train_multinomial_nb(
         return log_prior, log_theta
 
     log_prior, log_theta = fit(jnp.asarray(X), jnp.asarray(y))
+    return MultinomialNBModel(
+        log_prior=np.asarray(log_prior, np.float32),
+        log_theta=np.asarray(log_theta, np.float32),
+    )
+
+
+def train_multinomial_nb_bags(
+    ids: np.ndarray,
+    weights: np.ndarray,
+    y: np.ndarray,
+    n_features: int,
+    n_classes: int,
+    lambda_: float = 1.0,
+) -> MultinomialNBModel:
+    """Multinomial NB from packed sparse bags — no ``[n, V]`` densification.
+
+    Same estimator as :func:`train_multinomial_nb`, but the per-class feature
+    sums ``[C, V]`` are a single segment-sum over the flattened
+    ``class·V + token_id`` keys, so memory is O(nnz + C·V) instead of the
+    O(n·V) dense matrix (which at V=65536 would be gigabytes for a modest
+    corpus). Pad slots (id 0, weight 0) contribute nothing.
+
+    Args:
+        ids/weights: [n, L] bags in the pio_tpu.ops.pack_bags layout.
+        y: [n] int class codes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ids = np.asarray(ids, np.int32)
+    weights = np.asarray(weights, np.float32)
+    y = np.asarray(y, np.int32)
+    if (weights < 0).any():
+        raise ValueError("multinomial NB requires non-negative features")
+
+    @jax.jit
+    def fit(ids_j, w_j, y_j):
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(y_j, jnp.float32), y_j, num_segments=n_classes
+        )
+        flat_keys = (
+            y_j[:, None] * n_features + ids_j
+        ).reshape(-1)
+        feat_sums = jax.ops.segment_sum(
+            w_j.reshape(-1), flat_keys, num_segments=n_classes * n_features
+        ).reshape(n_classes, n_features)
+        log_prior = jnp.log(counts / counts.sum())
+        smoothed = feat_sums + lambda_
+        log_theta = jnp.log(
+            smoothed / smoothed.sum(axis=1, keepdims=True)
+        )
+        return log_prior, log_theta
+
+    log_prior, log_theta = fit(
+        jnp.asarray(ids), jnp.asarray(weights), jnp.asarray(y)
+    )
     return MultinomialNBModel(
         log_prior=np.asarray(log_prior, np.float32),
         log_theta=np.asarray(log_theta, np.float32),

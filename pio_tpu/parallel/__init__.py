@@ -19,8 +19,8 @@ from pio_tpu.parallel.mesh import AXIS_ORDER, MeshSpec, build_mesh, mesh_axis_si
 _LAZY = {
     "pipeline_apply": "pio_tpu.parallel.pipeline",
     "stage_slice": "pio_tpu.parallel.pipeline",
-    "ring_attention": "pio_tpu.parallel.ring_attention",
-    "ring_attention_sharded": "pio_tpu.parallel.ring_attention",
+    "ring_attention": "pio_tpu.parallel.ring",
+    "ring_attention_sharded": "pio_tpu.parallel.ring",
 }
 
 __all__ = [

@@ -35,16 +35,24 @@ def maybe_initialize(
 ) -> bool:
     """Join the multi-host process group if one is configured.
 
-    Returns True when running multi-host (group joined or already up),
-    False for the single-host path. Idempotent.
+    Returns True when running multi-host (group joined), False for the
+    single-host path. Idempotent. Must run before any other JAX call:
+    touching the backend (even ``jax.process_count()``) before
+    ``jax.distributed.initialize`` makes the XLA client single-host
+    permanently, so this function decides purely from its args/env and only
+    then imports jax.
     """
     global _initialized
-    import jax
 
-    if _initialized or jax.process_count() > 1:
-        return jax.process_count() > 1
+    if _initialized:
+        return True
 
     coordinator = coordinator or os.environ.get("PIO_TPU_COORDINATOR")
+    if coordinator is None:
+        # Single host. (On TPU pods with a metadata server, set
+        # PIO_TPU_COORDINATOR or call jax.distributed.initialize() yourself
+        # before any JAX use.)
+        return False
     num_str = os.environ.get("PIO_TPU_NUM_PROCESSES")
     num_processes = num_processes or (int(num_str) if num_str else None)
     pid_str = os.environ.get("PIO_TPU_PROCESS_ID")
@@ -52,8 +60,8 @@ def maybe_initialize(
         int(pid_str) if pid_str else None
     )
 
-    if coordinator is None:
-        return False  # single host
+    import jax
+
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

@@ -11,7 +11,7 @@ shard naturally:
   embedding tables (EP rides the same axis: experts/vocab rows are laid out
   along ``model`` and addressed with all_to_all / psum).
 - ``seq``    — sequence/context parallelism (ring attention,
-  pio_tpu/parallel/ring_attention.py).
+  pio_tpu/parallel/ring.py).
 - ``pipe``   — pipeline stages (pio_tpu/parallel/pipeline.py).
 
 Axis *order* puts ``data`` outermost and ``model`` innermost so that the
@@ -81,12 +81,13 @@ def build_mesh(spec: MeshSpec = MeshSpec(), devices=None):
     import jax
     from jax.sharding import Mesh
 
-    if devices is None:
+    use_default_devices = devices is None
+    if use_default_devices:
         devices = jax.devices()
     sizes = spec.sizes(len(devices))
     shape = tuple(sizes[n] for n in AXIS_ORDER)
 
-    if jax.process_count() > 1 and devices == jax.devices():
+    if use_default_devices and jax.process_count() > 1:
         from jax.experimental import mesh_utils
 
         per_host = len(devices) // jax.process_count()

@@ -13,7 +13,6 @@ through ``ppermute``/``scan``, so the same helper serves training.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax

@@ -89,23 +89,29 @@ def ring_attention(
     l = jnp.zeros((b, h, t_loc), jnp.float32)
     q_pos = idx * t_loc + jnp.arange(t_loc)
 
-    def step(carry, s):
-        o, m, l, k_blk, v_blk = carry
+    def update(o, m, l, k_blk, v_blk, s):
         src = (idx - s) % n  # which device this K/V block started on
         k_pos = src * t_loc + jnp.arange(t_loc)
-        o, m, l = _block_attn_update(
+        return _block_attn_update(
             o, m, l, q32, k_blk.astype(jnp.float32),
             v_blk.astype(jnp.float32), q_pos, k_pos, causal, scale,
         )
-        if axis is not None and n > 1:
-            perm = [(i, (i + 1) % n) for i in range(n)]
-            k_blk = jax.lax.ppermute(k_blk, axis, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis, perm)
+
+    def step(carry, s):
+        o, m, l, k_blk, v_blk = carry
+        o, m, l = update(o, m, l, k_blk, v_blk, s)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
         return (o, m, l, k_blk, v_blk), None
 
-    (o, m, l, _, _), _ = jax.lax.scan(
-        step, (o, m, l, k, v), jnp.arange(n)
-    )
+    if n > 1:
+        # n-1 rotating steps, then the last block's update with no final
+        # ppermute (the rotated result would be discarded — wasted ICI).
+        (o, m, l, k, v), _ = jax.lax.scan(
+            step, (o, m, l, k, v), jnp.arange(n - 1)
+        )
+    o, m, l = update(o, m, l, k, v, n - 1)
     out = o / jnp.maximum(l, 1e-20)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 

@@ -52,7 +52,7 @@ from pio_tpu.data.bimap import BiMap
 from pio_tpu.models.mlp import MLPConfig, MLPModel, train_mlp
 from pio_tpu.models.naive_bayes import (
     MultinomialNBModel,
-    train_multinomial_nb,
+    train_multinomial_nb_bags,
 )
 from pio_tpu.models.tfidf import TfIdfVectorizer
 from pio_tpu.ops.embedding import pack_bags
@@ -144,6 +144,7 @@ class PreparedData:
     weights: np.ndarray  # [n, L] float32
     label_codes: np.ndarray  # [n] int32
     label_index: BiMap
+    token_cap: int = 0  # per-doc truncation cap applied at train time
 
 
 class TextPreparator(Preparator):
@@ -154,7 +155,10 @@ class TextPreparator(Preparator):
     def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
         p: PreparatorParams = self.params
         vec = TfIdfVectorizer.fit(td.texts, max_features=p.max_features)
-        bags = [vec.transform_doc(t) for t in td.texts]
+        bags = [
+            _truncate_bag(*vec.transform_doc(t), p.max_doc_tokens)
+            for t in td.texts
+        ]
         longest = max((len(b[0]) for b in bags), default=1)
         ids, w = pack_bags(
             [b[0] for b in bags],
@@ -166,7 +170,9 @@ class TextPreparator(Preparator):
         codes = np.fromiter(
             (fwd[l] for l in td.labels), np.int32, len(td.labels)
         )
-        return PreparedData(vec, ids, w, codes, label_index)
+        return PreparedData(
+            vec, ids, w, codes, label_index, token_cap=p.max_doc_tokens
+        )
 
 
 # ----------------------------------------------------------------- algorithm
@@ -184,13 +190,34 @@ class PredictedResult:
         return {"label": self.label, "confidence": self.confidence}
 
 
-def _query_bag(vec: TfIdfVectorizer, text: str, width: int):
-    ids, w = vec.transform_doc(text)
+def _truncate_bag(ids, w, width: int):
+    """Cut a bag to ``width`` tokens keeping the *highest-weight* ones.
+
+    transform_doc returns ids ascending (≈ descending document frequency),
+    so a head-slice would keep the common low-idf tokens and drop the rare
+    discriminative ones.
+    """
+    if len(ids) <= width:
+        return ids, w
+    keep = np.argsort(-np.asarray(w))[:width]
+    keep.sort()  # preserve id order within the kept set
+    return np.asarray(ids)[keep], np.asarray(w)[keep]
+
+
+def _query_bag(vec: TfIdfVectorizer, text: str, width: int, cap: int = 0):
+    """Pack one query doc to the training bag width.
+
+    ``cap`` is the train-time truncation cap: pack_bags rounds the packed
+    width up (kernel alignment), so truncating at ``width`` would keep more
+    tokens for queries than training docs got — train/serve skew.
+    """
+    cap = min(width, cap) if cap else width
+    ids, w = _truncate_bag(*vec.transform_doc(text), cap)
     out_i = np.zeros((1, width), np.int32)
     out_w = np.zeros((1, width), np.float32)
-    n = min(len(ids), width)
-    out_i[0, :n] = ids[:n]
-    out_w[0, :n] = w[:n]
+    n = len(ids)
+    out_i[0, :n] = ids
+    out_w[0, :n] = w
     return out_i, out_w
 
 
@@ -208,7 +235,8 @@ class TextMLPModel:
     mlp: MLPModel
     vectorizer: TfIdfVectorizer
     label_index: BiMap
-    bag_width: int
+    bag_width: int  # packed width (rounded up for kernel alignment)
+    token_cap: int = 0  # truncation cap used at train time (0 = bag_width)
 
 
 class MLPAlgorithm(Algorithm):
@@ -235,11 +263,14 @@ class MLPAlgorithm(Algorithm):
             ),
         )
         return TextMLPModel(
-            mlp, pd.vectorizer, pd.label_index, pd.ids.shape[1]
+            mlp, pd.vectorizer, pd.label_index, pd.ids.shape[1],
+            token_cap=pd.token_cap,
         )
 
     def predict(self, model: TextMLPModel, query: Query) -> PredictedResult:
-        ids, w = _query_bag(model.vectorizer, query.text, model.bag_width)
+        ids, w = _query_bag(
+            model.vectorizer, query.text, model.bag_width, model.token_cap
+        )
         proba = model.mlp.predict_proba(ids, w)[0]
         code = int(np.argmax(proba))
         return PredictedResult(
@@ -258,38 +289,37 @@ class TextNBModel:
     nb: MultinomialNBModel
     vectorizer: TfIdfVectorizer
     label_index: BiMap
-    bag_width: int
+    bag_width: int  # packed width (rounded up for kernel alignment)
+    token_cap: int = 0  # truncation cap used at train time (0 = bag_width)
 
 
 class NBAlgorithm(Algorithm):
-    """Multinomial NB on densified tf-idf rows (small-vocab path)."""
+    """Multinomial NB over the sparse tf-idf bags (segment-sum training)."""
 
     params_class = NBParams
     query_class = Query
 
-    def _densify(self, ids, weights, n_features):
-        X = np.zeros((ids.shape[0], n_features), np.float32)
-        rows = np.repeat(np.arange(ids.shape[0]), ids.shape[1])
-        np.add.at(X, (rows, ids.reshape(-1)), weights.reshape(-1))
-        X[:, 0] = 0.0  # pad row
-        return X
-
     def train(self, ctx: ComputeContext, pd: PreparedData) -> TextNBModel:
         p: NBParams = self.params
-        X = self._densify(pd.ids, pd.weights, pd.vectorizer.n_features)
-        nb = train_multinomial_nb(
-            X,
+        nb = train_multinomial_nb_bags(
+            pd.ids,
+            pd.weights,
             pd.label_codes,
+            n_features=pd.vectorizer.n_features,
             n_classes=len(pd.label_index),
             lambda_=p.lambda_,
         )
-        return TextNBModel(nb, pd.vectorizer, pd.label_index, pd.ids.shape[1])
+        return TextNBModel(
+            nb, pd.vectorizer, pd.label_index, pd.ids.shape[1],
+            token_cap=pd.token_cap,
+        )
 
     def predict(self, model: TextNBModel, query: Query) -> PredictedResult:
-        ids, w = _query_bag(model.vectorizer, query.text, model.bag_width)
-        X = self._densify(ids, w, model.vectorizer.n_features)
-        code = int(model.nb.predict(X)[0])
-        log_p = model.nb.scores(X)[0]
+        ids, w = _query_bag(
+            model.vectorizer, query.text, model.bag_width, model.token_cap
+        )
+        log_p = model.nb.scores_bags(ids, w)[0]
+        code = int(np.argmax(log_p))
         p = np.exp(log_p - log_p.max())
         p = p / p.sum()
         return PredictedResult(

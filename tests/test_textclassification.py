@@ -190,3 +190,95 @@ class TestTextClassificationEndToEnd:
         engine, ep = build_engine(v)
         with pytest.raises(ValueError, match="empty"):
             run_train(engine, ep, v, ctx=ComputeContext.create(seed=0))
+
+
+class TestSparseNBTraining:
+    """train_multinomial_nb_bags ≡ the dense estimator, without the [n, V]."""
+
+    def test_matches_dense(self):
+        from pio_tpu.models.naive_bayes import (
+            train_multinomial_nb,
+            train_multinomial_nb_bags,
+        )
+
+        rng = np.random.default_rng(0)
+        n, L, V, C = 32, 6, 50, 3
+        ids = rng.integers(1, V, size=(n, L)).astype(np.int32)
+        w = rng.uniform(0.1, 1.0, size=(n, L)).astype(np.float32)
+        # emulate pad slots
+        w[:, -2:] = 0.0
+        ids[:, -2:] = 0
+        y = rng.integers(0, C, size=n).astype(np.int32)
+
+        X = np.zeros((n, V), np.float32)
+        rows = np.repeat(np.arange(n), L)
+        np.add.at(X, (rows, ids.reshape(-1)), w.reshape(-1))
+
+        dense = train_multinomial_nb(X, y, n_classes=C)
+        sparse = train_multinomial_nb_bags(
+            ids, w, y, n_features=V, n_classes=C
+        )
+        np.testing.assert_allclose(
+            sparse.log_prior, dense.log_prior, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            sparse.log_theta, dense.log_theta, rtol=1e-5, atol=1e-6
+        )
+
+
+class TestBagTruncation:
+    def test_keeps_highest_weight_tokens(self):
+        from pio_tpu.templates.textclassification import _truncate_bag
+
+        ids = np.array([1, 2, 3, 4, 5], np.int32)
+        w = np.array([0.1, 0.9, 0.2, 0.8, 0.3], np.float32)
+        tids, tw = _truncate_bag(ids, w, 2)
+        assert list(tids) == [2, 4]
+        assert list(tw) == pytest.approx([0.9, 0.8])
+
+    def test_noop_when_within_width(self):
+        from pio_tpu.templates.textclassification import _truncate_bag
+
+        ids = np.array([1, 2], np.int32)
+        w = np.array([0.5, 0.5], np.float32)
+        tids, tw = _truncate_bag(ids, w, 8)
+        assert list(tids) == [1, 2]
+
+
+class TestMLPServingCache:
+    def test_pickle_roundtrip_drops_cache(self):
+        import pickle
+
+        from pio_tpu.models.mlp import MLPModel
+
+        m = MLPModel(
+            w_in=np.ones((10, 4), np.float32),
+            b_in=np.zeros(4, np.float32),
+            w_out=np.ones((4, 2), np.float32),
+            b_out=np.zeros(2, np.float32),
+            n_classes=2,
+        )
+        ids = np.array([[1, 2, 0, 0]], np.int32)
+        w = np.array([[0.5, 0.5, 0.0, 0.0]], np.float32)
+        before = m.logits(ids, w)
+        assert m._serve_cache is not None
+        m2 = pickle.loads(pickle.dumps(m))
+        assert m2._serve_cache is None
+        np.testing.assert_allclose(m2.logits(ids, w), before, rtol=1e-6)
+
+    def test_repeated_predict_reuses_cache(self):
+        from pio_tpu.models.mlp import MLPModel
+
+        m = MLPModel(
+            w_in=np.ones((10, 4), np.float32),
+            b_in=np.zeros(4, np.float32),
+            w_out=np.ones((4, 2), np.float32),
+            b_out=np.zeros(2, np.float32),
+            n_classes=2,
+        )
+        ids = np.array([[1, 2, 0, 0]], np.int32)
+        w = np.array([[0.5, 0.5, 0.0, 0.0]], np.float32)
+        m.logits(ids, w)
+        fn1 = m._serve_cache[0]
+        m.logits(ids, w)
+        assert m._serve_cache[0] is fn1
