@@ -45,6 +45,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from pio_tpu.utils.numutil import round_up as _round_up
+
 from pio_tpu.parallel.context import ComputeContext
 
 
@@ -71,8 +73,6 @@ class ALSFactors:
     item_factors: np.ndarray  # [n_items, rank]
 
 
-def _round_up(x: int, mult: int) -> int:
-    return -(-x // mult) * mult
 
 
 def _auto_width(n_edges: int, n_entities: int) -> int:

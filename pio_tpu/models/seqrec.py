@@ -1,0 +1,430 @@
+"""Sequence recommender — causal transformer over user event histories.
+
+The reference has no sequential model (nearest concepts: MarkovChain in e2,
+ALS over an interaction matrix — SURVEY.md §2.5); this model family makes
+the framework's long-context support real: next-item prediction over a
+user's **entire event history**, SASRec-style.
+
+One jitted train step composes every parallelism axis in the mesh
+(pio_tpu/parallel/mesh.py):
+
+- **dp**    — batch rows shard over ``data``; the loss mean psums there.
+- **sp**    — the sequence shards over ``seq``; attention is exact ring
+  attention (pio_tpu/parallel/ring.py), K/V blocks rotating by ppermute.
+- **tp**    — attention heads and FFN hidden shard over ``model``
+  (Megatron split: column-parallel in, row-parallel out + psum).
+- **ep**    — the item-embedding table shards by vocab rows over ``model``;
+  logits use *vocab-parallel* cross-entropy (local partial logits, pmax /
+  psum assembled log-softmax) so the ``[B, T, V]`` tensor never exists
+  unsharded.
+- **pp**    — transformer blocks stack over ``pipe`` and microbatches flow
+  through :func:`pio_tpu.parallel.pipeline.pipeline_apply`.
+
+Everything is differentiated through ``shard_map``; JAX transposes the
+collectives (psum↔broadcast, ppermute↔reverse ppermute, gather↔scatter).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pio_tpu.parallel.mesh import mesh_axis_size
+from pio_tpu.parallel.vocab import (
+    vocab_parallel_lookup,
+    vocab_parallel_target_gather,
+)
+from pio_tpu.utils.numutil import round_up as _round_up
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqRecConfig:
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    ffn: int = 128
+    max_len: int = 64
+    dropout: float = 0.0  # reserved; deterministic v1
+    learning_rate: float = 1e-3
+    steps: int = 200
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class SeqRecModel:
+    """Trained transformer; host copies of params for persistence/serving."""
+
+    params: dict  # layer-stacked pytree (host numpy)
+    n_items: int
+    config: SeqRecConfig
+    _serve_cache: Optional[tuple] = dataclasses.field(
+        default=None, init=False, repr=False, compare=False
+    )
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_serve_cache"] = None
+        return state
+
+    def next_item_scores(self, histories: np.ndarray) -> np.ndarray:
+        """[B, T] padded histories (0 = pad) → [B, V] next-item scores.
+
+        Single-device serving path; jitted + device-cached like
+        MLPModel (pio_tpu/models/mlp.py).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if self._serve_cache is None:
+            params = jax.tree.map(jnp.asarray, self.params)
+
+            @jax.jit
+            def fwd(params, seqs):
+                h = _trunk(params, seqs, self.config, None, None, None)
+                # score from the last real position of each row
+                lengths = (seqs > 0).sum(axis=1)
+                last = jnp.take_along_axis(
+                    h,
+                    jnp.maximum(lengths - 1, 0)[:, None, None],
+                    axis=1,
+                )[:, 0]
+                return jnp.dot(
+                    last,
+                    params["emb"].T,
+                    preferred_element_type=jnp.float32,
+                )
+
+            self._serve_cache = (fwd, params)
+        fwd, params = self._serve_cache
+        return np.asarray(fwd(params, jnp.asarray(histories, jnp.int32)))
+
+
+def init_params(vocab: int, cfg: SeqRecConfig):
+    """Layer-stacked parameter pytree (leading dim = n_layers)."""
+    import jax
+
+    k = jax.random.PRNGKey(cfg.seed)
+    keys = jax.random.split(k, 8)
+    D, F, L = cfg.d_model, cfg.ffn, cfg.n_layers
+    s = D ** -0.5
+
+    def nrm(key, shape, scale):
+        return jax.random.normal(key, shape) * scale
+
+    return {
+        "emb": nrm(keys[0], (vocab, D), s),
+        "pos": nrm(keys[1], (cfg.max_len, D), s),
+        "blocks": {
+            "ln1_g": np.ones((L, D), np.float32),
+            "ln1_b": np.zeros((L, D), np.float32),
+            "wq": nrm(keys[2], (L, D, D), s),
+            "wk": nrm(keys[6], (L, D, D), s),
+            "wv": nrm(keys[7], (L, D, D), s),
+            "wo": nrm(keys[3], (L, D, D), s),
+            "ln2_g": np.ones((L, D), np.float32),
+            "ln2_b": np.zeros((L, D), np.float32),
+            "w1": nrm(keys[4], (L, D, F), s),
+            "b1": np.zeros((L, F), np.float32),
+            "w2": nrm(keys[5], (L, F, D), F ** -0.5),
+            "b2": np.zeros((L, D), np.float32),
+        },
+        "lnf_g": np.ones((D,), np.float32),
+        "lnf_b": np.zeros((D,), np.float32),
+    }
+
+
+def param_specs(cfg: SeqRecConfig):
+    """PartitionSpecs: ep for emb, tp for heads/ffn, pp over the stack."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "emb": P("model", None),  # vocab-sharded (ep)
+        "pos": P(),
+        "blocks": {
+            "ln1_g": P("pipe", None),
+            "ln1_b": P("pipe", None),
+            "wq": P("pipe", None, "model"),  # heads column-sharded (tp)
+            "wk": P("pipe", None, "model"),
+            "wv": P("pipe", None, "model"),
+            "wo": P("pipe", "model", None),  # row-sharded + psum (tp)
+            "ln2_g": P("pipe", None),
+            "ln2_b": P("pipe", None),
+            "w1": P("pipe", None, "model"),  # ffn column-sharded (tp)
+            "b1": P("pipe", "model"),
+            "w2": P("pipe", "model", None),  # ffn row-sharded + psum (tp)
+            "b2": P("pipe", None),
+        },
+        "lnf_g": P(),
+        "lnf_b": P(),
+    }
+
+
+def _ln(x, g, b):
+    import jax
+
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g + b
+
+
+def _block(blk, h, cfg, m_axis, s_axis):
+    """One pre-LN transformer block on the local [mb, T_loc, D] slice.
+
+    ``blk`` leaves have NO layer dim (already sliced). Heads/FFN hidden are
+    local tp shards; attention rides the ring over ``s_axis``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pio_tpu.parallel.ring import ring_attention
+
+    mb, t_loc, D = h.shape
+    n_model = 1 if m_axis is None else jax.lax.axis_size(m_axis)
+    heads_loc = cfg.n_heads // n_model
+    hd = cfg.d_model // cfg.n_heads
+
+    x = _ln(h, blk["ln1_g"], blk["ln1_b"])
+    # separate projections: a fused [D, 3D] column shard would split at
+    # arbitrary offsets and scramble the q/k/v boundaries across devices
+    q = jnp.dot(x, blk["wq"], preferred_element_type=jnp.float32)
+    k = jnp.dot(x, blk["wk"], preferred_element_type=jnp.float32)
+    v = jnp.dot(x, blk["wv"], preferred_element_type=jnp.float32)
+
+    def split_heads(a):
+        return a.reshape(mb, t_loc, heads_loc, hd)
+
+    attn = ring_attention(
+        split_heads(q), split_heads(k), split_heads(v),
+        axis=s_axis, causal=True,
+    ).reshape(mb, t_loc, heads_loc * hd)
+    out = jnp.dot(attn, blk["wo"], preferred_element_type=jnp.float32)
+    if m_axis is not None:
+        out = jax.lax.psum(out, m_axis)  # close row-parallel wo (tp)
+    h = h + out
+
+    x = _ln(h, blk["ln2_g"], blk["ln2_b"])
+    f = jnp.maximum(
+        jnp.dot(x, blk["w1"], preferred_element_type=jnp.float32)
+        + blk["b1"],
+        0.0,
+    )
+    f = jnp.dot(f, blk["w2"], preferred_element_type=jnp.float32)
+    if m_axis is not None:
+        f = jax.lax.psum(f, m_axis)
+    return h + f + blk["b2"]
+
+
+def _embed(params, seqs, cfg, m_axis, s_axis):
+    """Vocab-parallel embedding + global-position encoding → [mb, T_loc, D]."""
+    import jax
+    import jax.numpy as jnp
+
+    x = vocab_parallel_lookup(params["emb"], seqs, m_axis)
+    t_loc = seqs.shape[1]
+    t_off = 0 if s_axis is None else jax.lax.axis_index(s_axis) * t_loc
+    pos = jax.lax.dynamic_slice_in_dim(params["pos"], t_off, t_loc)
+    return x + pos[None]
+
+
+def _trunk(params, seqs, cfg, m_axis, s_axis, p_axis):
+    """Embed + all transformer blocks + final LN → [mb, T_loc, D].
+
+    With a pipe axis the blocks run through pipeline_apply (the whole local
+    batch as ONE microbatch per tick slot — callers microbatch upstream);
+    otherwise a scan over the layer stack.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    h = _embed(params, seqs, cfg, m_axis, s_axis)
+    blocks = params["blocks"]
+
+    def apply_stack(h, stack):
+        def body(h, blk):
+            return _block(blk, h, cfg, m_axis, s_axis), None
+
+        h, _ = jax.lax.scan(body, h, stack)
+        return h
+
+    if p_axis is None:
+        h = apply_stack(h, blocks)
+    else:
+        from pio_tpu.parallel.pipeline import pipeline_apply
+
+        # Microbatch so the pipe stays busy: with one microbatch every
+        # stage computes discarded garbage for (n_pipe-1)/n_pipe of the
+        # ticks. n_pipe microbatches ≈ 50% steady-state utilization.
+        n_pipe = jax.lax.axis_size(p_axis)
+        mb = h.shape[0]
+        m = n_pipe if mb % n_pipe == 0 else 1
+        hm = h.reshape(m, mb // m, *h.shape[1:])
+        h = pipeline_apply(
+            blocks, hm, lambda stack, x: apply_stack(x, stack),
+            axis=p_axis,
+        ).reshape(h.shape)
+    return _ln(h, params["lnf_g"], params["lnf_b"])
+
+
+def _vocab_parallel_ce(h, emb, targets, mask, m_axis):
+    """CE over the vocab-sharded logits; [mb, T_loc] masked mean parts.
+
+    Returns (sum_ce, sum_mask) — caller psums over data/seq axes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.einsum(
+        "btd,vd->btv", h, emb, preferred_element_type=jnp.float32
+    )  # local vocab shard
+    if m_axis is None:
+        z = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1
+        )[..., 0]
+    else:
+        rows = emb.shape[0]
+        offset = jax.lax.axis_index(m_axis) * rows
+        # The stability shift carries no gradient (it cancels in
+        # logsumexp), and pmax has no differentiation rule — so detach the
+        # local max and reduce it with the (linear, differentiable)
+        # all_gather instead.
+        gmax = jax.lax.all_gather(
+            jax.lax.stop_gradient(logits.max(axis=-1)), m_axis
+        ).max(axis=0)
+        z = gmax + jnp.log(
+            jax.lax.psum(
+                jnp.exp(logits - gmax[..., None]).sum(axis=-1), m_axis
+            )
+        )
+        tgt = vocab_parallel_target_gather(logits, targets, m_axis)
+    ce = (z - tgt) * mask
+    return ce.sum(), mask.sum()
+
+
+def train_seqrec(
+    mesh,
+    sequences: np.ndarray,
+    n_items: int,
+    config: SeqRecConfig = SeqRecConfig(),
+) -> SeqRecModel:
+    """Next-item training over padded histories.
+
+    Args:
+        mesh: build_mesh() mesh — data/seq/model/pipe all honored; None →
+            single-device.
+        sequences: [n, T] int32, item ids ≥ 1, 0 = pad (right-padded).
+        n_items: vocabulary size (ids are 1..n_items; row 0 = pad).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = config
+    n_data = mesh_axis_size(mesh, "data")
+    n_seq = mesh_axis_size(mesh, "seq")
+    n_model = mesh_axis_size(mesh, "model")
+    n_pipe = mesh_axis_size(mesh, "pipe")
+    m_axis = "model" if mesh is not None else None
+    s_axis = "seq" if mesh is not None else None
+    p_axis = "pipe" if (mesh is not None and n_pipe > 1) else None
+
+    if cfg.n_heads % n_model:
+        raise ValueError("n_heads must divide by the model axis")
+    if cfg.n_layers % max(n_pipe, 1):
+        raise ValueError("n_layers must divide by the pipe axis")
+
+    seqs = np.asarray(sequences, np.int32)
+    n, t = seqs.shape
+    t_pad = _round_up(min(t, cfg.max_len), n_seq)
+    if t_pad > cfg.max_len:
+        raise ValueError(
+            f"max_len {cfg.max_len} not a multiple of seq axis {n_seq}"
+        )
+    buf = np.zeros((_round_up(n, n_data), t_pad), np.int32)
+    buf[:n, : min(t, t_pad)] = seqs[:, :t_pad]
+    seqs = buf
+
+    # next-item targets: target[t] = seq[t+1]; last position unsupervised
+    targets = np.zeros_like(seqs)
+    targets[:, :-1] = seqs[:, 1:]
+    mask = (targets > 0) & (seqs > 0)
+
+    vocab = _round_up(n_items + 1, n_model)  # +1 for the pad row
+    params = init_params(vocab, cfg)
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+    tx = optax.adam(cfg.learning_rate)
+    specs = param_specs(cfg)
+
+    def global_loss(params, seqs, targets, mask):
+        if mesh is None:
+            h = _trunk(params, seqs, cfg, None, None, None)
+            ce, denom = _vocab_parallel_ce(
+                h, params["emb"], targets, mask, None
+            )
+            return ce / jnp.maximum(denom, 1.0)
+
+        def inner(params, seqs, targets, mask):
+            h = _trunk(params, seqs, cfg, m_axis, s_axis, p_axis)
+            ce, denom = _vocab_parallel_ce(
+                h, params["emb"], targets, mask, m_axis
+            )
+            ce = jax.lax.psum(ce, ("data", "seq"))
+            denom = jax.lax.psum(denom, ("data", "seq"))
+            return ce / jnp.maximum(denom, 1.0)
+
+        dspec = P("data", "seq")
+        return shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(specs, dspec, dspec, dspec),
+            out_specs=P(),
+            check_vma=False,
+        )(params, seqs, targets, mask)
+
+    def fit(params, seqs, targets, mask):
+        opt_state = tx.init(params)
+
+        def step(carry, _):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(global_loss)(
+                params, seqs, targets, mask
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, _), losses = jax.lax.scan(
+            step, (params, opt_state), None, length=cfg.steps
+        )
+        return params, losses
+
+    mask = mask.astype(np.float32)
+    if mesh is not None:
+        psh = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            specs,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+        )
+        params = jax.tree.map(jax.device_put, params, psh)
+        dsh = NamedSharding(mesh, P("data", "seq"))
+        fitted, losses = jax.jit(fit)(
+            params,
+            jax.device_put(jnp.asarray(seqs), dsh),
+            jax.device_put(jnp.asarray(targets), dsh),
+            jax.device_put(jnp.asarray(mask), dsh),
+        )
+    else:
+        fitted, losses = jax.jit(fit)(
+            params,
+            jnp.asarray(seqs),
+            jnp.asarray(targets),
+            jnp.asarray(mask),
+        )
+
+    host = jax.tree.map(lambda a: np.asarray(a), fitted)
+    host["emb"] = host["emb"][: n_items + 1]
+    return SeqRecModel(params=host, n_items=n_items, config=cfg)

@@ -1,0 +1,294 @@
+"""Two-tower retrieval model — dp × tp × ep sharded, in-batch softmax.
+
+BASELINE.json config #5 names "Two-tower / Wide&Deep recommender template"
+as a required measurement config; the reference itself has no neural
+recommender (its similar-product/ecommerce templates are ALS-factor cosine —
+SURVEY.md §2.5), so this model is capability-forward rather than parity.
+
+Architecture: user tower and item tower, each ``embed → relu MLP → L2-norm
+vector``; score = dot product; trained with in-batch sampled-softmax
+contrastive loss (each row's positive item, everyone else's items as
+negatives).
+
+Sharding (the point of this model — it exercises every mesh axis class):
+
+- **dp**: the pair batch shards over ``data``; in-batch negatives require an
+  ``all_gather`` of item vectors over ``data`` (its transpose in the
+  backward pass is the matching ``psum_scatter``).
+- **ep** (vocab-parallel embeddings): each embedding table shards by rows
+  over ``model``; a lookup masks ids outside the local shard, gathers
+  locally, and ``psum``s partial rows over ``model`` — the expert-parallel
+  addressing pattern, no replicated table anywhere.
+- **tp** (Megatron-style MLP): first dense column-sharded over ``model``
+  (activations ``[B, H/m]``), second dense row-sharded with a closing
+  ``psum`` — one reduction per tower, matmuls stay MXU-sized.
+
+The whole step is differentiated *through* ``shard_map`` so JAX transposes
+the collectives (all_gather ↔ psum_scatter, psum ↔ broadcast) instead of us
+hand-deriving gradient comms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pio_tpu.parallel.mesh import mesh_axis_size
+from pio_tpu.parallel.vocab import vocab_parallel_lookup
+from pio_tpu.utils.numutil import round_up as _round_up
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoTowerConfig:
+    embed_dim: int = 64
+    hidden: int = 128
+    out_dim: int = 64
+    temperature: float = 20.0  # logit scale on the unit sphere
+    learning_rate: float = 1e-3
+    steps: int = 200
+    batch_size: int = 256
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class TwoTowerModel:
+    """Trained towers, materialized as host arrays.
+
+    ``item_vectors`` is the full item-tower output table — serving top-N is
+    one ``[B, D] @ [D, V_i]`` MXU matmul exactly like the ALS template.
+    """
+
+    user_vectors: np.ndarray  # [n_users, D] unit rows
+    item_vectors: np.ndarray  # [n_items, D] unit rows
+    config: TwoTowerConfig
+
+    def scores(self, user_rows: np.ndarray) -> np.ndarray:
+        return np.asarray(user_rows @ self.item_vectors.T)
+
+
+def _init_tower(key, vocab: int, cfg: TwoTowerConfig):
+    import jax
+
+    ke, k1, k2 = jax.random.split(key, 3)
+    s = cfg.embed_dim ** -0.5
+    return {
+        "emb": jax.random.normal(ke, (vocab, cfg.embed_dim)) * s,
+        "w1": jax.random.normal(k1, (cfg.embed_dim, cfg.hidden))
+        * (cfg.embed_dim ** -0.5),
+        "b1": np.zeros((cfg.hidden,), np.float32),
+        "w2": jax.random.normal(k2, (cfg.hidden, cfg.out_dim))
+        * (cfg.hidden ** -0.5),
+        "b2": np.zeros((cfg.out_dim,), np.float32),
+    }
+
+
+def _tower_specs():
+    """PartitionSpecs for one tower's params (see module docstring)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "emb": P("model", None),  # vocab-sharded (ep)
+        "w1": P(None, "model"),  # column-sharded (tp)
+        "b1": P("model"),
+        "w2": P("model", None),  # row-sharded (tp)
+        "b2": P(),
+    }
+
+
+def _tower_forward(params, ids, axis: Optional[str]):
+    """Sharded tower: vocab-parallel embed → tp MLP → unit vectors.
+
+    Runs inside shard_map; ``params`` are the *local* blocks.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x = vocab_parallel_lookup(params["emb"], ids, axis)
+
+    h = jnp.maximum(
+        jnp.dot(x, params["w1"], preferred_element_type=jnp.float32)
+        + params["b1"],
+        0.0,
+    )  # [B, H/m] column-parallel
+    out = jnp.dot(h, params["w2"], preferred_element_type=jnp.float32)
+    if axis is not None:
+        out = jax.lax.psum(out, axis)  # close the row-parallel matmul (tp)
+    out = out + params["b2"]
+    return out / jnp.maximum(
+        jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-6
+    )
+
+
+def _contrastive_loss(user_p, item_p, uids, iids, cfg, d_axis, m_axis):
+    """In-batch softmax CE, all_gather'd negatives over the data axis."""
+    import jax
+    import jax.numpy as jnp
+
+    u = _tower_forward(user_p, uids, m_axis)  # [B_loc, D]
+    v = _tower_forward(item_p, iids, m_axis)  # [B_loc, D]
+    b_loc = u.shape[0]
+    if d_axis is None:
+        v_all = v
+        labels = jnp.arange(b_loc)
+    else:
+        v_all = jax.lax.all_gather(v, d_axis, tiled=True)  # [B_glob, D]
+        labels = jax.lax.axis_index(d_axis) * b_loc + jnp.arange(b_loc)
+    logits = cfg.temperature * jnp.dot(
+        u, v_all.T, preferred_element_type=jnp.float32
+    )
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ce = logz - jnp.take_along_axis(
+        logits, labels[:, None], axis=-1
+    )[:, 0]
+    loss = ce.sum()
+    if d_axis is not None:
+        loss = jax.lax.psum(loss, d_axis)
+        total = b_loc * jax.lax.axis_size(d_axis)
+    else:
+        total = b_loc
+    return loss / total
+
+
+def train_two_tower(
+    mesh,
+    user_ids: np.ndarray,
+    item_ids: np.ndarray,
+    n_users: int,
+    n_items: int,
+    config: TwoTowerConfig = TwoTowerConfig(),
+) -> TwoTowerModel:
+    """Train on positive (user, item) pairs; returns unit vector tables.
+
+    Args:
+        mesh: a build_mesh() mesh (data/model axes used; seq/pipe ignored).
+            None → single-device path (no collectives).
+        user_ids/item_ids: [n_pairs] int32 positive interaction pairs.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = config
+    n_data = mesh_axis_size(mesh, "data")
+    n_model = mesh_axis_size(mesh, "model")
+    d_axis = "data" if mesh is not None else None
+    m_axis = "model" if mesh is not None else None
+
+    # vocab rounded up so tables shard evenly; batch to a data multiple
+    vu = _round_up(max(n_users, 1), n_model)
+    vi = _round_up(max(n_items, 1), n_model)
+    batch = _round_up(min(cfg.batch_size, len(user_ids)), n_data)
+
+    rng = np.random.default_rng(cfg.seed)
+    perm = rng.permutation(len(user_ids))
+    uids = np.asarray(user_ids, np.int32)[perm]
+    iids = np.asarray(item_ids, np.int32)[perm]
+    # wraparound so every scan step slices a full batch
+    n_pairs = len(uids)
+    reps = _round_up(max(n_pairs, batch), batch)
+    uids = np.resize(uids, reps)
+    iids = np.resize(iids, reps)
+    n_batches = reps // batch
+
+    ku, ki = jax.random.split(jax.random.PRNGKey(cfg.seed))
+    params = {
+        "user": _init_tower(ku, vu, cfg),
+        "item": _init_tower(ki, vi, cfg),
+    }
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+    tx = optax.adam(cfg.learning_rate)
+
+    specs = {"user": _tower_specs(), "item": _tower_specs()}
+
+    def global_loss(params, ub, ib):
+        if mesh is None:
+            return _contrastive_loss(
+                params["user"], params["item"], ub, ib, cfg, None, None
+            )
+
+        def inner(user_p, item_p, ub, ib):
+            return _contrastive_loss(
+                user_p, item_p, ub, ib, cfg, d_axis, m_axis
+            )
+
+        return shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(specs["user"], specs["item"], P("data"), P("data")),
+            out_specs=P(),
+            check_vma=False,
+        )(params["user"], params["item"], ub, ib)
+
+    def fit(params, uids, iids):
+        opt_state = tx.init(params)
+
+        def step(carry, s):
+            params, opt_state = carry
+            start = (s % n_batches) * batch
+            ub = jax.lax.dynamic_slice_in_dim(uids, start, batch)
+            ib = jax.lax.dynamic_slice_in_dim(iids, start, batch)
+            loss, grads = jax.value_and_grad(global_loss)(params, ub, ib)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, _), losses = jax.lax.scan(
+            step, (params, opt_state), jnp.arange(cfg.steps)
+        )
+        return params, losses
+
+    if mesh is not None:
+        param_shardings = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            specs,
+            is_leaf=lambda x: isinstance(
+                x, jax.sharding.PartitionSpec
+            ),
+        )
+        params = jax.tree.map(jax.device_put, params, param_shardings)
+        data_sh = NamedSharding(mesh, P(None))
+        fitted, losses = jax.jit(fit)(
+            params,
+            jax.device_put(jnp.asarray(uids), data_sh),
+            jax.device_put(jnp.asarray(iids), data_sh),
+        )
+    else:
+        fitted, losses = jax.jit(fit)(
+            params, jnp.asarray(uids), jnp.asarray(iids)
+        )
+
+    # materialize full vector tables (chunked matmuls, replicated output)
+    def vectors(tower_params, vocab, specs_t):
+        all_ids = jnp.arange(vocab)
+        if mesh is None:
+            return np.asarray(
+                _tower_forward(tower_params, all_ids, None)
+            )
+
+        def inner(tp, ids):
+            return _tower_forward(tp, ids, m_axis)
+
+        out = shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(specs_t, P("data")),
+            out_specs=P("data"),
+            check_vma=False,
+        )(tower_params, all_ids)
+        return np.asarray(out)
+
+    vu_pad = _round_up(vu, max(n_data, 1))
+    vi_pad = _round_up(vi, max(n_data, 1))
+    user_vecs = vectors(
+        fitted["user"], vu_pad, specs["user"]
+    )[:n_users]
+    item_vecs = vectors(
+        fitted["item"], vi_pad, specs["item"]
+    )[:n_items]
+    return TwoTowerModel(
+        user_vectors=user_vecs, item_vectors=item_vecs, config=cfg
+    )

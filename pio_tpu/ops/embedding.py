@@ -46,9 +46,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pio_tpu.utils.numutil import round_up as _round_up
 
-def _round_up(x: int, mult: int) -> int:
-    return -(-x // mult) * mult
+
 
 
 # --------------------------------------------------------------------- kernel

@@ -62,11 +62,17 @@ def maybe_initialize(
 
     import jax
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # group already formed (operator called initialize directly, or a
+        # library did) — idempotency beats strictness here
+        if "already" not in str(e).lower():
+            raise
     _initialized = True
     return True
 

@@ -1,0 +1,61 @@
+"""Vocab-parallel (ep) addressing over a row-sharded table.
+
+The expert/embedding-parallel pattern shared by the two-tower and
+sequence-recommender models: a ``[V, D]`` table shards by rows over the
+``model`` mesh axis; lookups mask ids outside the local shard, gather
+locally, and ``psum`` the partial rows — no replicated table anywhere.
+Call these from inside ``shard_map`` with the *local* table block.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def vocab_parallel_lookup(table, ids, axis: Optional[str]):
+    """Row lookup on a vocab-sharded table: ``table[ids]`` assembled by psum.
+
+    Args:
+        table: local ``[V_local, D]`` shard (or the full table if axis is
+            None).
+        ids: integer array of any shape; out-of-range ids yield zero rows.
+        axis: mesh axis the vocab rows shard over; None → plain gather.
+
+    Returns ``ids.shape + (D,)`` embedding rows.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if axis is None:
+        return table[ids]
+    rows = table.shape[0]
+    offset = jax.lax.axis_index(axis) * rows
+    local = ids - offset
+    hit = (local >= 0) & (local < rows)
+    gathered = table[jnp.clip(local, 0, rows - 1)]
+    return jax.lax.psum(
+        jnp.where(hit[..., None], gathered, 0.0), axis
+    )
+
+
+def vocab_parallel_target_gather(logits_local, targets, axis: Optional[str]):
+    """Pick each target's logit from vocab-sharded ``[..., V_local]`` logits.
+
+    The target-column gather of a vocab-parallel cross-entropy: exactly one
+    shard holds each target id; the rest contribute zero to the psum.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if axis is None:
+        return jnp.take_along_axis(
+            logits_local, targets[..., None], axis=-1
+        )[..., 0]
+    rows = logits_local.shape[-1]
+    offset = jax.lax.axis_index(axis) * rows
+    local = targets - offset
+    hit = (local >= 0) & (local < rows)
+    picked = jnp.take_along_axis(
+        logits_local, jnp.clip(local, 0, rows - 1)[..., None], axis=-1
+    )[..., 0]
+    return jax.lax.psum(jnp.where(hit, picked, 0.0), axis)

@@ -12,18 +12,26 @@ Importing this package registers every bundled engine factory:
   (≙ examples/scala-parallel-ecommercerecommendation)
 - ``templates.textclassification`` — TF-IDF + sparse-input MLP / NB
   (≙ upstream text-classification template; BASELINE.json config #4)
+- ``templates.twotower`` — neural two-tower retrieval, dp×tp×ep sharded
+  (BASELINE.json config #5; capability-forward, no reference analog)
+- ``templates.sequence`` — next-item transformer over full event
+  histories, dp×sp×tp×ep×pp sharded (capability-forward)
 """
 
 from pio_tpu.templates import classification  # noqa: F401  (registers factory)
 from pio_tpu.templates import ecommerce  # noqa: F401  (registers factory)
 from pio_tpu.templates import recommendation  # noqa: F401  (registers factory)
+from pio_tpu.templates import sequence  # noqa: F401  (registers factory)
 from pio_tpu.templates import similarproduct  # noqa: F401  (registers factory)
 from pio_tpu.templates import textclassification  # noqa: F401  (registers factory)
+from pio_tpu.templates import twotower  # noqa: F401  (registers factory)
 
 __all__ = [
     "classification",
     "ecommerce",
     "recommendation",
+    "sequence",
     "similarproduct",
     "textclassification",
+    "twotower",
 ]

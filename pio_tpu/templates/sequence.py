@@ -1,0 +1,266 @@
+"""Sequence-recommendation template — next-item prediction over histories.
+
+Long-context, first-class: the DataSource assembles each user's **full
+time-ordered event stream** (view/buy/rate events sorted by eventTime —
+the reference's nearest concept is Spark partitioning of the event RDD
+along time; SURVEY.md §5 "long-context: ABSENT") and the algorithm trains
+the causal transformer of pio_tpu/models/seqrec.py, whose training step
+shards dp × sp (ring attention) × tp × ep × pp over the mesh.
+
+engine.json:
+
+    {
+      "id": "seqrec",
+      "engineFactory": "templates.sequence",
+      "datasource": {"params": {"app_name": "myapp"}},
+      "algorithms": [{"name": "seqrec", "params":
+          {"d_model": 64, "n_layers": 2, "max_len": 64,
+           "seq_parallel": 1, "pipe_parallel": 1}}]
+    }
+
+Query ``{"user": "u1", "num": 4}`` (or ``{"history": ["i1", "i2"], ...}``)
+→ ``{"itemScores": [{"item": "i5", "score": 3.1}, ...]}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+    register_engine,
+)
+from pio_tpu.data.bimap import BiMap
+from pio_tpu.models.als import top_n
+from pio_tpu.models.seqrec import SeqRecConfig, SeqRecModel, train_seqrec
+from pio_tpu.parallel.context import ComputeContext
+from pio_tpu.parallel.mesh import MeshSpec, build_mesh
+from pio_tpu.storage import Storage
+from pio_tpu.templates.common import ItemScore, PredictedResult, resolve_app
+
+
+# --------------------------------------------------------------- data source
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    app_id: int = 0
+    channel: str = ""
+    #: events whose target entity enters the user's history, in time order
+    event_names: Tuple[str, ...] = ("view", "buy", "rate")
+    min_history: int = 2
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    #: per user: time-ordered item-id history
+    histories: Dict[str, List[str]]
+
+    def sanity_check(self) -> None:
+        if not self.histories:
+            raise ValueError(
+                "TrainingData is empty - no user event streams found. "
+                "Did you import events for this app?"
+            )
+
+    def __len__(self):
+        return len(self.histories)
+
+
+class SequenceDataSource(DataSource):
+    """Full event streams per user, ordered by eventTime."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        p: DataSourceParams = self.params
+        app_id, channel_id = resolve_app(p)
+        frame = Storage.get_pevents().find_frame(
+            app_id,
+            channel_id=channel_id,
+            event_names=list(p.event_names),
+            entity_type="user",
+            target_entity_type="item",
+        )
+        order = np.argsort(frame.event_time_us, kind="stable")
+        histories: Dict[str, List[str]] = {}
+        for i in order:
+            histories.setdefault(str(frame.entity_id[i]), []).append(
+                str(frame.target_entity_id[i])
+            )
+        histories = {
+            u: h for u, h in histories.items() if len(h) >= p.min_history
+        }
+        return TrainingData(histories=histories)
+
+
+# --------------------------------------------------------------- preparator
+@dataclasses.dataclass
+class PreparedData:
+    item_index: BiMap  # code 0 is reserved for padding
+    sequences: np.ndarray  # [n_users, T] int32, right-padded with 0
+    user_rows: Dict[str, int]  # user id → row in sequences
+
+
+class SequencePreparator(Preparator):
+    """Index items (code 0 = pad) and pack histories into a dense matrix."""
+
+    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
+        all_items: List[str] = []
+        for h in td.histories.values():
+            all_items.extend(h)
+        # BiMap codes start at 0; shift by +1 so 0 stays the pad id
+        item_index = BiMap.string_int(all_items)
+        fwd = item_index.to_dict()
+        users = sorted(td.histories)
+        t = max(len(td.histories[u]) for u in users)
+        seqs = np.zeros((len(users), t), np.int32)
+        for r, u in enumerate(users):
+            h = td.histories[u]
+            seqs[r, : len(h)] = [fwd[i] + 1 for i in h]
+        return PreparedData(
+            item_index=item_index,
+            sequences=seqs,
+            user_rows={u: r for r, u in enumerate(users)},
+        )
+
+
+# ----------------------------------------------------------------- algorithm
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str = ""
+    history: Tuple[str, ...] = ()  # anonymous/session queries
+    num: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqRecParams(Params):
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    ffn: int = 128
+    max_len: int = 64
+    learning_rate: float = 1e-3
+    steps: int = 300
+    seed: int = 0
+    #: mesh splits; remaining devices ride the data axis
+    seq_parallel: int = 1
+    pipe_parallel: int = 1
+    model_parallel: int = 1
+
+
+@dataclasses.dataclass
+class SeqRecEngineModel:
+    model: SeqRecModel
+    item_index: BiMap
+    #: training-time histories for user-id queries
+    user_histories: Dict[str, List[int]]
+
+
+class SeqRecAlgorithm(Algorithm):
+    """Causal-transformer next-item training over the packed histories."""
+
+    params_class = SeqRecParams
+    query_class = Query
+
+    def _mesh(self, ctx: ComputeContext):
+        p: SeqRecParams = self.params
+        if ctx.mesh is None:
+            return None
+        devices = list(ctx.mesh.devices.flat)
+        n = len(devices)
+        sp = max(1, min(p.seq_parallel, n))
+        pp = max(1, min(p.pipe_parallel, n // sp))
+        mp = max(1, min(p.model_parallel, n // (sp * pp)))
+        return build_mesh(
+            MeshSpec(data=-1, seq=sp, pipe=pp, model=mp), devices=devices
+        )
+
+    def train(
+        self, ctx: ComputeContext, pd: PreparedData
+    ) -> SeqRecEngineModel:
+        p: SeqRecParams = self.params
+        mesh = self._mesh(ctx)
+        seqs = pd.sequences
+        if seqs.shape[1] > p.max_len:
+            # keep each user's NEWEST max_len events — serving scores the
+            # tail of the history (predict's codes[-t:]), so training on
+            # the head would skew heavy users onto stale behavior
+            out = np.zeros((len(seqs), p.max_len), np.int32)
+            for r in range(len(seqs)):
+                codes = seqs[r][seqs[r] > 0][-p.max_len :]
+                out[r, : len(codes)] = codes
+            seqs = out
+        model = train_seqrec(
+            mesh,
+            seqs,
+            n_items=len(pd.item_index),
+            config=SeqRecConfig(
+                d_model=p.d_model,
+                n_heads=p.n_heads,
+                n_layers=p.n_layers,
+                ffn=p.ffn,
+                max_len=p.max_len,
+                learning_rate=p.learning_rate,
+                steps=p.steps,
+                seed=p.seed,
+            ),
+        )
+        user_histories = {
+            u: [int(x) for x in pd.sequences[r] if x > 0]
+            for u, r in pd.user_rows.items()
+        }
+        return SeqRecEngineModel(model, pd.item_index, user_histories)
+
+    def _history_codes(
+        self, model: SeqRecEngineModel, query: Query
+    ) -> Optional[List[int]]:
+        if query.history:
+            fwd = model.item_index.to_dict()
+            codes = [
+                fwd[i] + 1 for i in query.history if i in fwd
+            ]
+            return codes or None
+        return model.user_histories.get(query.user)
+
+    def predict(
+        self, model: SeqRecEngineModel, query: Query
+    ) -> PredictedResult:
+        codes = self._history_codes(model, query)
+        if not codes:
+            return PredictedResult()  # unknown user / empty history
+        t = model.model.config.max_len
+        row = np.zeros((1, t), np.int32)
+        tail = codes[-t:]
+        row[0, : len(tail)] = tail
+        scores = model.model.next_item_scores(row)[0]
+        idx, vals = top_n(scores[1:], query.num)  # shift off the pad row
+        inv = model.item_index.inverse
+        return PredictedResult(
+            tuple(
+                ItemScore(inv[int(i)], float(v))
+                for i, v in zip(idx, vals)
+            )
+        )
+
+
+class SequenceServing(FirstServing):
+    pass
+
+
+@register_engine("templates.sequence")
+def sequence_engine() -> Engine:
+    return Engine(
+        SequenceDataSource,
+        SequencePreparator,
+        {"seqrec": SeqRecAlgorithm},
+        SequenceServing,
+    )

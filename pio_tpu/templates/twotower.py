@@ -1,0 +1,155 @@
+"""Two-tower retrieval template — neural personalized recommendation.
+
+BASELINE.json config #5 ("Two-tower / Wide&Deep recommender template") —
+capability-forward: the reference's recommenders are ALS-factor based
+(examples/scala-parallel-{recommendation,similarproduct} — UNVERIFIED
+paths; SURVEY.md §2.5); this template serves the same query shape from a
+learned two-tower model (pio_tpu/models/two_tower.py) whose training step
+shards dp × tp × ep over the device mesh.
+
+engine.json:
+
+    {
+      "id": "twotower",
+      "engineFactory": "templates.twotower",
+      "datasource": {"params": {"app_name": "myapp"}},
+      "algorithms": [{"name": "twotower", "params":
+          {"out_dim": 64, "steps": 500, "model_parallel": 1}}]
+    }
+
+Query ``{"user": "u1", "num": 4}`` →
+``{"itemScores": [{"item": "i5", "score": 0.93}, ...]}`` — identical wire
+shape to the recommendation template, so clients can switch engines without
+code changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from pio_tpu.controller import (
+    Algorithm,
+    Engine,
+    FirstServing,
+    Params,
+    register_engine,
+)
+from pio_tpu.data.bimap import BiMap
+from pio_tpu.models.two_tower import (
+    TwoTowerConfig,
+    TwoTowerModel,
+    train_two_tower,
+)
+from pio_tpu.models.als import top_n
+from pio_tpu.parallel.context import ComputeContext
+from pio_tpu.parallel.mesh import MeshSpec, build_mesh
+from pio_tpu.templates.common import ItemScore, PredictedResult
+from pio_tpu.templates.recommendation import (
+    PreparedData,
+    Query,
+    RecommendationDataSource,
+    RecommendationPreparator,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoTowerParams(Params):
+    embed_dim: int = 64
+    hidden: int = 128
+    out_dim: int = 64
+    temperature: float = 20.0
+    learning_rate: float = 1e-3
+    steps: int = 500
+    batch_size: int = 256
+    seed: int = 0
+    #: mesh split: model axis size (tp/ep); remaining devices ride data (dp)
+    model_parallel: int = 1
+
+
+@dataclasses.dataclass
+class TwoTowerEngineModel:
+    model: TwoTowerModel
+    user_index: BiMap
+    item_index: BiMap
+
+
+class TwoTowerAlgorithm(Algorithm):
+    """Contrastive two-tower training on the interaction pairs."""
+
+    params_class = TwoTowerParams
+    query_class = Query
+
+    def _mesh(self, ctx: ComputeContext):
+        p: TwoTowerParams = self.params
+        if ctx.mesh is None:
+            return None
+        devices = list(ctx.mesh.devices.flat)
+        mp = max(1, min(p.model_parallel, len(devices)))
+        return build_mesh(
+            MeshSpec(data=-1, model=mp), devices=devices
+        )
+
+    def train(
+        self, ctx: ComputeContext, pd: PreparedData
+    ) -> TwoTowerEngineModel:
+        p: TwoTowerParams = self.params
+        model = train_two_tower(
+            self._mesh(ctx),
+            pd.user_codes,
+            pd.item_codes,
+            n_users=len(pd.user_index),
+            n_items=len(pd.item_index),
+            config=TwoTowerConfig(
+                embed_dim=p.embed_dim,
+                hidden=p.hidden,
+                out_dim=p.out_dim,
+                temperature=p.temperature,
+                learning_rate=p.learning_rate,
+                steps=p.steps,
+                batch_size=p.batch_size,
+                seed=p.seed,
+            ),
+        )
+        return TwoTowerEngineModel(model, pd.user_index, pd.item_index)
+
+    def predict(
+        self, model: TwoTowerEngineModel, query: Query
+    ) -> PredictedResult:
+        code = model.user_index.get(query.user)
+        if code is None:
+            return PredictedResult()  # unknown user → empty (ALS parity)
+        scores = model.model.scores(
+            model.model.user_vectors[code][None]
+        )[0]
+        if query.item:
+            icode = model.item_index.get(query.item)
+            if icode is None:
+                return PredictedResult()
+            return PredictedResult(
+                (ItemScore(query.item, float(scores[icode])),)
+            )
+        idx, vals = top_n(scores, query.num)
+        inv = model.item_index.inverse
+        return PredictedResult(
+            tuple(
+                ItemScore(inv[int(i)], float(v))
+                for i, v in zip(idx, vals)
+            )
+        )
+
+
+class TwoTowerServing(FirstServing):
+    pass
+
+
+@register_engine("templates.twotower")
+def twotower_engine() -> Engine:
+    return Engine(
+        RecommendationDataSource,
+        RecommendationPreparator,
+        {"twotower": TwoTowerAlgorithm},
+        TwoTowerServing,
+    )

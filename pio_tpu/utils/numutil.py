@@ -1,0 +1,8 @@
+"""Small numeric helpers shared across models/ops."""
+
+from __future__ import annotations
+
+
+def round_up(x: int, mult: int) -> int:
+    """Smallest multiple of ``mult`` ≥ ``x``."""
+    return -(-x // mult) * mult

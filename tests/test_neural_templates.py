@@ -1,0 +1,203 @@
+"""End-to-end lifecycle tests for the two-tower and sequence templates.
+
+Same quickstart shape as the reference's integration scenarios
+(tests/pio_tests/scenarios/quickstart_test.py — UNVERIFIED; SURVEY.md §4):
+import events → train → load models → query.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+import pio_tpu.templates  # noqa: F401  (registers engine factories)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.storage import App, Storage
+from pio_tpu.templates.common import PredictedResult
+from pio_tpu.workflow import (
+    build_engine,
+    load_models_for_instance,
+    run_train,
+    variant_from_dict,
+)
+
+
+@pytest.fixture(autouse=True)
+def _home(tmp_home):
+    return tmp_home
+
+
+GROUPS = 4
+N_USERS, N_ITEMS = 16, 16
+
+
+def _seed_interactions(app_id):
+    """User u views/buys items from group u % GROUPS, in time order."""
+    le = Storage.get_levents()
+    rng = np.random.default_rng(0)
+    per = N_ITEMS // GROUPS
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    for u in range(N_USERS):
+        group = u % GROUPS
+        for k in range(12):
+            item = group * per + rng.integers(0, per)
+            le.insert(
+                Event(
+                    event="view" if k % 3 else "buy",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{item}",
+                    event_time=t0 + dt.timedelta(minutes=int(k)),
+                ),
+                app_id,
+            )
+
+
+def _train_and_serve(variant_dict, query):
+    variant = variant_from_dict(variant_dict)
+    engine, ep = build_engine(variant)
+    ctx = ComputeContext.create(seed=0)
+    instance_id = run_train(engine, ep, variant, ctx=ctx)
+    models = load_models_for_instance(instance_id, engine, ep, ctx)
+    serving = engine.make_serving(ep)
+    pairs = engine.algorithms_with_models(ep, models)
+    return serving.serve(query, [a.predict(m, query) for a, m in pairs])
+
+
+class TestTwoTowerTemplate:
+    def test_full_lifecycle(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "tt-test"))
+        _seed_interactions(app_id)
+        from pio_tpu.templates.twotower import Query
+
+        result = _train_and_serve(
+            {
+                "id": "tt",
+                "engineFactory": "templates.twotower",
+                "datasource": {
+                    "params": {"app_name": "tt-test", "rate_event": "view"}
+                },
+                "algorithms": [
+                    {
+                        "name": "twotower",
+                        "params": {
+                            "embed_dim": 16,
+                            "hidden": 32,
+                            "out_dim": 16,
+                            "steps": 200,
+                            "batch_size": 64,
+                            "model_parallel": 2,
+                        },
+                    }
+                ],
+            },
+            Query(user="u1", num=3),
+        )
+        assert isinstance(result, PredictedResult)
+        assert len(result.item_scores) == 3
+        per = N_ITEMS // GROUPS
+        group_of = lambda item: int(item[1:]) // per  # noqa: E731
+        hits = sum(
+            group_of(s.item) == 1 % GROUPS for s in result.item_scores
+        )
+        assert hits >= 2  # top-3 dominated by the user's group
+
+    def test_unknown_user_empty(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "tt-test"))
+        _seed_interactions(app_id)
+        from pio_tpu.templates.twotower import Query
+
+        result = _train_and_serve(
+            {
+                "id": "tt",
+                "engineFactory": "templates.twotower",
+                "datasource": {
+                    "params": {"app_name": "tt-test", "rate_event": "view"}
+                },
+                "algorithms": [
+                    {
+                        "name": "twotower",
+                        "params": {"embed_dim": 8, "hidden": 16,
+                                   "out_dim": 8, "steps": 5},
+                    }
+                ],
+            },
+            Query(user="nobody", num=3),
+        )
+        assert result.item_scores == ()
+
+
+class TestSequenceTemplate:
+    def _variant(self, **algo_params):
+        params = {
+            "d_model": 32,
+            "n_heads": 4,
+            "n_layers": 2,
+            "ffn": 64,
+            "max_len": 16,
+            "steps": 250,
+            "learning_rate": 3e-3,
+        }
+        params.update(algo_params)
+        return {
+            "id": "sr",
+            "engineFactory": "templates.sequence",
+            "datasource": {"params": {"app_name": "sr-test"}},
+            "algorithms": [{"name": "seqrec", "params": params}],
+        }
+
+    def _seed_cycles(self, app_id, V=8):
+        """Every user walks the item cycle i0→i1→…→i{V-1}→i0…"""
+        le = Storage.get_levents()
+        t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        rng = np.random.default_rng(1)
+        for u in range(12):
+            start = rng.integers(0, V)
+            for k in range(10):
+                le.insert(
+                    Event(
+                        event="view",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{(start + k) % V}",
+                        event_time=t0 + dt.timedelta(minutes=int(k)),
+                    ),
+                    app_id,
+                )
+
+    def test_full_lifecycle_user_query(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "sr-test"))
+        self._seed_cycles(app_id)
+        from pio_tpu.templates.sequence import Query
+
+        # user u0's history ends at some item ik → next should be i(k+1)%V
+        result = _train_and_serve(
+            self._variant(seq_parallel=2, pipe_parallel=2),
+            Query(user="u0", num=1),
+        )
+        assert len(result.item_scores) == 1
+
+    def test_history_query_predicts_cycle(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "sr-test"))
+        self._seed_cycles(app_id)
+        from pio_tpu.templates.sequence import Query
+
+        result = _train_and_serve(
+            self._variant(),
+            Query(history=("i0", "i1", "i2", "i3"), num=1),
+        )
+        assert result.item_scores[0].item == "i4"
+
+    def test_empty_history_empty_result(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "sr-test"))
+        self._seed_cycles(app_id)
+        from pio_tpu.templates.sequence import Query
+
+        result = _train_and_serve(
+            self._variant(steps=5),
+            Query(user="ghost", num=3),
+        )
+        assert result.item_scores == ()
