@@ -166,8 +166,32 @@ class Engine:
             log.info("stopping after prepare (stop_after_prepare)")
             return []
         models = []
-        for algo in self._algorithms(engine_params):
-            models.append(algo.train(ctx, pd))
+        algo_names = [n for n, _ in engine_params.algorithm_params_list]
+        for i, algo in enumerate(self._algorithms(engine_params)):
+            algo_ctx = ctx
+            manager = None
+            if (
+                getattr(ctx, "checkpoint_base", None)
+                and getattr(ctx, "checkpoint_every", 0) > 0
+            ):
+                import dataclasses as _dc
+                import os as _os
+
+                from pio_tpu.workflow.checkpoint import CheckpointManager
+
+                # per-algorithm subdir: two algorithms in one engine must
+                # never restore each other's snapshots
+                manager = CheckpointManager(
+                    _os.path.join(
+                        ctx.checkpoint_base, f"algo{i}_{algo_names[i]}"
+                    )
+                )
+                algo_ctx = _dc.replace(ctx, checkpoint=manager)
+            try:
+                models.append(algo.train(algo_ctx, pd))
+            finally:
+                if manager is not None:
+                    manager.close()
         return models
 
     # -- eval (reference object Engine.eval) ---------------------------------

@@ -309,6 +309,8 @@ def train_seqrec(
     sequences: np.ndarray,
     n_items: int,
     config: SeqRecConfig = SeqRecConfig(),
+    checkpoint=None,
+    checkpoint_every: int = 0,
 ) -> SeqRecModel:
     """Next-item training over padded histories.
 
@@ -317,6 +319,9 @@ def train_seqrec(
             single-device.
         sequences: [n, T] int32, item ids ≥ 1, 0 = pad (right-padded).
         n_items: vocabulary size (ids are 1..n_items; row 0 = pad).
+        checkpoint/checkpoint_every: optional
+            pio_tpu.workflow.checkpoint.CheckpointManager + snapshot
+            interval in steps; resumes from the newest snapshot on restart.
     """
     import jax
     import jax.numpy as jnp
@@ -346,7 +351,15 @@ def train_seqrec(
             f"max_len {cfg.max_len} not a multiple of seq axis {n_seq}"
         )
     buf = np.zeros((_round_up(n, n_data), t_pad), np.int32)
-    buf[:n, : min(t, t_pad)] = seqs[:, :t_pad]
+    if t <= t_pad:
+        buf[:n, :t] = seqs
+    else:
+        # keep each row's NEWEST t_pad events: serving scores the tail of
+        # the history (next_item_scores on codes[-max_len:]), so training
+        # on the head would skew heavy users onto stale behavior
+        for r in range(n):
+            codes = seqs[r][seqs[r] > 0][-t_pad:]
+            buf[r, : len(codes)] = codes
     seqs = buf
 
     # next-item targets: target[t] = seq[t+1]; last position unsupervised
@@ -386,22 +399,6 @@ def train_seqrec(
             check_vma=False,
         )(params, seqs, targets, mask)
 
-    def fit(params, seqs, targets, mask):
-        opt_state = tx.init(params)
-
-        def step(carry, _):
-            params, opt_state = carry
-            loss, grads = jax.value_and_grad(global_loss)(
-                params, seqs, targets, mask
-            )
-            updates, opt_state = tx.update(grads, opt_state, params)
-            return (optax.apply_updates(params, updates), opt_state), loss
-
-        (params, _), losses = jax.lax.scan(
-            step, (params, opt_state), None, length=cfg.steps
-        )
-        return params, losses
-
     mask = mask.astype(np.float32)
     if mesh is not None:
         psh = jax.tree.map(
@@ -411,19 +408,48 @@ def train_seqrec(
         )
         params = jax.tree.map(jax.device_put, params, psh)
         dsh = NamedSharding(mesh, P("data", "seq"))
-        fitted, losses = jax.jit(fit)(
-            params,
-            jax.device_put(jnp.asarray(seqs), dsh),
-            jax.device_put(jnp.asarray(targets), dsh),
-            jax.device_put(jnp.asarray(mask), dsh),
-        )
+        seqs_d = jax.device_put(jnp.asarray(seqs), dsh)
+        targets_d = jax.device_put(jnp.asarray(targets), dsh)
+        mask_d = jax.device_put(jnp.asarray(mask), dsh)
     else:
-        fitted, losses = jax.jit(fit)(
-            params,
-            jnp.asarray(seqs),
-            jnp.asarray(targets),
-            jnp.asarray(mask),
+        seqs_d = jnp.asarray(seqs)
+        targets_d = jnp.asarray(targets)
+        mask_d = jnp.asarray(mask)
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def chunk_fn(state, n):
+        step0, params, opt_state = state
+
+        def step(carry, _):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(global_loss)(
+                params, seqs_d, targets_d, mask_d
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, opt_state), _ = jax.lax.scan(
+            step, (params, opt_state), None, length=n
         )
+        return step0 + n, params, opt_state
+
+    from pio_tpu.workflow.checkpoint import (
+        run_chunked_steps,
+        state_fingerprint,
+    )
+
+    # steps excluded: resume with a different total must still match
+    fingerprint = state_fingerprint(
+        "seqrec", dataclasses.replace(cfg, steps=0), n_items, seqs.shape,
+        int(seqs.sum()),
+    )
+    state = (jnp.int32(0), params, jax.jit(tx.init)(params))
+    state = run_chunked_steps(
+        state, cfg.steps, chunk_fn,
+        checkpoint=checkpoint, checkpoint_every=checkpoint_every,
+        fingerprint=fingerprint,
+    )
+    fitted = state[1]
 
     host = jax.tree.map(lambda a: np.asarray(a), fitted)
     host["emb"] = host["emb"][: n_items + 1]

@@ -159,6 +159,8 @@ def train_two_tower(
     n_users: int,
     n_items: int,
     config: TwoTowerConfig = TwoTowerConfig(),
+    checkpoint=None,
+    checkpoint_every: int = 0,
 ) -> TwoTowerModel:
     """Train on positive (user, item) pairs; returns unit vector tables.
 
@@ -166,6 +168,9 @@ def train_two_tower(
         mesh: a build_mesh() mesh (data/model axes used; seq/pipe ignored).
             None → single-device path (no collectives).
         user_ids/item_ids: [n_pairs] int32 positive interaction pairs.
+        checkpoint/checkpoint_every: optional
+            pio_tpu.workflow.checkpoint.CheckpointManager + snapshot
+            interval in steps; resumes from the newest snapshot on restart.
     """
     import jax
     import jax.numpy as jnp
@@ -224,23 +229,6 @@ def train_two_tower(
             check_vma=False,
         )(params["user"], params["item"], ub, ib)
 
-    def fit(params, uids, iids):
-        opt_state = tx.init(params)
-
-        def step(carry, s):
-            params, opt_state = carry
-            start = (s % n_batches) * batch
-            ub = jax.lax.dynamic_slice_in_dim(uids, start, batch)
-            ib = jax.lax.dynamic_slice_in_dim(iids, start, batch)
-            loss, grads = jax.value_and_grad(global_loss)(params, ub, ib)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            return (optax.apply_updates(params, updates), opt_state), loss
-
-        (params, _), losses = jax.lax.scan(
-            step, (params, opt_state), jnp.arange(cfg.steps)
-        )
-        return params, losses
-
     if mesh is not None:
         param_shardings = jax.tree.map(
             lambda spec: NamedSharding(mesh, spec),
@@ -251,15 +239,47 @@ def train_two_tower(
         )
         params = jax.tree.map(jax.device_put, params, param_shardings)
         data_sh = NamedSharding(mesh, P(None))
-        fitted, losses = jax.jit(fit)(
-            params,
-            jax.device_put(jnp.asarray(uids), data_sh),
-            jax.device_put(jnp.asarray(iids), data_sh),
-        )
+        uids_d = jax.device_put(jnp.asarray(uids), data_sh)
+        iids_d = jax.device_put(jnp.asarray(iids), data_sh)
     else:
-        fitted, losses = jax.jit(fit)(
-            params, jnp.asarray(uids), jnp.asarray(iids)
+        uids_d, iids_d = jnp.asarray(uids), jnp.asarray(iids)
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def chunk_fn(state, n):
+        step0, params, opt_state = state
+
+        def step(carry, i):
+            params, opt_state = carry
+            start = ((step0 + i) % n_batches) * batch
+            ub = jax.lax.dynamic_slice_in_dim(uids_d, start, batch)
+            ib = jax.lax.dynamic_slice_in_dim(iids_d, start, batch)
+            loss, grads = jax.value_and_grad(global_loss)(params, ub, ib)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, opt_state), _ = jax.lax.scan(
+            step, (params, opt_state), jnp.arange(n)
         )
+        return step0 + n, params, opt_state
+
+    from pio_tpu.workflow.checkpoint import (
+        run_chunked_steps,
+        state_fingerprint,
+    )
+
+    # steps excluded: resuming an interrupted run with a higher/lower
+    # total must still match the recorded identity
+    fingerprint = state_fingerprint(
+        "two_tower", dataclasses.replace(cfg, steps=0), n_users, n_items,
+        reps, int(uids.sum()), int(iids.sum()),
+    )
+    state = (jnp.int32(0), params, jax.jit(tx.init)(params))
+    state = run_chunked_steps(
+        state, cfg.steps, chunk_fn,
+        checkpoint=checkpoint, checkpoint_every=checkpoint_every,
+        fingerprint=fingerprint,
+    )
+    fitted = state[1]
 
     # materialize full vector tables (chunked matmuls, replicated output)
     def vectors(tower_params, vocab, specs_t):

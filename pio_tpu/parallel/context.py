@@ -54,6 +54,13 @@ class ComputeContext:
     seed: int = 0
     batch_axis: str = "data"
     model_axis: str = "model"
+    #: checkpointing (WorkflowParams.checkpoint_every > 0): run_train sets
+    #: ``checkpoint_base`` (a directory) + ``checkpoint_every``;
+    #: Engine.train derives a per-algorithm CheckpointManager into
+    #: ``checkpoint`` so concurrent algorithms never share snapshot state
+    checkpoint: Optional[object] = None
+    checkpoint_base: Optional[str] = None
+    checkpoint_every: int = 0
 
     @staticmethod
     def create(seed: int = 0, axis_names: Tuple[str, ...] = ("data",)):

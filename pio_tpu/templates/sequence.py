@@ -189,19 +189,11 @@ class SeqRecAlgorithm(Algorithm):
     ) -> SeqRecEngineModel:
         p: SeqRecParams = self.params
         mesh = self._mesh(ctx)
-        seqs = pd.sequences
-        if seqs.shape[1] > p.max_len:
-            # keep each user's NEWEST max_len events — serving scores the
-            # tail of the history (predict's codes[-t:]), so training on
-            # the head would skew heavy users onto stale behavior
-            out = np.zeros((len(seqs), p.max_len), np.int32)
-            for r in range(len(seqs)):
-                codes = seqs[r][seqs[r] > 0][-p.max_len :]
-                out[r, : len(codes)] = codes
-            seqs = out
+        # train_seqrec keeps each row's NEWEST max_len events (tail), the
+        # same window predict scores
         model = train_seqrec(
             mesh,
-            seqs,
+            pd.sequences,
             n_items=len(pd.item_index),
             config=SeqRecConfig(
                 d_model=p.d_model,
@@ -213,6 +205,8 @@ class SeqRecAlgorithm(Algorithm):
                 steps=p.steps,
                 seed=p.seed,
             ),
+            checkpoint=ctx.checkpoint,
+            checkpoint_every=ctx.checkpoint_every,
         )
         user_histories = {
             u: [int(x) for x in pd.sequences[r] if x > 0]

@@ -112,6 +112,8 @@ class TwoTowerAlgorithm(Algorithm):
                 batch_size=p.batch_size,
                 seed=p.seed,
             ),
+            checkpoint=ctx.checkpoint,
+            checkpoint_every=ctx.checkpoint_every,
         )
         return TwoTowerEngineModel(model, pd.user_index, pd.item_index)
 

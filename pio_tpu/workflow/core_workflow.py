@@ -114,6 +114,32 @@ def run_train(
     instance = instances.get(instance_id)
     log.info("training started: instance %s", instance_id)
 
+    if workflow_params.checkpoint_every > 0:
+        from pio_tpu.workflow.checkpoint import (
+            default_checkpoint_dir,
+            state_fingerprint,
+        )
+
+        # Default dir keys on the engine variant + params (NOT the per-run
+        # instance id): a preempted run restarted with the same config
+        # finds its snapshots; the data fingerprint recorded inside guards
+        # against resuming across a data change.
+        stable_key = state_fingerprint(
+            variant.engine_id,
+            variant.engine_factory,
+            instance.data_source_params,
+            instance.preparator_params,
+            instance.algorithms_params,
+        )
+        ckpt_dir = workflow_params.checkpoint_dir or default_checkpoint_dir(
+            stable_key
+        )
+        ctx = dataclasses.replace(
+            ctx,
+            checkpoint_base=ckpt_dir,
+            checkpoint_every=workflow_params.checkpoint_every,
+        )
+
     t0 = time.monotonic()
     try:
         models = engine.train(

@@ -16,3 +16,10 @@ class WorkflowParams:
     stop_after_read: bool = False
     stop_after_prepare: bool = False
     seed: int = 0
+    #: >0 → snapshot train state every N steps (capability beyond the
+    #: reference; SURVEY.md §5). Algorithms that support it read the
+    #: manager off the ComputeContext.
+    checkpoint_every: int = 0
+    #: explicit snapshot dir; default is per-engine-instance (set this to
+    #: resume a preempted run under a NEW instance id)
+    checkpoint_dir: str = ""
