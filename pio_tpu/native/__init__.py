@@ -1,0 +1,114 @@
+"""Native (C++) runtime components, built on first use.
+
+The reference's native substrate is the JVM + Spark (no C++/CUDA anywhere —
+SURVEY.md §2); this package holds the rebuild's own native pieces:
+
+- ``event_log.cpp`` — append-only binary event log with C++ filtered scan
+  (pio_tpu/storage/eventlog.py wraps it as a storage backend).
+
+Build model: no wheels, no pybind11 — ``g++ -O2 -shared -fPIC`` at first
+import, cached under ``$PIO_TPU_HOME/native/<source-sha>.so`` so rebuilds
+happen only when the source changes. ctypes loads the result. Environments
+without a toolchain get :class:`NativeUnavailable` and callers fall back to
+pure-Python backends.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger("pio_tpu.native")
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+class NativeUnavailable(RuntimeError):
+    """No compiler / compile failed — use a pure-Python backend instead."""
+
+
+def _build_dir() -> str:
+    home = os.environ.get("PIO_TPU_HOME") or os.path.expanduser("~/.pio_tpu")
+    d = os.path.join(home, "native")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def build_library(name: str) -> str:
+    """Compile ``<name>.cpp`` (beside this file) → cached .so path."""
+    src = os.path.join(os.path.dirname(__file__), f"{name}.cpp")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_build_dir(), f"{name}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    tmp = f"{out}.{os.getpid()}.tmp"  # per-process: concurrent first builds
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-o", tmp, src,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise NativeUnavailable(f"cannot run g++: {e}") from e
+    if proc.returncode != 0:
+        raise NativeUnavailable(
+            f"g++ failed for {src}:\n{proc.stderr[-2000:]}"
+        )
+    os.replace(tmp, out)
+    log.info("built native library %s", out)
+    return out
+
+
+_NUM_STR = 9  # string columns in a PelResult (see event_log.cpp)
+
+
+class PelResult(ctypes.Structure):
+    _fields_ = [
+        ("n", ctypes.c_int64),
+        ("time_us", ctypes.POINTER(ctypes.c_int64)),
+        ("ctime_us", ctypes.POINTER(ctypes.c_int64)),
+        # POINTER(c_char), not c_char_p: arenas are length-delimited binary
+        # (c_char_p would truncate at the first NUL on conversion)
+        ("arena", ctypes.POINTER(ctypes.c_char) * _NUM_STR),
+        ("off", ctypes.POINTER(ctypes.c_uint32) * _NUM_STR),
+    ]
+
+
+def event_log_lib():
+    """Load (building if needed) the event-log library; cached."""
+    with _lock:
+        if "event_log" in _cache:
+            return _cache["event_log"]
+        lib = ctypes.CDLL(build_library("event_log"))
+        lib.pel_append.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64
+        ]
+        lib.pel_append.restype = ctypes.c_int
+        lib.pel_scan.argtypes = [
+            ctypes.c_char_p,  # path
+            ctypes.c_char_p, ctypes.c_int,  # event_names set, count
+            ctypes.c_char_p, ctypes.c_char_p,  # entity_type, entity_id
+            ctypes.c_char_p, ctypes.c_char_p,  # target type/id
+            ctypes.c_char_p,  # event_id
+            ctypes.c_int64, ctypes.c_int64,  # start, until (us)
+            ctypes.c_int, ctypes.c_int64,  # reversed, limit
+            ctypes.POINTER(PelResult),
+        ]
+        lib.pel_scan.restype = ctypes.c_int
+        lib.pel_free_result.argtypes = [ctypes.POINTER(PelResult)]
+        lib.pel_free_result.restype = None
+        lib.pel_count.argtypes = [ctypes.c_char_p]
+        lib.pel_count.restype = ctypes.c_int64
+        lib.pel_repair.argtypes = [ctypes.c_char_p]
+        lib.pel_repair.restype = ctypes.c_int64
+        _cache["event_log"] = lib
+        return lib

@@ -1,0 +1,343 @@
+// Native event-log storage engine — append-only binary log + filtered scan.
+//
+// The reference's at-scale event store is HBase with a hand-designed rowkey
+// (storage/hbase/.../HBEventsUtil.scala — UNVERIFIED path; SURVEY.md §2.3):
+// a network KV store the JVM queries per scan. This framework's native
+// equivalent is a local append-only record log per (app, channel) with the
+// filter/sort/tombstone logic in C++, so the training-read hot path
+// (PEvents.find_frame feeding DataSources) never loops over records in
+// Python. Exposed via a C ABI consumed with ctypes
+// (pio_tpu/native/__init__.py builds this file with g++ on first use).
+//
+// Record layout (little-endian), file = 8-byte magic "PEL1\0\0\0\0" then
+// records:
+//   u32  payload_len                  (bytes after this field)
+//   u8   flags                        (bit0 = tombstone: event_id names the
+//                                      record to delete)
+//   i64  event_time_us
+//   i64  creation_time_us
+//   u16  len[8]: event_id, event, entity_type, entity_id,
+//                target_entity_type, target_entity_id, pr_id, tags_json
+//   u32  len_props_json
+//   bytes: the 9 strings concatenated (utf-8)
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[8] = {'P', 'E', 'L', '1', 0, 0, 0, 0};
+constexpr int kNumStr = 9;  // 8 u16-length strings + props (u32 length)
+constexpr size_t kHeaderFixed = 1 + 8 + 8 + 8 * 2 + 4;
+
+struct Rec {
+  uint8_t flags;
+  int64_t time_us;
+  int64_t ctime_us;
+  const char* str[kNumStr];
+  uint32_t len[kNumStr];
+  int64_t seq;  // file order, for a stable sort
+};
+
+template <typename T>
+T read_le(const char* p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  return v;
+}
+
+// Parses whole records. A *torn tail* — a trailing partial record left by a
+// crash mid-append (the bytes are a prefix of one framed record) — is NOT
+// corruption: parsing stops there and *valid_end marks the end of the last
+// whole record, so committed data stays readable. Only mid-record
+// inconsistencies (bad magic, lengths that disagree within fully-present
+// bytes) return false.
+// out may be null (framing/validation walk only — no Rec materialization;
+// pel_repair uses this to find valid_end without O(records) memory).
+bool parse_records(const std::vector<char>& buf, std::vector<Rec>* out,
+                   size_t* valid_end) {
+  *valid_end = 0;
+  if (buf.size() < sizeof(kMagic)) return true;  // empty or torn magic
+  if (std::memcmp(buf.data(), kMagic, sizeof(kMagic)) != 0) return false;
+  size_t pos = sizeof(kMagic);
+  *valid_end = pos;
+  int64_t seq = 0;
+  while (pos + 4 <= buf.size()) {
+    uint32_t plen = read_le<uint32_t>(buf.data() + pos);
+    if (plen < kHeaderFixed) return false;
+    if (pos + 4 + plen > buf.size()) return true;  // torn tail
+    pos += 4;
+    const char* p = buf.data() + pos;
+    Rec r;
+    r.flags = static_cast<uint8_t>(*p);
+    r.time_us = read_le<int64_t>(p + 1);
+    r.ctime_us = read_le<int64_t>(p + 9);
+    size_t off = 17;
+    uint64_t total = 0;
+    for (int i = 0; i < kNumStr - 1; ++i) {
+      r.len[i] = read_le<uint16_t>(p + off);
+      off += 2;
+      total += r.len[i];
+    }
+    r.len[kNumStr - 1] = read_le<uint32_t>(p + off);
+    off += 4;
+    total += r.len[kNumStr - 1];
+    if (off + total != plen) return false;
+    const char* s = p + off;
+    for (int i = 0; i < kNumStr; ++i) {
+      r.str[i] = s;
+      s += r.len[i];
+    }
+    r.seq = seq++;
+    if (out) out->push_back(r);
+    pos += plen;
+    *valid_end = pos;
+  }
+  return true;
+}
+
+bool read_file(const char* path, std::vector<char>* buf) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return errno == ENOENT;  // only an absent file is an empty log
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  buf->resize(static_cast<size_t>(size));
+  size_t got = size ? std::fread(buf->data(), 1, buf->size(), f) : 0;
+  std::fclose(f);
+  return got == buf->size();
+}
+
+bool str_eq(const char* a, uint32_t alen, const char* b) {
+  return std::strlen(b) == alen && std::memcmp(a, b, alen) == 0;
+}
+
+// filter string sets arrive as "name1\0name2\0" (count separately)
+bool in_set(const char* s, uint32_t slen, const char* set, int count) {
+  const char* p = set;
+  for (int i = 0; i < count; ++i) {
+    size_t l = std::strlen(p);
+    if (l == slen && std::memcmp(p, s, l) == 0) return true;
+    p += l + 1;
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Columnar scan result. String column i: chars arena[off[i][k]..off[i][k+1])
+// for row k; off arrays have n+1 entries. Free with pel_free_result.
+typedef struct {
+  int64_t n;
+  int64_t* time_us;
+  int64_t* ctime_us;
+  char* arena[kNumStr];
+  uint32_t* off[kNumStr];
+} PelResult;
+
+void pel_free_result(PelResult* r);
+
+// Appends pre-encoded record bytes (Python frames them); creates the file
+// with magic if needed. Returns 0 on success.
+int pel_append(const char* path, const uint8_t* data, int64_t len) {
+  FILE* f = std::fopen(path, "ab");
+  if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  if (std::ftell(f) == 0) {
+    if (std::fwrite(kMagic, 1, sizeof(kMagic), f) != sizeof(kMagic)) {
+      std::fclose(f);
+      return -1;
+    }
+  }
+  size_t wrote = std::fwrite(data, 1, static_cast<size_t>(len), f);
+  // fflush+fclose must BOTH succeed: stdio buffering means fwrite can
+  // report full length while the actual write (ENOSPC, EIO) fails at
+  // flush — returning 0 then would claim persistence that never happened
+  bool flushed = std::fflush(f) == 0;
+  bool closed = std::fclose(f) == 0;
+  return (wrote == static_cast<size_t>(len) && flushed && closed) ? 0 : -1;
+}
+
+// Filtered scan. Empty-string filters mean "any"; event_names is a packed
+// set ("a\0b\0", event_name_count entries, 0 = any). start/until in
+// microseconds (INT64_MIN/MAX = unbounded; until is exclusive).
+// reversed != 0 → newest first. limit < 0 → no limit.
+// event_id filter ("" = any) serves LEvents.get. (The Python wrapper maps
+// explicit empty-string filters to "match nothing" before the ABI.)
+// Returns 0 ok, -1 io error, -2 corrupt file, -3 result too large
+// (a string column would overflow the u32 offset arrays), -4 out of
+// memory. Never throws across the C ABI.
+static int pel_scan_impl(const char* path, const char* event_names,
+                         int event_name_count, const char* entity_type,
+                         const char* entity_id,
+                         const char* target_entity_type,
+                         const char* target_entity_id, const char* event_id,
+                         int64_t start_us, int64_t until_us, int reversed,
+                         int64_t limit, PelResult* out) {
+  std::vector<char> buf;
+  if (!read_file(path, &buf)) return -1;
+  std::vector<Rec> recs;
+  size_t valid_end;
+  if (!parse_records(buf, &recs, &valid_end)) return -2;
+
+  // last-write-wins per event_id: the newest record for an id (data or
+  // tombstone) is authoritative. Re-insert after delete resurrects the id,
+  // and inserting an existing id replaces it — matching the upsert/delete
+  // semantics of the SQLite and memory backends.
+  std::unordered_map<std::string, int64_t> last;
+  for (const Rec& r : recs) last[std::string(r.str[0], r.len[0])] = r.seq;
+
+  std::vector<const Rec*> hits;
+  for (const Rec& r : recs) {
+    if (r.flags & 1) continue;
+    if (last[std::string(r.str[0], r.len[0])] != r.seq) continue;
+    if (r.time_us < start_us || r.time_us >= until_us) continue;
+    if (event_name_count > 0 &&
+        !in_set(r.str[1], r.len[1], event_names, event_name_count))
+      continue;
+    if (entity_type[0] && !str_eq(r.str[2], r.len[2], entity_type)) continue;
+    if (entity_id[0] && !str_eq(r.str[3], r.len[3], entity_id)) continue;
+    if (target_entity_type[0] &&
+        !str_eq(r.str[4], r.len[4], target_entity_type))
+      continue;
+    if (target_entity_id[0] &&
+        !str_eq(r.str[5], r.len[5], target_entity_id))
+      continue;
+    if (event_id[0] && !str_eq(r.str[0], r.len[0], event_id)) continue;
+    hits.push_back(&r);
+  }
+
+  std::sort(hits.begin(), hits.end(), [&](const Rec* a, const Rec* b) {
+    if (a->time_us != b->time_us)
+      return reversed ? a->time_us > b->time_us : a->time_us < b->time_us;
+    return reversed ? a->seq > b->seq : a->seq < b->seq;
+  });
+  if (limit >= 0 && static_cast<int64_t>(hits.size()) > limit)
+    hits.resize(static_cast<size_t>(limit));
+
+  const int64_t n = static_cast<int64_t>(hits.size());
+  out->n = n;
+  out->time_us =
+      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (n ? n : 1)));
+  out->ctime_us =
+      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (n ? n : 1)));
+  if (!out->time_us || !out->ctime_us) {
+    pel_free_result(out);
+    return -4;
+  }
+  for (int c = 0; c < kNumStr; ++c) {
+    uint64_t total = 0;
+    for (const Rec* r : hits) total += r->len[c];
+    if (total > UINT32_MAX) {
+      pel_free_result(out);
+      return -3;
+    }
+    out->arena[c] = static_cast<char*>(std::malloc(total ? total : 1));
+    out->off[c] =
+        static_cast<uint32_t*>(std::malloc(sizeof(uint32_t) * (n + 1)));
+    if (!out->arena[c] || !out->off[c]) {
+      pel_free_result(out);
+      return -4;
+    }
+    uint32_t pos = 0;
+    for (int64_t k = 0; k < n; ++k) {
+      out->off[c][k] = pos;
+      std::memcpy(out->arena[c] + pos, hits[k]->str[c], hits[k]->len[c]);
+      pos += hits[k]->len[c];
+    }
+    out->off[c][n] = pos;
+  }
+  for (int64_t k = 0; k < n; ++k) {
+    out->time_us[k] = hits[k]->time_us;
+    out->ctime_us[k] = hits[k]->ctime_us;
+  }
+  return 0;
+}
+
+int pel_scan(const char* path, const char* event_names,
+             int event_name_count, const char* entity_type,
+             const char* entity_id, const char* target_entity_type,
+             const char* target_entity_id, const char* event_id,
+             int64_t start_us, int64_t until_us, int reversed,
+             int64_t limit, PelResult* out) {
+  std::memset(out, 0, sizeof(*out));
+  try {
+    return pel_scan_impl(path, event_names, event_name_count, entity_type,
+                         entity_id, target_entity_type, target_entity_id,
+                         event_id, start_us, until_us, reversed, limit,
+                         out);
+  } catch (...) {  // bad_alloc from vector/string growth, most likely
+    pel_free_result(out);
+    return -4;
+  }
+}
+
+void pel_free_result(PelResult* r) {
+  std::free(r->time_us);
+  std::free(r->ctime_us);
+  for (int c = 0; c < kNumStr; ++c) {
+    std::free(r->arena[c]);
+    std::free(r->off[c]);
+  }
+  std::memset(r, 0, sizeof(*r));
+}
+
+// Count live (non-tombstoned) records; -1 io error, -2 corrupt, -4 oom.
+int64_t pel_count(const char* path) {
+  try {
+    std::vector<char> buf;
+    if (!read_file(path, &buf)) return -1;
+    std::vector<Rec> recs;
+    size_t valid_end;
+    if (!parse_records(buf, &recs, &valid_end)) return -2;
+    std::unordered_map<std::string, int64_t> last;
+    for (const Rec& r : recs) last[std::string(r.str[0], r.len[0])] = r.seq;
+    int64_t n = 0;
+    for (const Rec& r : recs)
+      if (!(r.flags & 1) &&
+          last[std::string(r.str[0], r.len[0])] == r.seq)
+        ++n;
+    return n;
+  } catch (...) {
+    return -4;
+  }
+}
+
+// Truncates a torn tail (partial record left by a crash mid-append) so
+// later appends don't land after unreachable bytes. Called by the Python
+// wrapper once per file before its first append in a process. Returns the
+// number of bytes dropped (0 = clean), -1 io error, -2 corrupt file,
+// -4 oom.
+int64_t pel_repair(const char* path) {
+  try {
+    std::vector<char> buf;
+    if (!read_file(path, &buf)) return -1;
+    if (buf.empty()) return 0;
+    size_t valid_end;
+    if (!parse_records(buf, nullptr, &valid_end)) return -2;
+    if (valid_end == buf.size()) return 0;
+    FILE* f = std::fopen(path, "rb+");
+    if (!f) return -1;
+    int rc = std::fflush(f) == 0 &&
+                     ftruncate(fileno(f), static_cast<off_t>(valid_end)) == 0
+                 ? 0
+                 : -1;
+    std::fclose(f);
+    return rc == 0 ? static_cast<int64_t>(buf.size() - valid_end) : -1;
+  } catch (...) {
+    return -4;
+  }
+}
+
+}  // extern "C"

@@ -19,7 +19,8 @@ Environment scheme (parity with the reference's ``PIO_STORAGE_*``):
 Unset → quickstart defaults under ``$PIO_TPU_HOME`` (default
 ``~/.pio_tpu``): SQLite for metadata + events, localfs for models.
 Backend types: ``sqlite``, ``memory``, ``parquet`` (events only),
-``localfs`` (models only).
+``eventlog`` (events only — native C++ append-only log, the at-scale
+event store), ``localfs`` (models only).
 """
 
 from __future__ import annotations
@@ -155,6 +156,17 @@ class Storage:
             SQLiteEvaluationInstances, "evaluation_instances", MemEvaluationInstances
         )
 
+    @classmethod
+    def _eventlog(cls, cfg: _SourceConfig):
+        from pio_tpu.storage.eventlog import EventLogEvents
+
+        path = cfg.path or os.path.join(pio_home(), "eventlog")
+        key = f"eventlog:{path}"
+        with cls._lock:
+            if key not in cls._clients:
+                cls._clients[key] = EventLogEvents(path)
+            return cls._clients[key]
+
     # -- event stores -------------------------------------------------------
     @classmethod
     def get_levents(cls) -> base.LEvents:
@@ -163,6 +175,8 @@ class Storage:
             return SQLiteEvents(cls._sqlite_client(cfg))
         if cfg.type == "memory":
             return cls._memory("levents", MemLEvents)
+        if cfg.type == "eventlog":
+            return cls._eventlog(cfg)
         if cfg.type == "parquet":
             raise StorageConfigError(
                 "parquet backend is bulk-only (PEvents); pair it with sqlite "
@@ -177,6 +191,8 @@ class Storage:
             return SQLitePEvents(SQLiteEvents(cls._sqlite_client(cfg)))
         if cfg.type == "memory":
             return MemPEvents(cls._memory("levents", MemLEvents))
+        if cfg.type == "eventlog":
+            return base.PEventsAdapter(cls._eventlog(cfg))
         if cfg.type == "parquet":
             path = cfg.path or os.path.join(pio_home(), "events")
             return ParquetPEvents(path)

@@ -1,0 +1,255 @@
+"""Native event-log backend specifics (beyond the shared conformance suite).
+
+The C++ engine (pio_tpu/native/event_log.cpp) is exercised through its
+ctypes wrapper; the conformance fixtures in tests/test_storage.py already
+run the full LEvents/PEvents spec over it.
+"""
+
+import datetime as dt
+import os
+
+import numpy as np
+import pytest
+
+from pio_tpu.data.event import Event
+
+try:
+    # the build happens lazily on first library load, not at module import,
+    # so force it here to turn "no toolchain" into a module-level skip
+    from pio_tpu.native import event_log_lib
+
+    event_log_lib()
+    from pio_tpu.storage.eventlog import EventLogEvents
+except Exception as e:  # pragma: no cover - no toolchain
+    pytest.skip(f"native eventlog unavailable: {e}", allow_module_level=True)
+
+
+def T(h):
+    return dt.datetime(2026, 1, 1, h, tzinfo=dt.timezone.utc)
+
+
+@pytest.fixture()
+def backend(tmp_path):
+    return EventLogEvents(str(tmp_path / "log"))
+
+
+class TestPersistence:
+    def test_reopen_sees_data(self, tmp_path):
+        root = str(tmp_path / "log")
+        b1 = EventLogEvents(root)
+        eid = b1.insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  properties={"rating": 3.0}, event_time=T(1)),
+            app_id=7,
+        )
+        b2 = EventLogEvents(root)  # fresh handle, same files
+        got = b2.get(eid, 7)
+        assert got is not None
+        assert got.properties.get_double("rating") == 3.0
+
+    def test_tombstone_survives_reopen(self, tmp_path):
+        root = str(tmp_path / "log")
+        b1 = EventLogEvents(root)
+        eid = b1.insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  event_time=T(1)),
+            app_id=1,
+        )
+        assert b1.delete(eid, 1)
+        b2 = EventLogEvents(root)
+        assert b2.get(eid, 1) is None
+        assert b2.count(1) == 0
+
+    def test_channels_are_separate_files(self, backend, tmp_path):
+        backend.insert(
+            Event(event="a", entity_type="u", entity_id="1",
+                  event_time=T(1)), 1
+        )
+        backend.insert(
+            Event(event="b", entity_type="u", entity_id="1",
+                  event_time=T(1)), 1, channel_id=4
+        )
+        files = sorted(os.listdir(backend.root))
+        assert files == ["app_1.pel", "app_1_ch4.pel"]
+        assert [e.event for e in backend.find(1)] == ["a"]
+        assert [e.event for e in backend.find(1, channel_id=4)] == ["b"]
+
+
+class TestLastWriteWins:
+    """Upsert/delete semantics must match the SQLite and memory backends."""
+
+    def test_reinsert_after_delete_resurrects(self, backend):
+        e = Event(event="rate", entity_type="user", entity_id="u1",
+                  event_time=T(1), event_id="X")
+        backend.insert(e, 1)
+        assert backend.delete("X", 1)
+        backend.insert(e, 1)
+        assert backend.get("X", 1) is not None
+        assert backend.count(1) == 1
+
+    def test_insert_same_id_replaces(self, backend):
+        backend.insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  properties={"rating": 3.0}, event_time=T(1),
+                  event_id="X"),
+            1,
+        )
+        backend.insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  properties={"rating": 5.0}, event_time=T(2),
+                  event_id="X"),
+            1,
+        )
+        assert backend.count(1) == 1
+        evs = backend.find(1)
+        assert len(evs) == 1
+        assert evs[0].properties.get_double("rating") == 5.0
+        assert backend.get("X", 1).properties.get_double("rating") == 5.0
+
+    def test_delete_bulk_batches(self, backend):
+        ids = [
+            backend.insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                      event_time=T(1)),
+                1,
+            )
+            for i in range(10)
+        ]
+        backend.delete_bulk(ids[:7] + ["missing-id"], 1)
+        assert backend.count(1) == 3
+        assert {e.event_id for e in backend.find(1)} == set(ids[7:])
+
+
+class TestRobustness:
+    def test_unreadable_file_is_an_error_not_empty(self, backend):
+        import stat
+
+        from pio_tpu.storage.base import StorageError
+
+        eid = backend.insert(
+            Event(event="a", entity_type="u", entity_id="1",
+                  event_time=T(1)),
+            3,
+        )
+        path = backend._path(3)
+        os.chmod(path, 0)
+        if os.access(path, os.R_OK):  # running as root: chmod is a no-op
+            os.chmod(path, stat.S_IRUSR | stat.S_IWUSR)
+            pytest.skip("cannot make file unreadable under this uid")
+        try:
+            with pytest.raises(StorageError):
+                backend.find(3)
+            with pytest.raises(StorageError):
+                backend.count(3)
+        finally:
+            os.chmod(path, stat.S_IRUSR | stat.S_IWUSR)
+        assert backend.get(eid, 3) is not None
+
+    def test_corrupt_file_raises_storage_error(self, backend):
+        import struct
+
+        from pio_tpu.storage.base import StorageError
+
+        # a fully-present record whose internal string lengths disagree
+        # with its framed length — real corruption, not a torn tail
+        with open(backend._path(9), "wb") as f:
+            f.write(
+                b"PEL1\0\0\0\0" + struct.pack("<I", 37) + b"\xff" * 37
+            )
+        with pytest.raises(StorageError, match="corrupt"):
+            backend.find(9)
+
+    def test_bad_magic_raises_storage_error(self, backend):
+        from pio_tpu.storage.base import StorageError
+
+        with open(backend._path(8), "wb") as f:
+            f.write(b"NOTMAGIC" + b"\x00" * 32)
+        with pytest.raises(StorageError, match="corrupt"):
+            backend.find(8)
+
+    def test_torn_tail_is_tolerated_and_repaired(self, backend):
+        """A crash mid-append must not brick the log (torn-tail recovery)."""
+        eids = [
+            backend.insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                      event_time=T(i + 1)),
+                5,
+            )
+            for i in range(3)
+        ]
+        path = backend._path(5)
+        clean_size = os.path.getsize(path)
+        with open(path, "ab") as f:  # simulate a partial final record
+            f.write(b"\x80\x00\x00\x00" + b"partial-payload")
+        # committed records stay readable through the torn tail
+        assert backend.count(5) == 3
+        assert [e.event_id for e in backend.find(5)] == eids
+        # next append repairs (truncates) the tail, then lands cleanly
+        backend._repaired.discard(path)
+        eid4 = backend.insert(
+            Event(event="rate", entity_type="user", entity_id="u9",
+                  event_time=T(9)),
+            5,
+        )
+        assert backend.count(5) == 4
+        assert backend.get(eid4, 5) is not None
+        assert os.path.getsize(path) > clean_size
+
+    def test_unicode_and_empty_fields(self, backend):
+        eid = backend.insert(
+            Event(event="$set", entity_type="usér", entity_id="ü–1",
+                  properties={"名前": "値", "n": 1},
+                  event_time=T(1)),
+            1,
+        )
+        got = backend.get(eid, 1)
+        assert got.entity_type == "usér"
+        assert got.properties.to_dict()["名前"] == "値"
+        assert got.target_entity_id is None
+
+    def test_large_batch_scan(self, backend):
+        evs = [
+            Event(event="rate", entity_type="user", entity_id=f"u{i % 50}",
+                  target_entity_type="item", target_entity_id=f"i{i % 20}",
+                  properties={"rating": float(i % 5)},
+                  event_time=T(1) + dt.timedelta(seconds=i))
+            for i in range(5000)
+        ]
+        backend.write(evs, 1)
+        assert backend.count(1) == 5000
+        frame = backend.find_frame(1, event_names=["rate"],
+                                   entity_type="user")
+        assert len(frame.event) == 5000
+        # time-ordered ascending
+        assert (np.diff(frame.event_time_us) >= 0).all()
+        sub = backend.find(1, entity_id="u7")
+        assert len(sub) == 100
+
+
+class TestRegistryWiring:
+    def test_eventlog_type_serves_both_spis(self, tmp_path, monkeypatch):
+        from pio_tpu.storage import Storage
+
+        monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path))
+        monkeypatch.setenv(
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "NLOG"
+        )
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_NLOG_TYPE", "eventlog")
+        monkeypatch.setenv(
+            "PIO_STORAGE_SOURCES_NLOG_PATH", str(tmp_path / "nlog")
+        )
+        Storage.reset()
+        try:
+            le = Storage.get_levents()
+            pe = Storage.get_pevents()
+            eid = le.insert(
+                Event(event="buy", entity_type="user", entity_id="u1",
+                      target_entity_type="item", target_entity_id="i1",
+                      event_time=T(1)),
+                1,
+            )
+            assert le.get(eid, 1) is not None
+            frame = pe.find_frame(1, event_names=["buy"])
+            assert list(frame.target_entity_id) == ["i1"]
+        finally:
+            Storage.reset()

@@ -67,10 +67,23 @@ def sqlite_client(tmp_path):
 
 
 # ------------------------------------------------------------------ LEvents
-@pytest.fixture(params=["memory", "sqlite"])
+def _eventlog(tmp_path):
+    from pio_tpu.native import NativeUnavailable
+
+    try:
+        from pio_tpu.storage.eventlog import EventLogEvents
+
+        return EventLogEvents(str(tmp_path / "eventlog"))
+    except NativeUnavailable as e:  # no toolchain in this environment
+        pytest.skip(f"native eventlog unavailable: {e}")
+
+
+@pytest.fixture(params=["memory", "sqlite", "eventlog"])
 def levents(request, tmp_path):
     if request.param == "memory":
         return MemLEvents()
+    if request.param == "eventlog":
+        return _eventlog(tmp_path)
     return SQLiteEvents(SQLiteClient(str(tmp_path / "le.db")))
 
 
@@ -96,6 +109,12 @@ class TestLEventsConformance:
 
         assert len(levents.find(1)) == 3
         assert [e.event for e in levents.find(1, event_names=["buy"])] == ["buy"]
+        # [] = "match no names" (only None means any) — same on every backend
+        assert levents.find(1, event_names=[]) == []
+        # explicit "" filters match nothing (no stored field is empty)
+        assert levents.find(1, entity_id="") == []
+        assert levents.find(1, target_entity_id="") == []
+        assert levents.get("", 1) is None
         assert len(levents.find(1, entity_id="u1")) == 2
         assert len(levents.find(1, target_entity_type="item", target_entity_id="i1")) == 2
         assert len(levents.find(1, start_time=T(2))) == 2
@@ -138,12 +157,16 @@ class TestLEventsConformance:
 
 
 # ------------------------------------------------------------------ PEvents
-@pytest.fixture(params=["memory", "sqlite", "parquet"])
+@pytest.fixture(params=["memory", "sqlite", "parquet", "eventlog"])
 def pevents(request, tmp_path):
     if request.param == "memory":
         return MemPEvents(MemLEvents())
     if request.param == "sqlite":
         return SQLitePEvents(SQLiteEvents(SQLiteClient(str(tmp_path / "pe.db"))))
+    if request.param == "eventlog":
+        from pio_tpu.storage.base import PEventsAdapter
+
+        return PEventsAdapter(_eventlog(tmp_path))
     return ParquetPEvents(str(tmp_path / "events"))
 
 
