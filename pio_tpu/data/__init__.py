@@ -16,6 +16,7 @@ from pio_tpu.data.cleaning import (
     clean_events,
     parse_duration,
 )
+from pio_tpu.data.store import LEventStore, PEventStore
 
 __all__ = [
     "DataMap",
@@ -30,4 +31,6 @@ __all__ = [
     "SelfCleaningDataSource",
     "clean_events",
     "parse_duration",
+    "LEventStore",
+    "PEventStore",
 ]

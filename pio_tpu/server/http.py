@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import re
+import ssl
 import threading
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -170,14 +172,86 @@ def _make_handler_class(router: Router, server_name: str):
     return JsonHandler
 
 
+def ssl_context_from_env() -> Optional[ssl.SSLContext]:
+    """TLS config from the environment, or None for plain HTTP.
+
+    Rebuild of the reference's ``common/.../SSLConfiguration.scala``
+    (UNVERIFIED path; SURVEY.md §2.5), which reads a JKS keystore from
+    config; here: ``PIO_TPU_SSL_CERTFILE`` + ``PIO_TPU_SSL_KEYFILE``
+    (PEM paths, keyfile optional if the cert bundles the key) switch every
+    server built through :class:`JsonHTTPServer` to HTTPS.
+    """
+    cert = os.environ.get("PIO_TPU_SSL_CERTFILE")
+    if not cert:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, os.environ.get("PIO_TPU_SSL_KEYFILE") or None)
+    return ctx
+
+
+#: default sentinel: "no explicit context given — consult the env".
+#: Distinct from None, which explicitly forces plain HTTP even when the
+#: PIO_TPU_SSL_* vars are set (e.g. an internal loopback endpoint beside a
+#: public HTTPS server).
+SSL_FROM_ENV: Any = object()
+
+
+class _TLSThreadingHTTPServer(ThreadingHTTPServer):
+    """TLS wrapped per connection, in the worker thread.
+
+    Wrapping the LISTENING socket would run the blocking handshake inside
+    the single accept loop — one client that connects and never sends a
+    ClientHello would stall every other connection. ``finish_request``
+    runs in the per-connection thread, so a stalled handshake costs only
+    its own thread.
+    """
+
+    ssl_ctx: Optional[ssl.SSLContext] = None
+    handshake_timeout = 30.0
+
+    def finish_request(self, request, client_address):
+        if self.ssl_ctx is None:
+            super().finish_request(request, client_address)
+            return
+        prev = request.gettimeout()
+        try:
+            request.settimeout(self.handshake_timeout)
+            tls_sock = self.ssl_ctx.wrap_socket(request, server_side=True)
+            tls_sock.settimeout(prev)
+        except (OSError, ssl.SSLError) as e:  # bad/absent handshake
+            log.debug("TLS handshake failed from %s: %s", client_address, e)
+            try:
+                request.close()
+            except OSError:
+                pass
+            return
+        try:
+            super().finish_request(tls_sock, client_address)
+        finally:
+            # wrap_socket detached the original socket, so the outer
+            # shutdown_request can't close this fd — do it here
+            try:
+                tls_sock.close()
+            except OSError:
+                pass
+
+
 class JsonHTTPServer:
     """Threaded server with programmatic start/stop (tests + CLI)."""
 
     def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0,
-                 name: str = "pio-tpu"):
-        self._httpd = ThreadingHTTPServer(
+                 name: str = "pio-tpu",
+                 ssl_context: Any = SSL_FROM_ENV):
+        self._httpd = _TLSThreadingHTTPServer(
             (host, port), _make_handler_class(router, name)
         )
+        ctx = (
+            ssl_context_from_env()
+            if ssl_context is SSL_FROM_ENV
+            else ssl_context
+        )
+        self.tls = ctx is not None
+        self._httpd.ssl_ctx = ctx
         self._thread: Optional[threading.Thread] = None
 
     @property

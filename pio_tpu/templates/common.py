@@ -39,6 +39,8 @@ def resolve_app(params) -> Tuple[int, Optional[int]]:
     ``params`` needs ``app_name``/``app_id`` and optionally ``channel``
     attributes (every bundled DataSourceParams has them).
     """
+    from pio_tpu.data.store import resolve_channel
+
     app_id = params.app_id
     if params.app_name:
         app = Storage.get_meta_data_apps().get_by_name(params.app_name)
@@ -47,15 +49,7 @@ def resolve_app(params) -> Tuple[int, Optional[int]]:
         app_id = app.id
     if not app_id:
         raise ValueError("datasource params need app_name or app_id")
-    channel_id = None
-    channel = getattr(params, "channel", "")
-    if channel:
-        chans = Storage.get_meta_data_channels().get_by_app_id(app_id)
-        match = [c for c in chans if c.name == channel]
-        if not match:
-            raise ValueError(f"channel {channel!r} not found")
-        channel_id = match[0].id
-    return app_id, channel_id
+    return app_id, resolve_channel(app_id, getattr(params, "channel", ""))
 
 
 # ------------------------------------------------ shared item-scoring rules

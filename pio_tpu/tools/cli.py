@@ -43,13 +43,12 @@ def _resolve_app(name: str):
 
 
 def _channel_id(app_id: int, channel: Optional[str]):
-    if not channel:
-        return None
-    chans = _storage().get_meta_data_channels().get_by_app_id(app_id)
-    match = [c for c in chans if c.name == channel]
-    if not match:
-        raise SystemExit(_err(f"channel {channel!r} not found"))
-    return match[0].id
+    from pio_tpu.data.store import resolve_channel
+
+    try:
+        return resolve_channel(app_id, channel)
+    except ValueError as e:
+        raise SystemExit(_err(str(e)))
 
 
 # ----------------------------------------------------------------- app verbs
@@ -376,6 +375,40 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_shell(args) -> int:
+    """Interactive shell with the framework preloaded.
+
+    Rebuild of ``bin/pio-shell`` + the pypio PySpark bridge (reference
+    §2.4): where that dropped into a Spark shell with the PIO classpath
+    and a py4j-backed ``PEventStore``, this drops into a Python REPL with
+    the store facades, storage registry, and jax/jnp bound.
+    """
+    import code
+
+    import jax
+    import jax.numpy as jnp
+
+    from pio_tpu.data.event import Event
+    from pio_tpu.data.store import LEventStore, PEventStore
+
+    ns = {
+        "pio_tpu": pio_tpu,
+        "Storage": _storage(),
+        "PEventStore": PEventStore,
+        "LEventStore": LEventStore,
+        "Event": Event,
+        "jax": jax,
+        "jnp": jnp,
+    }
+    banner = (
+        f"pio-tpu {pio_tpu.__version__} shell\n"
+        "preloaded: Storage, PEventStore, LEventStore, Event, jax, jnp\n"
+        'e.g.  PEventStore.find("myapp", event_names=["rate"])'
+    )
+    code.interact(banner=banner, local=ns, exitmsg="")
+    return 0
+
+
 # -------------------------------------------------------------------- parser
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -506,6 +539,9 @@ def build_parser() -> argparse.ArgumentParser:
         fn=cmd_status
     )
     sub.add_parser("version").set_defaults(fn=cmd_version)
+    sub.add_parser(
+        "shell", help="interactive Python shell with stores preloaded"
+    ).set_defaults(fn=cmd_shell)
     return p
 
 

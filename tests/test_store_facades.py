@@ -1,0 +1,185 @@
+"""Store facades (pio_tpu/data/store.py) + server TLS + shell wiring.
+
+Reference: ``data/store/{PEventStore,LEventStore}.scala`` facades,
+``common/SSLConfiguration.scala``, ``bin/pio-shell`` (SURVEY.md §2.2,
+§2.4, §2.5 — paths UNVERIFIED, reference mount was empty).
+"""
+
+import datetime as dt
+import json
+import ssl
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from pio_tpu.data import Event, LEventStore, PEventStore
+from pio_tpu.storage import App, Channel, Storage
+
+
+@pytest.fixture(autouse=True)
+def mem_storage(tmp_home, monkeypatch):
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "MEM")
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def T(h):
+    return dt.datetime(2026, 3, 1, h, tzinfo=dt.timezone.utc)
+
+
+@pytest.fixture()
+def seeded_app():
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="shop"))
+    ch_id = Storage.get_meta_data_channels().insert(
+        Channel(id=0, name="mobile", app_id=app_id)
+    )
+    le = Storage.get_levents()
+    for i in range(5):
+        le.insert(
+            Event(event="rate", entity_type="user", entity_id=f"u{i % 2}",
+                  target_entity_type="item", target_entity_id=f"i{i}",
+                  properties={"rating": float(i)}, event_time=T(i + 1)),
+            app_id,
+        )
+    le.insert(
+        Event(event="view", entity_type="user", entity_id="u0",
+              event_time=T(9)),
+        app_id, channel_id=ch_id,
+    )
+    le.insert(
+        Event(event="$set", entity_type="item", entity_id="i0",
+              properties={"category": "book"}, event_time=T(1)),
+        app_id,
+    )
+    return app_id, ch_id
+
+
+class TestFacades:
+    def test_pevent_find_frame_by_app_name(self, seeded_app):
+        frame = PEventStore.find("shop", event_names=["rate"])
+        assert len(frame.event) == 5
+        assert set(frame.entity_id) == {"u0", "u1"}
+
+    def test_channel_name_resolution(self, seeded_app):
+        assert [e.event for e in
+                PEventStore.find_events("shop", channel_name="mobile")] == [
+                    "view"]
+        with pytest.raises(ValueError, match="channel"):
+            PEventStore.find("shop", channel_name="nope")
+        with pytest.raises(ValueError, match="app"):
+            PEventStore.find("ghost")
+
+    def test_aggregate_properties(self, seeded_app):
+        props = PEventStore.aggregate_properties("shop", "item")
+        assert props["i0"].get("category") == "book"
+
+    def test_levent_find_newest_first(self, seeded_app):
+        evs = LEventStore.find("shop", event_names=["rate"], limit=2)
+        assert [e.target_entity_id for e in evs] == ["i4", "i3"]
+
+    def test_find_by_entity(self, seeded_app):
+        evs = LEventStore.find_by_entity("shop", "user", "u0",
+                                         event_names=["rate"])
+        assert [e.target_entity_id for e in evs] == ["i4", "i2", "i0"]
+
+
+class TestServerTLS:
+    def test_https_event_server(self, tmp_path, seeded_app, monkeypatch):
+        # self-signed cert via the stdlib-adjacent openssl binary
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        proc = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost"],
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            pytest.skip("openssl unavailable to mint a test cert")
+        monkeypatch.setenv("PIO_TPU_SSL_CERTFILE", str(cert))
+        monkeypatch.setenv("PIO_TPU_SSL_KEYFILE", str(key))
+        from pio_tpu.server import create_event_server
+
+        srv = create_event_server(host="127.0.0.1", port=0)
+        assert srv.tls
+        srv.start()
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{srv.port}/", context=ctx, timeout=10
+            ) as r:
+                assert json.loads(r.read())["status"] == "alive"
+        finally:
+            srv.stop()
+
+    def test_plain_http_without_env(self, seeded_app):
+        from pio_tpu.server import create_event_server
+
+        srv = create_event_server(host="127.0.0.1", port=0)
+        assert not srv.tls
+
+    def test_explicit_none_forces_plain_http(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_TPU_SSL_CERTFILE", str(tmp_path / "no.pem"))
+        from pio_tpu.server.http import JsonHTTPServer, Router
+
+        srv = JsonHTTPServer(Router(), "127.0.0.1", 0, ssl_context=None)
+        assert not srv.tls  # None overrides the env (internal endpoints)
+        srv._httpd.server_close()
+
+    def test_stalled_handshake_does_not_block_others(
+        self, tmp_path, seeded_app, monkeypatch
+    ):
+        import socket
+
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        proc = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost"],
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            pytest.skip("openssl unavailable to mint a test cert")
+        monkeypatch.setenv("PIO_TPU_SSL_CERTFILE", str(cert))
+        monkeypatch.setenv("PIO_TPU_SSL_KEYFILE", str(key))
+        from pio_tpu.server import create_event_server
+
+        srv = create_event_server(host="127.0.0.1", port=0).start()
+        stalled = socket.create_connection(("127.0.0.1", srv.port))
+        try:
+            # the silent connection must not stall the accept loop
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{srv.port}/", context=ctx, timeout=10
+            ) as r:
+                assert json.loads(r.read())["status"] == "alive"
+        finally:
+            stalled.close()
+            srv.stop()
+
+
+class TestShell:
+    def test_shell_executes_with_preloaded_names(self, tmp_home):
+        # pipe a script into the REPL: facades + jnp must be bound
+        proc = subprocess.run(
+            [sys.executable, "-m", "pio_tpu", "shell"],
+            input="print('SUM', int(jnp.arange(4).sum()));"
+                  "print('HAS', PEventStore is not None, Event is not None)",
+            capture_output=True, text=True, timeout=120,
+            env={**__import__('os').environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "SUM 6" in proc.stdout
+        assert "HAS True True" in proc.stdout
