@@ -296,6 +296,15 @@ def get_engine_factory(name: str) -> EngineFactory:
                 continue
             if name in _ENGINE_REGISTRY:
                 return _ENGINE_REGISTRY[name]
+    # Bare names ("recommendation"): the bundled template gallery
+    # registers them on import — load it before giving up, so CLI
+    # entrypoints work without the caller pre-importing pio_tpu.templates.
+    try:
+        importlib.import_module("pio_tpu.templates")
+    except ImportError:
+        pass
+    if name in _ENGINE_REGISTRY:
+        return _ENGINE_REGISTRY[name]
     raise ParamsError(
         f"engine factory {name!r} not registered; known: {engine_factory_names()}"
     )

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Optional, Tuple
 
 import numpy as np
@@ -62,6 +63,16 @@ class ALSConfig:
     block_width: Optional[int] = None
     #: blocks per scan step — bounds the [chunk, width, K] HBM intermediate
     blocks_per_chunk: int = 4096
+    #: dtype for the factor gather + normal-equation matmuls ("bfloat16"
+    #: or "float32"). bf16 is the MXU's native rate and halves the gather
+    #: bandwidth; accumulation and the solves stay float32 either way.
+    matmul_dtype: str = "bfloat16"
+    #: per-entity K×K solver: "auto" uses exact Cholesky for small entity
+    #: counts and switches to Jacobi-preconditioned CG (matmul-only, rides
+    #: the MXU) above ~32k entities, where XLA's batched factorizations
+    #: serialize badly on TPU (LU at MovieLens-25M user count: ~780 ms per
+    #: half-step; CG: ~90 ms). Explicit "cg" / "cholesky" / "lu" override.
+    solver: str = "auto"
     seed: int = 0
 
 
@@ -75,10 +86,54 @@ class ALSFactors:
 
 
 
+def _native_packer():
+    """The C++ packer (pio_tpu/native/als_pack.cpp), or None when no
+    toolchain is available (tests cover both paths)."""
+    if os.environ.get("PIO_TPU_NO_NATIVE"):
+        return None
+    try:
+        from pio_tpu.native import als_pack_lib
+
+        return als_pack_lib()
+    except Exception:  # NativeUnavailable, or a broken toolchain
+        return None
+
+
+def _ptr(a: np.ndarray, dtype, ctype):
+    """C pointer to a's buffer. Asserts rather than converts: a silent
+    ascontiguousarray copy would send native WRITES into a discarded
+    temporary (these helpers are used for output buffers too)."""
+    import ctypes
+
+    assert a.dtype == dtype and a.flags.c_contiguous, (a.dtype, a.flags)
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _i32p(a: np.ndarray):
+    import ctypes
+
+    return _ptr(a, np.int32, ctypes.c_int32)
+
+
+def _i64p(a: np.ndarray):
+    import ctypes
+
+    return _ptr(a, np.int64, ctypes.c_int64)
+
+
+def _f32p(a: np.ndarray):
+    import ctypes
+
+    return _ptr(a, np.float32, ctypes.c_float)
+
+
 def _auto_width(n_edges: int, n_entities: int) -> int:
+    # Narrow blocks: padding waste (≈ width/2 per entity) costs real
+    # host→device bytes, which dominate over the extra scatter rows on the
+    # tunneled/PCIe link (measured optimum 16-64 at MovieLens scales).
     mean_deg = max(1.0, n_edges / max(1, n_entities))
-    w = 1 << int(np.ceil(np.log2(max(8.0, mean_deg / 2))))
-    return int(min(512, w))
+    w = 1 << int(np.ceil(np.log2(max(8.0, mean_deg / 4))))
+    return int(min(64, max(16, w)))
 
 
 def _pack_blocks(
@@ -89,13 +144,14 @@ def _pack_blocks(
     width: int,
     pad_blocks_to: int,
     counts: Optional[np.ndarray] = None,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Pack a COO edge list into dense [n_blocks, width] CSR-style blocks.
 
-    Returns (block_ent [S], block_other [S,W], block_rating [S,W],
-    block_mask [S,W]); ``block_ent`` ascending so downstream segment sums
-    take the sorted-indices fast path. Padded slots point at entity/row 0
-    with mask 0 — they contribute exactly zero.
+    Returns (block_ent [S], block_other [S,W], block_rating [S,W]);
+    ``block_ent`` ascending so downstream segment sums take the
+    sorted-indices fast path. Padded slots carry ``other = -1`` — the
+    validity mask is derived on device from the sign, so no separate mask
+    array rides the host→device link.
     """
     order = np.argsort(ent_idx, kind="stable")
     e = ent_idx[order]
@@ -114,15 +170,13 @@ def _pack_blocks(
     pos = np.arange(len(e), dtype=np.int64) - edge_start[e]
     flat = (block_start[e] + pos // width) * width + pos % width
 
-    block_other = np.zeros(S * width, dtype=np.int32)
+    block_other = np.full(S * width, -1, dtype=np.int32)
     block_rating = np.zeros(S * width, dtype=np.float32)
-    block_mask = np.zeros(S * width, dtype=np.float32)
     block_other[flat] = other_idx[order]
     block_rating[flat] = rating[order]
-    block_mask[flat] = 1.0
 
-    # padding blocks target the LAST entity (mask 0) to keep ids ascending
-    # for the segment-sum sorted fast path
+    # padding blocks target the LAST entity (masked out) to keep ids
+    # ascending for the segment-sum sorted fast path
     block_ent = np.full(S, n_entities - 1, dtype=np.int32)
     reps = np.repeat(np.arange(n_entities, dtype=np.int32), blocks_per_ent)
     block_ent[: len(reps)] = reps
@@ -130,14 +184,16 @@ def _pack_blocks(
         block_ent,
         block_other.reshape(S, width),
         block_rating.reshape(S, width),
-        block_mask.reshape(S, width),
     )
 
 
 @functools.lru_cache(maxsize=32)
 def _build_trainer(mesh, axis: str, iterations: int, reg: float,
                    implicit: bool, alpha: float,
-                   chunk_user: int, chunk_item: int):
+                   chunk_user: int, chunk_item: int,
+                   matmul_dtype: str = "bfloat16", solver: str = "cg",
+                   packed_shapes=None, rank: int = 0,
+                   U_pad: int = 0, I_pad: int = 0):
     """Jitted ALS trainer for one (mesh, static-config) combination.
 
     The returned function takes the two packed-block layouts + initial
@@ -148,16 +204,23 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
 
     lam = jnp.float32(reg)
     alpha_f = jnp.float32(alpha)
+    mm_dtype = jnp.dtype(matmul_dtype)
 
-    def partial_normal_eq(block_ent, block_other, block_r, block_m, factors,
+    def partial_normal_eq(block_ent, block_other, block_r, factors,
                           n_entities, chunk, varying_axis=None):
         """Blocked scan: Σ w·q qᵀ and Σ rhs·q per entity (one shard)."""
         K = factors.shape[1]
+        # cast ONCE per half-step: the scan then gathers from the low-
+        # precision table (half the HBM traffic) and the einsums hit the
+        # MXU at its native bf16 rate; accumulation stays f32 below
+        factors_mm = factors.astype(mm_dtype)
 
         def chunk_step(carry, ch):
             A, b = carry
-            ent, other, r_c, m_c = ch
-            q = factors[other]  # [chunk, W, K] gather of the fixed side
+            ent, other, r_c = ch
+            # padded slots are other == -1; validity derives from the sign
+            m_c = (other >= 0).astype(jnp.float32)
+            q = factors_mm[jnp.maximum(other, 0)]  # [chunk, W, K] gather
             if implicit:
                 # confidence c = 1 + α r; correction weight (c-1)·mask
                 w = alpha_f * r_c * m_c
@@ -165,9 +228,15 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
             else:
                 w = m_c
                 rhs = r_c * m_c
-            # batched MXU matmul: [chunk, K, W] @ [chunk, W, K]
-            A_blk = jnp.einsum("cwk,cwl->ckl", q * w[:, :, None], q)
-            b_blk = jnp.einsum("cwk,cw->ck", q, rhs)
+            # batched MXU matmul: [chunk, K, W] @ [chunk, W, K], f32 acc
+            A_blk = jnp.einsum(
+                "cwk,cwl->ckl", q * w[:, :, None].astype(mm_dtype), q,
+                preferred_element_type=jnp.float32,
+            )
+            b_blk = jnp.einsum(
+                "cwk,cw->ck", q, rhs.astype(mm_dtype),
+                preferred_element_type=jnp.float32,
+            )
             A = A + jax.ops.segment_sum(
                 A_blk, ent, num_segments=n_entities, indices_are_sorted=True
             )
@@ -180,7 +249,7 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
         n_chunks = S // chunk
         chunks = tuple(
             x.reshape(n_chunks, chunk, *x.shape[1:])
-            for x in (block_ent, block_other, block_r, block_m)
+            for x in (block_ent, block_other, block_r)
         )
         A0 = jnp.zeros((n_entities, K, K), jnp.float32)
         b0 = jnp.zeros((n_entities, K), jnp.float32)
@@ -192,12 +261,63 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
         (A, b), _ = jax.lax.scan(chunk_step, (A0, b0), chunks)
         return A, b
 
+    def _cg_solve(A, b):
+        """Batched Jacobi-preconditioned CG — matmul-only, so it rides the
+        MXU instead of XLA's serialized batched factorizations (measured
+        ~8× faster than LU at MovieLens-25M entity counts). A is SPD
+        (normal equations + λI); K+8 iterations ≥ the Krylov dimension
+        with margin for f32 rounding on ill-conditioned systems."""
+        K = b.shape[1]
+        inv_d = 1.0 / jnp.diagonal(A, axis1=1, axis2=2)
+        x = b * inv_d
+        r = b - jnp.einsum("nkl,nl->nk", A, x)
+        z = r * inv_d
+        p = z
+        rz = (r * z).sum(-1)
+
+        def body(_, st):
+            x, r, p, rz = st
+            Ap = jnp.einsum("nkl,nl->nk", A, p)
+            denom = (p * Ap).sum(-1)
+            alpha_c = rz / jnp.where(denom != 0, denom, 1.0)
+            x = x + alpha_c[:, None] * p
+            r = r - alpha_c[:, None] * Ap
+            z = r * inv_d
+            rz2 = (r * z).sum(-1)
+            beta = rz2 / jnp.where(rz != 0, rz, 1.0)
+            p = z + beta[:, None] * p
+            return (x, r, p, rz2)
+
+        x, *_ = jax.lax.fori_loop(0, K + 8, body, (x, r, p, rz))
+        return x
+
     def solve_block(A, b, gram):
         """Regularized batched solve on a block of entities."""
         K = b.shape[1]
         A = A + lam * jnp.eye(K, dtype=jnp.float32)[None, :, :]
         if implicit:
             A = A + gram[None, :, :]
+        # "auto": exact Cholesky while it's cheap, CG at the batch sizes
+        # where XLA's TPU factorizations serialize (A.shape[0] is static
+        # at trace time, so this is a compile-time branch)
+        if solver not in ("auto", "cg", "cholesky", "lu"):
+            raise ValueError(
+                f"unknown ALS solver {solver!r}; use auto/cg/cholesky/lu"
+            )
+        eff = solver
+        if eff == "auto":
+            eff = "cg" if A.shape[0] > 32768 else "cholesky"
+        if eff == "cg":
+            return _cg_solve(A, b)
+        if eff == "cholesky":
+            L = jnp.linalg.cholesky(A)
+            y = jax.scipy.linalg.solve_triangular(
+                L, b[:, :, None], lower=True
+            )
+            x = jax.scipy.linalg.solve_triangular(
+                jnp.swapaxes(L, 1, 2), y, lower=False
+            )
+            return x[:, :, 0]
         return jnp.linalg.solve(A, b[:, :, None])[:, :, 0]
 
     def gram_of(factors):
@@ -208,15 +328,15 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
     if mesh is not None and mesh.shape[axis] > 1:
         from jax.sharding import PartitionSpec as P
 
-        blk_spec = (P(axis), P(axis), P(axis), P(axis))
+        blk_spec = (P(axis), P(axis), P(axis))
 
-        def half_step(ent, other, r, m, factors, n_entities, chunk):
+        def half_step(ent, other, r, factors, n_entities, chunk):
             """shard_map body: block-parallel accumulate → reduce-scatter →
             local solve → all-gather (the MLlib-shuffle replacement)."""
 
-            def body(ent, other, r, m, factors):
+            def body(ent, other, r, factors):
                 A, b = partial_normal_eq(
-                    ent, other, r, m, factors, n_entities, chunk,
+                    ent, other, r, factors, n_entities, chunk,
                     varying_axis=axis,
                 )
                 # reduce-scatter the normal equations over the entity dim:
@@ -235,18 +355,21 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
                 in_specs=blk_spec + (P(),),
                 out_specs=P(),
                 check_vma=False,
-            )(ent, other, r, m, factors)
+            )(ent, other, r, factors)
     else:
 
-        def half_step(ent, other, r, m, factors, n_entities, chunk):
+        def half_step(ent, other, r, factors, n_entities, chunk):
             A, b = partial_normal_eq(
-                ent, other, r, m, factors, n_entities, chunk
+                ent, other, r, factors, n_entities, chunk
             )
             return solve_block(A, b, gram_of(factors))
 
-    @jax.jit
-    def run(by_user, by_item, P_init, Q_init):
-        U_pad, I_pad = P_init.shape[0], Q_init.shape[0]
+    def run_body(by_user, by_item, seed):
+        # factor init on device, inside the one compiled program:
+        # MLlib-style small random factors keep AᵀA well-conditioned
+        ku, ki = jax.random.split(jax.random.PRNGKey(seed))
+        P_init = jax.random.normal(ku, (U_pad, rank), jnp.float32) * 0.01
+        Q_init = jax.random.normal(ki, (I_pad, rank), jnp.float32) * 0.01
 
         def iteration(_, PQ):
             P_f, Q_f = PQ
@@ -256,7 +379,34 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
 
         return jax.lax.fori_loop(0, iterations, iteration, (P_init, Q_init))
 
-    return run
+    if packed_shapes is None:
+        return jax.jit(run_body)
+
+    # Packed variant: the six block arrays arrive concatenated in one int32
+    # and one float32 buffer (host→device links charge a high per-transfer
+    # AND per-dispatch latency — notably the tunneled TPU — so both the
+    # transfers and the splitting happen inside this single jit dispatch).
+    su, wu, si, wi = packed_shapes
+
+    def _split(buf, parts):
+        out, o = [], 0
+        for shape in parts:
+            n = int(np.prod(shape))
+            out.append(buf[o:o + n].reshape(shape))
+            o += n
+        return out
+
+    @jax.jit
+    def run_packed(ints, flts, seed):
+        ent_u, oth_u, ent_i, oth_i = _split(
+            ints, [(su,), (su, wu), (si,), (si, wi)]
+        )
+        r_u, r_i = _split(flts, [(su, wu), (si, wi)])
+        return run_body(
+            (ent_u, oth_u, r_u), (ent_i, oth_i, r_i), seed
+        )
+
+    return run_packed
 
 
 def train_als(
@@ -298,49 +448,87 @@ def train_als(
 
     def _layout(ent, other, width, n_entities):
         """Pick a chunk ≤ config bound that the shard block count divides."""
-        counts = np.bincount(ent, minlength=n_entities)
-        n_blocks = int((-(-counts // width)).sum())
+        native = _native_packer()
+        if native is not None:
+            counts = np.zeros(n_entities, np.int64)
+            n_blocks = int(native.als_pack_count(
+                _i32p(ent), len(ent), n_entities, width, _i64p(counts)
+            ))
+            if n_blocks < 0:
+                raise ValueError("entity index out of range")
+        else:
+            counts = np.bincount(ent, minlength=n_entities)
+            n_blocks = int((-(-counts // width)).sum())
         per_shard = max(1, -(-n_blocks // n_shards))
         chunk = min(config.blocks_per_chunk, _round_up(per_shard, 8))
         pad_to = n_shards * chunk
-        blocks = _pack_blocks(
-            ent, other, rating, n_entities, width, pad_to, counts=counts
-        )
+        # single home for the padded block count — the numpy packer is
+        # handed S directly so both paths cannot drift apart
+        S = max(pad_to, _round_up(max(n_blocks, 1), pad_to))
+        if native is not None:
+            block_ent = np.empty(S, np.int32)
+            block_other = np.empty(S * width, np.int32)
+            block_rating = np.empty(S * width, np.float32)
+            native.als_pack_fill(
+                _i32p(ent), _i32p(other), _f32p(rating), len(ent),
+                n_entities, width, _i64p(counts), S,
+                _i32p(block_ent), _i32p(block_other), _f32p(block_rating),
+            )
+            blocks = (
+                block_ent,
+                block_other.reshape(S, width),
+                block_rating.reshape(S, width),
+            )
+        else:
+            blocks = _pack_blocks(
+                ent, other, rating, n_entities, width, S, counts=counts
+            )
+            assert blocks[0].shape[0] == S
         return blocks, chunk
 
     by_user, chunk_user = _layout(user_idx, item_idx, w_user, U_pad)
     by_item, chunk_item = _layout(item_idx, user_idx, w_item, I_pad)
 
-    key = jax.random.PRNGKey(config.seed)
-    ku, ki = jax.random.split(key)
-    # MLlib-style init: small random factors; scale keeps AᵀA well-conditioned.
-    P0 = jax.random.normal(ku, (U_pad, K), jnp.float32) * 0.01
-    Q0 = jax.random.normal(ki, (I_pad, K), jnp.float32) * 0.01
-
-    run = _build_trainer(
+    common = (
         mesh, axis, config.iterations, float(config.reg),
         bool(config.implicit), float(config.alpha), chunk_user, chunk_item,
+        str(config.matmul_dtype), str(config.solver),
     )
+    seed = np.uint32(config.seed)
 
-    if mesh is not None:
+    if n_shards > 1:
+        run = _build_trainer(
+            *common, None, K, U_pad, I_pad
+        )
         blk = NamedSharding(mesh, P(axis))
         blk2 = NamedSharding(mesh, P(axis, None))
-        rep = NamedSharding(mesh, P())
         put_blocks = lambda t: (
             jax.device_put(t[0], blk),
             jax.device_put(t[1], blk2),
             jax.device_put(t[2], blk2),
-            jax.device_put(t[3], blk2),
         )
-        put_r = lambda x: jax.device_put(x, rep)
+        P_f, Q_f = run(put_blocks(by_user), put_blocks(by_item), seed)
     else:
-        put_blocks = lambda t: tuple(jnp.asarray(x) for x in t)
-        put_r = jnp.asarray
+        # Single-device path: host→device links (PCIe, or the tunneled
+        # TPU) charge a large per-transfer AND per-dispatch latency, so
+        # ship the six block arrays as one int32 + one float32 buffer
+        # and let the jitted trainer split them apart on device.
+        su, wu = by_user[1].shape
+        si, wi = by_item[1].shape
+        run = _build_trainer(
+            *common, (su, wu, si, wi), K, U_pad, I_pad
+        )
+        ints = np.concatenate([
+            by_user[0], by_user[1].ravel(),
+            by_item[0], by_item[1].ravel(),
+        ])
+        flts = np.concatenate([by_user[2].ravel(), by_item[2].ravel()])
+        P_f, Q_f = run(ints, flts, seed)
 
-    P_f, Q_f = run(put_blocks(by_user), put_blocks(by_item), put_r(P0), put_r(Q0))
+    P_f, Q_f = jax.device_get((P_f, Q_f))
     return ALSFactors(
-        user_factors=np.asarray(jax.device_get(P_f))[:n_users],
-        item_factors=np.asarray(jax.device_get(Q_f))[:n_items],
+        user_factors=np.asarray(P_f)[:n_users],
+        item_factors=np.asarray(Q_f)[:n_items],
     )
 
 

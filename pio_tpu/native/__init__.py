@@ -5,6 +5,8 @@ SURVEY.md §2); this package holds the rebuild's own native pieces:
 
 - ``event_log.cpp`` — append-only binary event log with C++ filtered scan
   (pio_tpu/storage/eventlog.py wraps it as a storage backend).
+- ``als_pack.cpp`` — parallel COO→blocked-CSR packer feeding the ALS
+  trainer's coalesced device transfer (pio_tpu/models/als.py).
 
 Build model: no wheels, no pybind11 — ``g++ -O2 -shared -fPIC`` at first
 import, cached under ``$PIO_TPU_HOME/native/<source-sha>.so`` so rebuilds
@@ -111,4 +113,26 @@ def event_log_lib():
         lib.pel_repair.argtypes = [ctypes.c_char_p]
         lib.pel_repair.restype = ctypes.c_int64
         _cache["event_log"] = lib
+        return lib
+
+
+def als_pack_lib():
+    """Load (building if needed) the ALS packer library; cached."""
+    with _lock:
+        if "als_pack" in _cache:
+            return _cache["als_pack"]
+        lib = ctypes.CDLL(build_library("als_pack"))
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.als_pack_count.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, i64p
+        ]
+        lib.als_pack_count.restype = ctypes.c_int64
+        lib.als_pack_fill.argtypes = [
+            i32p, i32p, f32p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, i64p, ctypes.c_int64, i32p, i32p, f32p,
+        ]
+        lib.als_pack_fill.restype = ctypes.c_int
+        _cache["als_pack"] = lib
         return lib

@@ -58,6 +58,32 @@ class TestALS:
         hu, hi = np.nonzero(~s["mask"])
         assert pred[s["u"], s["i"]].mean() > pred[hu, hi].mean() + 0.1
 
+    def test_cg_solver_matches_cholesky(self, synthetic):
+        """The >32k-entity perf path (CG) must agree with the exact solver
+        on the observed entries (well-conditioned config: rank ≤ data
+        rank, real regularization)."""
+        s = synthetic
+        cfg = dict(rank=4, iterations=10, reg=0.1, blocks_per_chunk=64)
+        preds = {}
+        for solver in ("cholesky", "cg"):
+            f = train_als(
+                ComputeContext.local(), s["u"], s["i"], s["r"],
+                s["U"], s["I"], ALSConfig(solver=solver, **cfg),
+            )
+            preds[solver] = (f.user_factors @ f.item_factors.T)[
+                s["u"], s["i"]
+            ]
+        err = np.abs(preds["cg"] - preds["cholesky"]).max()
+        assert err < 0.05, err
+
+    def test_unknown_solver_raises(self, synthetic):
+        s = synthetic
+        with pytest.raises(Exception, match="unknown ALS solver"):
+            train_als(
+                ComputeContext.local(), s["u"], s["i"], s["r"],
+                s["U"], s["I"], ALSConfig(solver="choleski"),
+            )
+
     def test_empty_ratings_raises(self):
         with pytest.raises(ValueError, match="at least one rating"):
             train_als(
@@ -65,6 +91,48 @@ class TestALS:
                 np.array([], np.int32), np.array([], np.int32),
                 np.array([], np.float32), 5, 5,
             )
+
+    def test_native_packer_matches_numpy(self):
+        """C++ packer (pio_tpu/native/als_pack.cpp) must be bit-identical
+        to the numpy reference layout."""
+        from pio_tpu.models.als import (
+            _f32p, _i32p, _i64p, _native_packer, _pack_blocks, _round_up,
+        )
+
+        native = _native_packer()
+        if native is None:
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(11)
+        E, N, W = 50_000, 700, 16
+        ent = rng.integers(0, N, E).astype(np.int32)
+        other = rng.integers(0, 9999, E).astype(np.int32)
+        rat = rng.random(E).astype(np.float32)
+        ref = _pack_blocks(ent, other, rat, N, W, 64)
+        S = ref[0].shape[0]
+        counts = np.zeros(N, np.int64)
+        nb = int(native.als_pack_count(_i32p(ent), E, N, W, _i64p(counts)))
+        assert S == max(64, _round_up(nb, 64))
+        be = np.empty(S, np.int32)
+        bo = np.empty(S * W, np.int32)
+        br = np.empty(S * W, np.float32)
+        native.als_pack_fill(
+            _i32p(ent), _i32p(other), _f32p(rat), E, N, W,
+            _i64p(counts), S, _i32p(be), _i32p(bo), _f32p(br),
+        )
+        assert (be == ref[0]).all()
+        assert (bo.reshape(S, W) == ref[1]).all()
+        assert (br.reshape(S, W) == ref[2]).all()
+
+    def test_numpy_fallback_trains(self, synthetic, monkeypatch):
+        monkeypatch.setenv("PIO_TPU_NO_NATIVE", "1")
+        s = synthetic
+        f = train_als(
+            ComputeContext.local(), s["u"], s["i"], s["r"], s["U"], s["I"],
+            CFG,
+        )
+        pred = f.user_factors @ f.item_factors.T
+        rmse = np.sqrt(np.mean((pred[s["u"], s["i"]] - s["r"]) ** 2))
+        assert rmse < 0.05
 
     def test_single_rating(self):
         f = train_als(
