@@ -50,6 +50,11 @@ class SeqRecConfig:
     dropout: float = 0.0  # reserved; deterministic v1
     learning_rate: float = 1e-3
     steps: int = 200
+    #: sequence-parallel attention mode: "ring" (ppermute K/V rotation,
+    #: O(T/n) memory — longest contexts) or "ulysses" (two all-to-alls,
+    #: full-T for H/n heads — fewer collective hops; needs the local head
+    #: count divisible by the seq-axis size). See pio_tpu/parallel/.
+    attention: str = "ring"
     seed: int = 0
 
 
@@ -180,11 +185,20 @@ def _block(blk, h, cfg, m_axis, s_axis):
     import jax.numpy as jnp
 
     from pio_tpu.parallel.ring import ring_attention
+    from pio_tpu.parallel.ulysses import ulysses_attention
 
     mb, t_loc, D = h.shape
     n_model = 1 if m_axis is None else jax.lax.axis_size(m_axis)
     heads_loc = cfg.n_heads // n_model
     hd = cfg.d_model // cfg.n_heads
+    if cfg.attention == "ring":
+        attn_fn = ring_attention
+    elif cfg.attention == "ulysses":
+        attn_fn = ulysses_attention
+    else:
+        raise ValueError(
+            f"unknown attention mode {cfg.attention!r}; use ring/ulysses"
+        )
 
     x = _ln(h, blk["ln1_g"], blk["ln1_b"])
     # separate projections: a fused [D, 3D] column shard would split at
@@ -196,7 +210,7 @@ def _block(blk, h, cfg, m_axis, s_axis):
     def split_heads(a):
         return a.reshape(mb, t_loc, heads_loc, hd)
 
-    attn = ring_attention(
+    attn = attn_fn(
         split_heads(q), split_heads(k), split_heads(v),
         axis=s_axis, causal=True,
     ).reshape(mb, t_loc, heads_loc * hd)
@@ -342,6 +356,19 @@ def train_seqrec(
         raise ValueError("n_heads must divide by the model axis")
     if cfg.n_layers % max(n_pipe, 1):
         raise ValueError("n_layers must divide by the pipe axis")
+    if cfg.attention not in ("ring", "ulysses"):
+        raise ValueError(
+            f"unknown attention mode {cfg.attention!r}; use ring/ulysses"
+        )
+    if cfg.attention == "ulysses" and (cfg.n_heads // max(n_model, 1)) % max(
+        n_seq, 1
+    ):
+        raise ValueError(
+            "ulysses attention needs the per-device head count "
+            f"(n_heads {cfg.n_heads} / model axis {n_model} = "
+            f"{cfg.n_heads // max(n_model, 1)}) divisible by the seq axis "
+            f"({n_seq}); use ring attention or adjust n_heads"
+        )
 
     seqs = np.asarray(sequences, np.int32)
     n, t = seqs.shape

@@ -21,6 +21,8 @@ _LAZY = {
     "stage_slice": "pio_tpu.parallel.pipeline",
     "ring_attention": "pio_tpu.parallel.ring",
     "ring_attention_sharded": "pio_tpu.parallel.ring",
+    "ulysses_attention": "pio_tpu.parallel.ulysses",
+    "ulysses_attention_sharded": "pio_tpu.parallel.ulysses",
 }
 
 __all__ = [

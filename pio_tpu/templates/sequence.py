@@ -151,6 +151,8 @@ class SeqRecParams(Params):
     learning_rate: float = 1e-3
     steps: int = 300
     seed: int = 0
+    #: sequence-parallel attention mode: "ring" or "ulysses" (all-to-all)
+    attention: str = "ring"
     #: mesh splits; remaining devices ride the data axis
     seq_parallel: int = 1
     pipe_parallel: int = 1
@@ -203,6 +205,7 @@ class SeqRecAlgorithm(Algorithm):
                 max_len=p.max_len,
                 learning_rate=p.learning_rate,
                 steps=p.steps,
+                attention=p.attention,
                 seed=p.seed,
             ),
             checkpoint=ctx.checkpoint,

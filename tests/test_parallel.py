@@ -117,6 +117,95 @@ def test_ring_attention_sharded_grads_flow():
         assert np.abs(np.asarray(g)).sum() > 0
 
 
+# ----------------------------------------------------- ulysses (all-to-all)
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_single_device_matches_dense(causal):
+    from pio_tpu.parallel import ulysses_attention
+
+    rng = np.random.default_rng(3)
+    q, k, v = (
+        rng.normal(size=(2, 16, 2, 8)).astype(np.float32) for _ in range(3)
+    )
+    out = ulysses_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        axis=None, causal=causal,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), _dense_attention(q, k, v, causal),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_sharded_matches_dense(causal):
+    from pio_tpu.parallel import ulysses_attention_sharded
+
+    mesh = build_mesh(MeshSpec(data=2, seq=4))
+    rng = np.random.default_rng(4)
+    b, t, h, d = 4, 32, 4, 8  # h=4 heads over seq=4 devices
+    q, k, v = (
+        rng.normal(size=(b, t, h, d)).astype(np.float32) for _ in range(3)
+    )
+    out = jax.jit(
+        lambda q, k, v: ulysses_attention_sharded(
+            mesh, q, k, v, causal=causal
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), _dense_attention(q, k, v, causal),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_ring(causal):
+    """Both SP modes are exact attention — identical up to float noise."""
+    from pio_tpu.parallel import ulysses_attention_sharded
+
+    mesh = build_mesh(MeshSpec(data=2, seq=4))
+    rng = np.random.default_rng(5)
+    q, k, v = (
+        rng.normal(size=(2, 32, 4, 8)).astype(np.float32) for _ in range(3)
+    )
+    ring = ring_attention_sharded(mesh, q, k, v, causal=causal)
+    uly = ulysses_attention_sharded(mesh, q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(uly), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from pio_tpu.parallel import ulysses_attention_sharded
+
+    mesh = build_mesh(MeshSpec(data=2, seq=4))
+    rng = np.random.default_rng(6)
+    q, k, v = (
+        rng.normal(size=(2, 32, 3, 8)).astype(np.float32)  # 3 heads, n=4
+        for _ in range(3)
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention_sharded(mesh, q, k, v)
+
+
+def test_ulysses_sharded_grads_flow():
+    from pio_tpu.parallel import ulysses_attention_sharded
+
+    mesh = build_mesh(MeshSpec(data=2, seq=4))
+    rng = np.random.default_rng(7)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, 16, 4, 8)), jnp.float32)
+        for _ in range(3)
+    )
+
+    def loss(q, k, v):
+        return ulysses_attention_sharded(mesh, q, k, v, causal=True).sum()
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
+
+
 # ------------------------------------------------------------------ pipeline
 def test_pipeline_apply_matches_sequential():
     """4-stage pipeline over the pipe axis ≡ applying the stages in order."""

@@ -5,6 +5,8 @@ learns it must attend to the last position through ring attention, the
 pipelined trunk, and the vocab-parallel softmax.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -54,6 +56,27 @@ def test_learns_cycle(spec):
     mesh = None if spec is None else build_mesh(spec)
     m = train_seqrec(mesh, seqs, V, CFG)
     assert _accuracy(m, seqs[:8], V) >= 0.85
+
+
+def test_learns_cycle_ulysses_attention():
+    """All-to-all SP mode: same training quality as the ring path (4 heads
+    over a 2-wide seq axis)."""
+    V = 12
+    seqs = _cycle_sequences(V)
+    mesh = build_mesh(MeshSpec(data=2, seq=2, model=2))
+    m = train_seqrec(
+        mesh, seqs, V, dataclasses.replace(CFG, attention="ulysses")
+    )
+    assert _accuracy(m, seqs[:8], V) >= 0.85
+
+
+def test_unknown_attention_mode_raises():
+    V = 12
+    seqs = _cycle_sequences(V)
+    with pytest.raises(ValueError, match="attention mode"):
+        train_seqrec(
+            None, seqs, V, dataclasses.replace(CFG, attention="flash")
+        )
 
 
 def test_serving_cache_and_pickle():
