@@ -149,17 +149,33 @@ class Engine:
         skip_sanity_check: bool = False,
         stop_after_read: bool = False,
         stop_after_prepare: bool = False,
+        timings: Optional[dict] = None,
     ) -> List[Any]:
-        """Run DataSource -> Preparator -> each Algorithm; return models."""
+        """Run DataSource -> Preparator -> each Algorithm; return models.
+
+        ``timings``, when given, is filled with per-phase wall seconds
+        (``read``, ``prepare``, ``train:<i>_<algo>``) — the rebuild's
+        answer to the reference's Spark-UI stage view (SURVEY.md §5
+        tracing).
+        """
+        import time as _time
+
+        def _phase(name, fn):
+            t0 = _time.monotonic()
+            out = fn()
+            if timings is not None:
+                timings[name] = round(_time.monotonic() - t0, 3)
+            return out
+
         data_source = self.data_source_class(engine_params.data_source_params)
-        td = data_source.read_training(ctx)
+        td = _phase("read", lambda: data_source.read_training(ctx))
         if not skip_sanity_check and isinstance(td, SanityCheck):
             td.sanity_check()
         if stop_after_read:
             log.info("stopping after read_training (stop_after_read)")
             return []
         preparator = self.preparator_class(engine_params.preparator_params)
-        pd = preparator.prepare(ctx, td)
+        pd = _phase("prepare", lambda: preparator.prepare(ctx, td))
         if not skip_sanity_check and isinstance(pd, SanityCheck):
             pd.sanity_check()
         if stop_after_prepare:
@@ -188,7 +204,14 @@ class Engine:
                 )
                 algo_ctx = _dc.replace(ctx, checkpoint=manager)
             try:
-                models.append(algo.train(algo_ctx, pd))
+                # index-prefixed like the checkpoint subdirs: two algos
+                # with the same name must not overwrite each other
+                models.append(
+                    _phase(
+                        f"train:{i}_{algo_names[i]}",
+                        lambda: algo.train(algo_ctx, pd),
+                    )
+                )
             finally:
                 if manager is not None:
                     manager.close()

@@ -187,6 +187,7 @@ def cmd_train(args) -> int:
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
         seed=args.seed,
+        profile_dir=args.profile_dir,
     )
     ctx = ComputeContext.create(seed=args.seed)
     instance_id = run_train(engine, ep, variant, wp, ctx=ctx)
@@ -375,6 +376,17 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_template_list(args) -> int:
+    """List bundled engine factories (reference ``pio template`` browsed a
+    remote gallery; bundled templates ship in-package here)."""
+    import pio_tpu.templates  # noqa: F401  (registers the factories)
+    from pio_tpu.controller.engine import engine_factory_names
+
+    for name in engine_factory_names():
+        _out(name)
+    return 0
+
+
 def cmd_shell(args) -> int:
     """Interactive shell with the framework preloaded.
 
@@ -461,6 +473,10 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--stop-after-read", action="store_true")
     a.add_argument("--stop-after-prepare", action="store_true")
     a.add_argument("--seed", type=int, default=0)
+    a.add_argument(
+        "--profile-dir", default="",
+        help="capture a jax.profiler trace of the train into this dir",
+    )
     a.set_defaults(fn=cmd_train)
 
     a = sub.add_parser("eval", help="run an evaluation sweep")
@@ -542,6 +558,10 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser(
         "shell", help="interactive Python shell with stores preloaded"
     ).set_defaults(fn=cmd_shell)
+    t = sub.add_parser("template", help="bundled engine templates").add_subparsers(
+        dest="template_verb", required=True
+    )
+    t.add_parser("list").set_defaults(fn=cmd_template_list)
     return p
 
 

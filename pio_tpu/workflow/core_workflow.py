@@ -13,6 +13,7 @@ instance env (the reference has no tracing at all — SURVEY.md §5).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import datetime as _dt
 import json as _json
@@ -141,14 +142,25 @@ def run_train(
         )
 
     t0 = time.monotonic()
+    timings: dict = {}
     try:
-        models = engine.train(
-            ctx,
-            engine_params,
-            skip_sanity_check=workflow_params.skip_sanity_check,
-            stop_after_read=workflow_params.stop_after_read,
-            stop_after_prepare=workflow_params.stop_after_prepare,
-        )
+        with contextlib.ExitStack() as stack:
+            if workflow_params.profile_dir:
+                # jax.profiler trace of the whole train — the rebuild's
+                # Spark UI equivalent; view with tensorboard/xprof
+                import jax as _jax
+
+                stack.enter_context(
+                    _jax.profiler.trace(workflow_params.profile_dir)
+                )
+            models = engine.train(
+                ctx,
+                engine_params,
+                skip_sanity_check=workflow_params.skip_sanity_check,
+                stop_after_read=workflow_params.stop_after_read,
+                stop_after_prepare=workflow_params.stop_after_prepare,
+                timings=timings,
+            )
         train_s = time.monotonic() - t0
         if workflow_params.stop_after_read or workflow_params.stop_after_prepare:
             instances.update(instance.with_status(RunStatus.ABORTED))
@@ -179,6 +191,8 @@ def run_train(
             env={
                 "train_seconds": f"{train_s:.3f}",
                 "num_devices": str(ctx.num_devices),
+                # per-phase wall seconds (read / prepare / train:<algo>)
+                **{f"phase_{k}": str(v) for k, v in timings.items()},
             },
         )
         instances.update(done)

@@ -23,3 +23,7 @@ class WorkflowParams:
     #: explicit snapshot dir; default is per-engine-instance (set this to
     #: resume a preempted run under a NEW instance id)
     checkpoint_dir: str = ""
+    #: non-empty → capture a jax.profiler trace of the whole train into
+    #: this directory (viewable with tensorboard/xprof). The rebuild's
+    #: answer to the reference's Spark UI (SURVEY.md §5 tracing).
+    profile_dir: str = ""

@@ -79,6 +79,31 @@ class TestRunTrain:
         )
         assert latest.id == iid
 
+    def test_phase_timings_recorded(self):
+        v = self._variant(algos=[{"name": "algo", "params": {"id": 1}}])
+        engine, ep = build_engine(v)
+        iid = run_train(engine, ep, v, ctx=CTX)
+        env = Storage.get_meta_data_engine_instances().get(iid).env
+        assert "phase_read" in env and "phase_prepare" in env
+        assert "phase_train:0_algo" in env
+        assert float(env["phase_read"]) >= 0.0
+
+    def test_profile_dir_captures_trace(self, tmp_path):
+        import os
+
+        v = self._variant(algos=[{"name": "algo", "params": {"id": 1}}])
+        engine, ep = build_engine(v)
+        prof = str(tmp_path / "trace")
+        run_train(
+            engine, ep, v, WorkflowParams(profile_dir=prof), ctx=CTX
+        )
+        files = [
+            os.path.join(r, f)
+            for r, _, fs in os.walk(prof)
+            for f in fs
+        ]
+        assert files, "profiler produced no trace files"
+
     def test_failed_run_marked(self):
         v = self._variant(ds={"id": 1, "fail_sanity": True}, algos=[{"name": "algo"}])
         engine, ep = build_engine(v)
