@@ -8,7 +8,8 @@ MovieLens-25M scale (25M ratings, 162,541 users, 59,047 items). One
 same program — the "Spark-free CPU ALS reference anchor" from SURVEY.md §6.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "p50_predict_ms": N}   # last field: serving-path p50 (auxiliary)
 
 Env knobs (for smoke runs): PIO_TPU_BENCH_EDGES, PIO_TPU_BENCH_ITERS,
 PIO_TPU_BENCH_RANK, PIO_TPU_BENCH_CPU_EDGES.
@@ -39,14 +40,42 @@ def _synth_ratings(n_edges: int, n_users: int, n_items: int, seed: int = 0):
     return user_idx, item_idx, rating
 
 
-def _time_train(ctx, u, i, r, n_users, n_items, cfg):
-    """Train twice: first call pays compile, second is the timed run."""
+def _time_train(ctx, u, i, r, n_users, n_items, cfg, repeats=3):
+    """Warmup/compile once, then best-of-``repeats`` timed runs (the
+    host↔device link shares a tunnel whose bandwidth fluctuates run to
+    run; min time is the stable throughput estimate).
+
+    Returns (seconds, trained factors) — the factors feed the serving
+    latency measurement.
+    """
     from pio_tpu.models.als import train_als
 
     train_als(ctx, u, i, r, n_users, n_items, cfg)  # warmup/compile
-    t0 = time.perf_counter()
-    train_als(ctx, u, i, r, n_users, n_items, cfg)
-    return time.perf_counter() - t0
+    best, factors = None, None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        factors = train_als(ctx, u, i, r, n_users, n_items, cfg)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best, factors
+
+
+def _predict_p50_ms(factors, n_users: int, n_queries: int = 300) -> float:
+    """p50 of the serving hot path (BASELINE.md's second tracked metric):
+    one user row against the full item-factor matrix + top-10, exactly
+    what Query-server POST /queries.json does per request."""
+    from pio_tpu.models.als import predict_scores, top_n
+
+    lat = []
+    for q in range(n_queries):
+        user = (q * 7919) % n_users
+        t0 = time.perf_counter()
+        scores = predict_scores(
+            factors.user_factors, factors.item_factors, user
+        )
+        top_n(scores, 10)
+        lat.append(time.perf_counter() - t0)
+    return float(np.percentile(np.array(lat) * 1000.0, 50))
 
 
 def main() -> None:
@@ -68,8 +97,9 @@ def main() -> None:
     devices = jax.devices()
     n_chips = len(devices)
     ctx = ComputeContext(mesh=default_mesh(("data",), devices=devices))
-    dt = _time_train(ctx, u, i, r, n_users, n_items, cfg)
+    dt, factors = _time_train(ctx, u, i, r, n_users, n_items, cfg)
     rate_per_chip = n_edges * iters / dt / n_chips
+    p50_ms = _predict_p50_ms(factors, n_users)
 
     # CPU anchor: same XLA program, single host CPU device, subsampled edges.
     cpu_edges = int(os.environ.get("PIO_TPU_BENCH_CPU_EDGES",
@@ -81,8 +111,10 @@ def main() -> None:
         cpu_cfg = ALSConfig(rank=rank, iterations=1, reg=0.1)
         with jax.default_device(cpu_dev):
             cpu_ctx = ComputeContext(mesh=None)
-            cpu_dt = _time_train(cpu_ctx, u[sub], i[sub], r[sub],
-                                 n_users, n_items, cpu_cfg)
+            # same best-of-3 as the accelerator side: an asymmetric
+            # (min vs single-run) comparison would inflate vs_baseline
+            cpu_dt, _ = _time_train(cpu_ctx, u[sub], i[sub], r[sub],
+                                    n_users, n_items, cpu_cfg)
         cpu_rate = cpu_edges * 1 / cpu_dt
     except Exception as exc:  # pragma: no cover - CPU backend always present
         print(f"# cpu anchor failed: {exc}", file=sys.stderr)
@@ -93,6 +125,8 @@ def main() -> None:
         "value": round(rate_per_chip, 1),
         "unit": "examples/sec/chip",
         "vs_baseline": round(vs_baseline, 2),
+        # BASELINE.md's second tracked metric, as an auxiliary field
+        "p50_predict_ms": round(p50_ms, 3),
     }))
 
 
