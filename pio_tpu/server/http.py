@@ -33,6 +33,10 @@ class Request:
     headers: Dict[str, str] = field(default_factory=dict)
     path_args: Tuple[str, ...] = ()
     client_addr: str = ""
+    #: handler-settable hook invoked AFTER the response is written to the
+    #: socket — for actions that must not race the reply (e.g. /undeploy
+    #: stopping the server)
+    after_response: Optional[Callable[[], None]] = None
 
     def header(self, name: str, default: Optional[str] = None):
         return self.headers.get(name.lower(), default)
@@ -159,6 +163,12 @@ def _make_handler_class(router: Router, server_name: str):
                 log.exception("unhandled error on %s %s", method, parsed.path)
                 status, out = 500, {"message": "internal server error"}
             self._respond(status, out)
+            if req.after_response is not None:
+                try:
+                    self.wfile.flush()
+                except OSError:
+                    pass
+                req.after_response()
 
         def do_GET(self):
             self._handle("GET")

@@ -105,6 +105,10 @@ class QueryServerService:
         self.stats = _LatencyStats()
         self._swap_lock = threading.Lock()
         self._deployed = True
+        #: set via attach_server(); when present, /undeploy also stops the
+        #: HTTP server shortly after responding (reference parity: `pio
+        #: undeploy` terminates the server process, not just the flag)
+        self._server = None
         self._load(instance_id)
 
         self.router = Router()
@@ -241,7 +245,21 @@ class QueryServerService:
     def undeploy(self, req: Request):
         self._check_admin(req)
         self._deployed = False
+        if self._server is not None:
+            # after_response fires once the reply is flushed to the
+            # socket, so shutdown can never race the client's read (a
+            # fixed timer would); stop() runs in its own thread because
+            # it blocks until the accept loop exits
+            server = self._server
+            req.after_response = lambda: threading.Thread(
+                target=server.stop, daemon=True
+            ).start()
         return 200, {"message": "undeployed"}
+
+    def attach_server(self, server) -> None:
+        """Let /undeploy stop ``server`` (the CLI deploy path attaches;
+        embedded servers keep the flag-only behavior unless they opt in)."""
+        self._server = server
 
 
 def create_query_server(

@@ -288,6 +288,8 @@ def cmd_deploy(args) -> int:
         feedback_app_id=feedback_app_id,
         admin_key=args.admin_key,
     )
+    # reference parity: `pio undeploy` terminates the serving process
+    service.attach_server(server)
     _out(
         f"Query Server for instance {service.instance_id} "
         f"listening on {args.ip}:{server.port}"

@@ -31,3 +31,11 @@ def tmp_home(tmp_path, monkeypatch):
     """Isolated PIO home directory for storage/metadata tests."""
     monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path))
     return tmp_path
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process integration scenarios (quickstart lifecycle);"
+        " runs by default, deselect quick runs with -m 'not slow'",
+    )

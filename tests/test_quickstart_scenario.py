@@ -1,0 +1,176 @@
+"""Integration scenario: the full quickstart lifecycle through REAL
+processes — the rebuild of the reference's Python integration harness
+(``tests/pio_tests/scenarios/quickstart_test.py``, SURVEY.md §4 tier 2:
+app new → ingest over HTTP → train → deploy → query → undeploy), with
+`python -m pio_tpu` subprocesses instead of pio shell scripts.
+
+Every step crosses a process boundary: state flows only through the
+storage layer ($PIO_TPU_HOME sqlite defaults) and HTTP.
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _cli_env(home):
+    env = dict(os.environ)
+    env["PIO_TPU_HOME"] = str(home)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    # the scenario exercises process plumbing, not collectives
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _run(args, env, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "pio_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+def _wait_http(url, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.3)
+    raise TimeoutError(f"server at {url} never came up")
+
+
+def _post(url, body, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.mark.slow
+def test_full_quickstart_lifecycle(tmp_path):
+    env = _cli_env(tmp_path)
+    procs = []
+    try:
+        # ---- pio app new ------------------------------------------------
+        out = _run(["app", "new", "quickstart"], env)
+        assert out.returncode == 0, out.stderr[-1000:]
+        m = re.search(r"Access key: (\S+)", out.stdout)
+        assert m, out.stdout
+        key = m.group(1)
+
+        # ---- event server + HTTP ingest ---------------------------------
+        es_port = _free_port()
+        es = subprocess.Popen(
+            [sys.executable, "-m", "pio_tpu", "eventserver",
+             "--ip", "127.0.0.1", "--port", str(es_port)],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        procs.append(es)
+        assert _wait_http(f"http://127.0.0.1:{es_port}/")["status"] == "alive"
+
+        batch = [
+            {"event": "rate", "entityType": "user",
+             "entityId": f"u{(i * 13) % 40}",
+             "targetEntityType": "item", "targetEntityId": f"i{i % 25}",
+             "properties": {"rating": float(1 + (i * 7) % 5)},
+             "eventTime": f"2026-01-01T00:{i % 60:02d}:00.000Z"}
+            for i in range(50)
+        ]
+        st, body = _post(
+            f"http://127.0.0.1:{es_port}/batch/events.json?accessKey={key}",
+            batch,
+        )
+        assert st == 200 and all(r["status"] == 201 for r in body), body
+        # duplicate the batch so the export step below sees 100 events
+        # (same 50 distinct user-item edges either way)
+        st, _ = _post(
+            f"http://127.0.0.1:{es_port}/batch/events.json?accessKey={key}",
+            batch,
+        )
+        assert st == 200
+
+        # ---- engine.json + pio train ------------------------------------
+        variant = {
+            "id": "qs1", "engineFactory": "templates.recommendation",
+            "datasource": {"params": {"app_name": "quickstart",
+                                      "rate_event": "rate"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 8, "num_iterations": 5, "lambda_": 0.1}}],
+        }
+        vpath = tmp_path / "engine.json"
+        vpath.write_text(json.dumps(variant))
+        out = _run(["train", "--engine-json", str(vpath)], env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "Training completed" in out.stdout
+
+        # ---- pio deploy + query -----------------------------------------
+        qs_port = _free_port()
+        qs = subprocess.Popen(
+            [sys.executable, "-m", "pio_tpu", "deploy",
+             "--engine-json", str(vpath),
+             "--ip", "127.0.0.1", "--port", str(qs_port)],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        procs.append(qs)
+        _wait_http(f"http://127.0.0.1:{qs_port}/stats.json")
+
+        st, body = _post(
+            f"http://127.0.0.1:{qs_port}/queries.json",
+            {"user": "u1", "num": 4},
+        )
+        assert st == 200, body
+        assert len(body["itemScores"]) == 4, body
+        scores = [x["score"] for x in body["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+
+        # ---- pio undeploy (graceful stop over HTTP) ---------------------
+        out = _run(["undeploy", "--ip", "127.0.0.1",
+                    "--port", str(qs_port)], env, timeout=60)
+        assert out.returncode == 0, out.stderr[-500:]
+        qs.wait(timeout=30)
+
+        # ---- pio export round-trips the ingested events -----------------
+        out_file = tmp_path / "events.jsonl"
+        out = _run(["export", "--app", "quickstart",
+                    "--output", str(out_file)], env)
+        assert out.returncode == 0, out.stderr[-500:]
+        lines = out_file.read_text().strip().splitlines()
+        assert len(lines) == 100
+        assert json.loads(lines[0])["event"] == "rate"
+
+        # ---- pio status self-check --------------------------------------
+        out = _run(["status"], env)
+        assert out.returncode == 0, out.stderr[-500:]
+        assert "sanity check passed" in out.stdout
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
