@@ -123,6 +123,31 @@ class TestALS:
         assert (bo.reshape(S, W) == ref[1]).all()
         assert (br.reshape(S, W) == ref[2]).all()
 
+    def test_device_pack_matches_host_packers(self):
+        """The on-device packer must be bit-identical to the host layout
+        (the trainer's correctness rides on ascending block_ent for
+        indices_are_sorted segment sums and -1 padding sentinels)."""
+        import jax
+        import jax.numpy as jnp
+
+        from pio_tpu.models.als import (
+            _pack_blocks, _round_up, device_pack,
+        )
+
+        rng = np.random.default_rng(21)
+        for E, N, W in [(5000, 80, 16), (1, 4, 8), (64, 4, 8), (97, 200, 8)]:
+            ent = rng.integers(0, N, E).astype(np.int32)
+            oth = rng.integers(0, 999, E).astype(np.int32)
+            rat = rng.random(E).astype(np.float32)
+            ref = _pack_blocks(ent, oth, rat, N, W, 8)
+            S = ref[0].shape[0]
+            got = jax.jit(
+                device_pack, static_argnums=(3, 4, 5)
+            )(jnp.asarray(ent), jnp.asarray(oth), jnp.asarray(rat), N, W, S)
+            assert (np.asarray(got[0]) == ref[0]).all(), (E, N, W)
+            assert (np.asarray(got[1]) == ref[1]).all(), (E, N, W)
+            assert (np.asarray(got[2]) == ref[2]).all(), (E, N, W)
+
     def test_numpy_fallback_trains(self, synthetic, monkeypatch):
         monkeypatch.setenv("PIO_TPU_NO_NATIVE", "1")
         s = synthetic
