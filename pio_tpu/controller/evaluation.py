@@ -41,17 +41,25 @@ class EngineParamsGenerator:
 
 
 class Evaluation:
-    """Binds an engine + metric(s) (reference ``trait Evaluation``)."""
+    """Binds an engine + metric(s) (reference ``trait Evaluation``).
+
+    ``engine_params_generator`` pairs the sweep definition with the
+    evaluation (reference ``Evaluation with EngineParamsGenerator``
+    mix-in); the CLI ``eval`` verb reads it when no generator is passed
+    explicitly.
+    """
 
     def __init__(
         self,
         engine: Engine,
         metric: Metric,
         other_metrics: Sequence[Metric] = (),
+        engine_params_generator: Optional[EngineParamsGenerator] = None,
     ):
         self.engine = engine
         self.metric = metric
         self.other_metrics = list(other_metrics)
+        self.engine_params_generator = engine_params_generator
 
 
 @dataclasses.dataclass

@@ -44,6 +44,7 @@ from pio_tpu.controller import (
     register_engine,
 )
 from pio_tpu.controller.cross_validation import split_data
+from pio_tpu.controller.metrics import AverageMetric
 from pio_tpu.data.bimap import BiMap
 from pio_tpu.models.logreg import LogRegConfig, LogRegModel, train_logreg
 from pio_tpu.models.naive_bayes import (
@@ -281,4 +282,50 @@ def classification_engine() -> Engine:
             "logreg": LogisticRegressionAlgorithm,
         },
         ClassificationServing,
+    )
+
+
+# -------------------------------------------------------------- evaluation
+class AccuracyMetric(AverageMetric):
+    """Fraction of held-out entities whose predicted label matches
+    (the reference classification template's Evaluation.scala metric)."""
+
+    def calculate_one(self, query, prediction, actual):
+        return 1.0 if prediction.label == actual else 0.0
+
+
+def classification_evaluation(
+    app_name: str = "",
+    eval_k: int = 3,
+    lambdas=(0.5, 1.0, 2.0),
+):
+    """Ready-made `pio eval` sweep: k-fold accuracy over the naive-Bayes
+    smoothing grid (the reference template's quickstart evaluation).
+
+    Zero-arg CLI use reads the app from ``$PIO_TPU_EVAL_APP``:
+
+        PIO_TPU_EVAL_APP=myapp python -m pio_tpu eval \\
+            pio_tpu.templates.classification:classification_evaluation
+    """
+    from pio_tpu.controller.engine import EngineParams
+    from pio_tpu.controller.evaluation import (
+        EngineParamsGenerator, Evaluation,
+    )
+    from pio_tpu.templates.common import eval_app_name
+
+    if eval_k < 2:
+        raise ValueError("k-fold evaluation needs eval_k >= 2")
+    ds = DataSourceParams(app_name=eval_app_name(app_name), eval_k=eval_k)
+    grid = [
+        EngineParams(
+            data_source_params=ds,
+            algorithm_params_list=(
+                ("naivebayes", NaiveBayesParams(lambda_=lam)),
+            ),
+        )
+        for lam in lambdas
+    ]
+    return Evaluation(
+        classification_engine(), AccuracyMetric(),
+        engine_params_generator=EngineParamsGenerator(grid),
     )

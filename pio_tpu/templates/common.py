@@ -33,6 +33,15 @@ class PredictedResult:
         }
 
 
+def eval_app_name(app_name: str) -> str:
+    """App for a bundled `pio eval` sweep: the explicit argument, or the
+    ``$PIO_TPU_EVAL_APP`` environment fallback for zero-arg CLI use —
+    one contract shared by every template's evaluation factory."""
+    import os
+
+    return app_name or os.environ.get("PIO_TPU_EVAL_APP", "")
+
+
 def resolve_app(params) -> Tuple[int, Optional[int]]:
     """(app_id, channel_id) from datasource params.
 

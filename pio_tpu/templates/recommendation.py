@@ -36,6 +36,8 @@ from pio_tpu.controller import (
     SanityCheck,
     register_engine,
 )
+from pio_tpu.controller.engine import EngineParams
+from pio_tpu.controller.metrics import OptionAverageMetric
 from pio_tpu.data.bimap import BiMap
 from pio_tpu.models.als import ALSConfig, ALSFactors, top_n, train_als
 from pio_tpu.parallel.context import ComputeContext
@@ -115,6 +117,10 @@ class RecommendationDataSource(DataSource):
         p: DataSourceParams = self.params
         if p.eval_k <= 0:
             return []
+        if p.eval_k == 1:
+            # k=1 would make every training fold empty and fail deep in
+            # ALS with a misleading "no ratings" error
+            raise ValueError("k-fold cross-validation needs eval_k >= 2")
         frame, _ = self._read_frame()
         td_all = self._to_training_data(frame)
         n = len(td_all)
@@ -257,4 +263,64 @@ def recommendation_engine() -> Engine:
         RecommendationPreparator,
         {"als": ALSAlgorithm},
         RecommendationServing,
+    )
+
+
+# -------------------------------------------------------------- evaluation
+class SquaredErrorMetric(OptionAverageMetric):
+    """MSE on held-out (user, item) ratings; queries whose user/item were
+    unseen in the training fold are skipped (the reference template's
+    Evaluation.scala RMSE analog). Lower is better."""
+
+    higher_is_better = False
+
+    def calculate_one(self, query, prediction, actual):
+        if not prediction.item_scores:
+            return None
+        return (prediction.item_scores[0].score - float(actual)) ** 2
+
+
+def recommendation_evaluation(
+    app_name: str = "",
+    eval_k: int = 3,
+    rate_event: str = "rate",
+    ranks=(8, 16),
+    lambdas=(0.05, 0.1),
+    num_iterations: int = 10,
+):
+    """Ready-made `pio eval` sweep: k-fold MSE over a rank × lambda grid.
+
+    Zero-arg CLI use reads the app from ``$PIO_TPU_EVAL_APP``:
+
+        PIO_TPU_EVAL_APP=myapp python -m pio_tpu eval \\
+            pio_tpu.templates.recommendation:recommendation_evaluation
+
+    or wrap it in your own module to pin parameters.
+    """
+    from pio_tpu.controller.evaluation import (
+        EngineParamsGenerator, Evaluation,
+    )
+    from pio_tpu.templates.common import eval_app_name
+
+    if eval_k < 2:
+        raise ValueError("k-fold evaluation needs eval_k >= 2")
+    ds = DataSourceParams(
+        app_name=eval_app_name(app_name), rate_event=rate_event,
+        eval_k=eval_k,
+    )
+    grid = [
+        EngineParams(
+            data_source_params=ds,
+            algorithm_params_list=(
+                ("als", ALSAlgorithmParams(
+                    rank=r, lambda_=lam, num_iterations=num_iterations
+                )),
+            ),
+        )
+        for r in ranks
+        for lam in lambdas
+    ]
+    return Evaluation(
+        recommendation_engine(), SquaredErrorMetric(),
+        engine_params_generator=EngineParamsGenerator(grid),
     )

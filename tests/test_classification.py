@@ -193,3 +193,21 @@ class TestClassificationEndToEnd:
         pairs = engine.algorithms_with_models(ep, models)
         with pytest.raises(ValueError):
             [a.predict(m, Query(attrs=(1.0,))) for a, m in pairs]
+
+
+class TestShippedEvaluation:
+    def test_classification_evaluation_sweep(self):
+        from pio_tpu.templates.classification import (
+            classification_evaluation,
+        )
+        from pio_tpu.workflow import run_evaluation
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "cls-eval"))
+        _seed_users(app_id)
+        ev = classification_evaluation(app_name="cls-eval", eval_k=3)
+        result = run_evaluation(
+            ev, ev.engine_params_generator, ctx=ComputeContext.create()
+        )
+        assert result.best_score > 0.8
+        insts = Storage.get_meta_data_evaluation_instances().get_all()
+        assert insts[0].status == "COMPLETED"

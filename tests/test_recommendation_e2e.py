@@ -152,3 +152,25 @@ class TestRecommendationEndToEnd:
                     n += 1
         assert n > 50
         assert sq_err / n < sq_base / n
+
+
+class TestShippedEvaluation:
+    def test_recommendation_evaluation_sweep(self):
+        from pio_tpu.templates.recommendation import (
+            recommendation_evaluation,
+        )
+        from pio_tpu.workflow import run_evaluation
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "rec-eval"))
+        _seed_events(app_id)
+        ev = recommendation_evaluation(
+            app_name="rec-eval", eval_k=3, ranks=(2,), lambdas=(0.1, 0.3),
+            num_iterations=10,
+        )
+        result = run_evaluation(
+            ev, ev.engine_params_generator, ctx=ComputeContext.create()
+        )
+        # MSE (lower better): must beat predicting a constant 3 everywhere
+        assert result.best_score < 2.0
+        insts = Storage.get_meta_data_evaluation_instances().get_all()
+        assert insts[0].status == "COMPLETED"
