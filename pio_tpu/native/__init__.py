@@ -112,6 +112,8 @@ def event_log_lib():
         lib.pel_count.restype = ctypes.c_int64
         lib.pel_repair.argtypes = [ctypes.c_char_p]
         lib.pel_repair.restype = ctypes.c_int64
+        lib.pel_compact.argtypes = [ctypes.c_char_p]
+        lib.pel_compact.restype = ctypes.c_int64
         _cache["event_log"] = lib
         return lib
 

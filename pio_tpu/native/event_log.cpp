@@ -55,6 +55,20 @@ T read_le(const char* p) {
   return v;
 }
 
+// Last-write-wins filter shared by scan/count/compact: the newest record
+// per event id (by file order) is authoritative; a tombstone as winner
+// kills the id. Appends the surviving records to *live in file order.
+void collect_live(const std::vector<Rec>& recs,
+                  std::vector<const Rec*>* live) {
+  std::unordered_map<std::string, int64_t> last;
+  for (const Rec& r : recs) last[std::string(r.str[0], r.len[0])] = r.seq;
+  for (const Rec& r : recs) {
+    if (r.flags & 1) continue;
+    if (last[std::string(r.str[0], r.len[0])] != r.seq) continue;
+    live->push_back(&r);
+  }
+}
+
 // Parses whole records. A *torn tail* — a trailing partial record left by a
 // crash mid-append (the bytes are a prefix of one framed record) — is NOT
 // corruption: parsing stops there and *valid_end marks the end of the last
@@ -191,17 +205,15 @@ static int pel_scan_impl(const char* path, const char* event_names,
   size_t valid_end;
   if (!parse_records(buf, &recs, &valid_end)) return -2;
 
-  // last-write-wins per event_id: the newest record for an id (data or
-  // tombstone) is authoritative. Re-insert after delete resurrects the id,
-  // and inserting an existing id replaces it — matching the upsert/delete
-  // semantics of the SQLite and memory backends.
-  std::unordered_map<std::string, int64_t> last;
-  for (const Rec& r : recs) last[std::string(r.str[0], r.len[0])] = r.seq;
+  // last-write-wins per event_id (collect_live): re-insert after delete
+  // resurrects the id, inserting an existing id replaces it — matching
+  // the upsert/delete semantics of the SQLite and memory backends.
+  std::vector<const Rec*> live;
+  collect_live(recs, &live);
 
   std::vector<const Rec*> hits;
-  for (const Rec& r : recs) {
-    if (r.flags & 1) continue;
-    if (last[std::string(r.str[0], r.len[0])] != r.seq) continue;
+  for (const Rec* rp : live) {
+    const Rec& r = *rp;
     if (r.time_us < start_us || r.time_us >= until_us) continue;
     if (event_name_count > 0 &&
         !in_set(r.str[1], r.len[1], event_names, event_name_count))
@@ -301,14 +313,9 @@ int64_t pel_count(const char* path) {
     std::vector<Rec> recs;
     size_t valid_end;
     if (!parse_records(buf, &recs, &valid_end)) return -2;
-    std::unordered_map<std::string, int64_t> last;
-    for (const Rec& r : recs) last[std::string(r.str[0], r.len[0])] = r.seq;
-    int64_t n = 0;
-    for (const Rec& r : recs)
-      if (!(r.flags & 1) &&
-          last[std::string(r.str[0], r.len[0])] == r.seq)
-        ++n;
-    return n;
+    std::vector<const Rec*> live;
+    collect_live(recs, &live);
+    return static_cast<int64_t>(live.size());
   } catch (...) {
     return -4;
   }
@@ -335,6 +342,74 @@ int64_t pel_repair(const char* path) {
                  : -1;
     std::fclose(f);
     return rc == 0 ? static_cast<int64_t>(buf.size() - valid_end) : -1;
+  } catch (...) {
+    return -4;
+  }
+}
+
+// Rewrites the log keeping only live records (dropping tombstones and
+// records shadowed by a newer write of the same event id), preserving
+// order. Atomic: writes <path>.compact then renames over the original.
+// Returns bytes reclaimed (0 = nothing to do), -1 io error, -2 corrupt,
+// -4 oom.
+int64_t pel_compact(const char* path) {
+  try {
+    std::vector<char> buf;
+    if (!read_file(path, &buf)) return -1;
+    if (buf.empty()) return 0;
+    std::vector<Rec> recs;
+    size_t valid_end;
+    if (!parse_records(buf, &recs, &valid_end)) return -2;
+
+    std::vector<const Rec*> live;
+    collect_live(recs, &live);
+    int64_t live_bytes = sizeof(kMagic);
+    for (const Rec* r : live) {
+      uint64_t payload = kHeaderFixed;
+      for (int c = 0; c < kNumStr; ++c) payload += r->len[c];
+      live_bytes += 4 + static_cast<int64_t>(payload);
+    }
+    int64_t reclaimed = static_cast<int64_t>(buf.size()) - live_bytes;
+    if (reclaimed <= 0) return 0;
+
+    std::string tmp = std::string(path) + ".compact";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return -1;
+    bool ok = std::fwrite(kMagic, 1, sizeof(kMagic), f) == sizeof(kMagic);
+    for (const Rec* r : live) {
+      if (!ok) break;
+      uint64_t payload = kHeaderFixed;
+      for (int c = 0; c < kNumStr; ++c) payload += r->len[c];
+      uint32_t plen = static_cast<uint32_t>(payload);
+      char head[4 + kHeaderFixed];
+      std::memcpy(head, &plen, 4);
+      char* p = head + 4;
+      p[0] = static_cast<char>(r->flags);
+      std::memcpy(p + 1, &r->time_us, 8);
+      std::memcpy(p + 9, &r->ctime_us, 8);
+      size_t off = 17;
+      for (int c = 0; c < kNumStr - 1; ++c) {
+        uint16_t l16 = static_cast<uint16_t>(r->len[c]);
+        std::memcpy(p + off, &l16, 2);
+        off += 2;
+      }
+      std::memcpy(p + off, &r->len[kNumStr - 1], 4);
+      ok = std::fwrite(head, 1, sizeof(head), f) == sizeof(head);
+      for (int c = 0; ok && c < kNumStr; ++c)
+        if (r->len[c])
+          ok = std::fwrite(r->str[c], 1, r->len[c], f) == r->len[c];
+    }
+    // fsync BEFORE the rename: fflush only reaches the page cache, and a
+    // rename-then-crash could otherwise leave a truncated file where the
+    // intact original used to be (append-path fflush bounds loss to one
+    // record; a rewrite must not risk the whole log)
+    ok = ok && std::fflush(f) == 0 && fsync(fileno(f)) == 0;
+    ok = (std::fclose(f) == 0) && ok;
+    if (!ok || std::rename(tmp.c_str(), path) != 0) {
+      std::remove(tmp.c_str());
+      return -1;
+    }
+    return reclaimed;
   } catch (...) {
     return -4;
   }

@@ -416,6 +416,27 @@ class EventLogEvents(base.LEvents, base.PEvents):
         )
         self._append(app_id, channel_id, recs)
 
+    def compact(self, app_id: int, channel_id=None) -> int:
+        """Rewrite the log dropping tombstones and shadowed records;
+        returns bytes reclaimed. Atomic (temp file + rename), safe to run
+        while serving — in-process readers/writers are excluded by the
+        per-file lock for the duration."""
+        path = self._path(app_id, channel_id)
+        with _lock_for(path):
+            n = int(self._lib.pel_compact(path.encode()))
+            if n > 0:
+                # the REWRITTEN file has no torn tail by construction;
+                # n <= 0 means the original (possibly torn) file is still
+                # in place and the next append must keep its repair pass
+                self._repaired.add(path)
+        if n == -2:
+            raise base.StorageError(f"corrupt event log for app {app_id}")
+        if n < 0:
+            raise base.StorageError(
+                f"event-log compaction failed for app {app_id} (rc={n})"
+            )
+        return n
+
     def count(self, app_id: int, channel_id=None) -> int:
         path = self._path(app_id, channel_id)
         with _lock_for(path):

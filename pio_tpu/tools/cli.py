@@ -109,6 +109,31 @@ def cmd_app_data_delete(args) -> int:
     return 0
 
 
+def cmd_app_compact(args) -> int:
+    """Reclaim space in the event store: eventlog drops tombstones and
+    shadowed upserts, parquet merges shards. No-op for backends without a
+    compact operation."""
+    from pio_tpu.storage import StorageConfigError
+
+    app = _resolve_app(args.name)
+    channel_id = _channel_id(app.id, args.channel)
+    store = _storage()
+    try:
+        backend = store.get_levents()
+    except StorageConfigError:
+        # bulk-only backend (parquet) has no LEvents side
+        backend = store.get_pevents()
+    if not hasattr(backend, "compact"):
+        _out(f"backend {type(backend).__name__} does not need compaction")
+        return 0
+    n = backend.compact(app.id, channel_id)
+    _out(
+        f"compacted app {args.name!r}"
+        + (f": reclaimed {n} bytes" if n is not None else "")
+    )
+    return 0
+
+
 def cmd_channel_new(args) -> int:
     from pio_tpu.storage import Channel
 
@@ -445,6 +470,10 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("name")
     a.add_argument("--channel", default=None)
     a.set_defaults(fn=cmd_app_data_delete)
+    a = app.add_parser("compact")
+    a.add_argument("name")
+    a.add_argument("--channel", default=None)
+    a.set_defaults(fn=cmd_app_compact)
     a = app.add_parser("channel-new")
     a.add_argument("app")
     a.add_argument("channel")

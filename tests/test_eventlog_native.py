@@ -120,6 +120,47 @@ class TestLastWriteWins:
         assert {e.event_id for e in backend.find(1)} == set(ids[7:])
 
 
+class TestCompaction:
+    def test_compact_drops_dead_records(self, backend):
+        for i in range(20):
+            backend.insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                      properties={"rating": float(i % 5)},
+                      event_time=T(1), event_id=f"E{i}"),
+                2,
+            )
+        # shadow half by upsert, delete a quarter
+        for i in range(0, 20, 2):
+            backend.insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                      properties={"rating": 9.0},
+                      event_time=T(2), event_id=f"E{i}"),
+                2,
+            )
+        for i in range(0, 20, 4):
+            backend.delete(f"E{i}", 2)
+        before = os.path.getsize(backend._path(2))
+        pre = {e.event_id: e.properties.to_dict() for e in backend.find(2)}
+        reclaimed = backend.compact(2)
+        assert reclaimed > 0
+        assert os.path.getsize(backend._path(2)) == before - reclaimed
+        post = {e.event_id: e.properties.to_dict() for e in backend.find(2)}
+        assert post == pre  # observable state unchanged
+        assert backend.count(2) == len(pre)
+        # idempotent: second pass reclaims nothing
+        assert backend.compact(2) == 0
+        # log still appendable after the rewrite
+        backend.insert(
+            Event(event="rate", entity_type="user", entity_id="u99",
+                  event_time=T(3)),
+            2,
+        )
+        assert backend.count(2) == len(pre) + 1
+
+    def test_compact_missing_file_is_noop(self, backend):
+        assert backend.compact(42) == 0
+
+
 class TestRobustness:
     def test_unreadable_file_is_an_error_not_empty(self, backend):
         import stat
