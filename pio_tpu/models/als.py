@@ -393,11 +393,12 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
 
     @jax.jit
     def run_packed(u, i, r, seed):
-        # u/i may arrive uint16-compressed (entity count < 2^16 → half
-        # the wire bytes); widen for the gathers/scatters
+        # u/i may arrive uint16-compressed and r fp16-compressed (half
+        # the wire bytes each, when lossless); widen on device
         u32, i32 = u.astype(jnp.int32), i.astype(jnp.int32)
-        by_user = device_pack(u32, i32, r, U_pad, wu, su)
-        by_item = device_pack(i32, u32, r, I_pad, wi, si)
+        r32 = r.astype(jnp.float32)
+        by_user = device_pack(u32, i32, r32, U_pad, wu, su)
+        by_item = device_pack(i32, u32, r32, I_pad, wi, si)
         return run_body(by_user, by_item, seed)
 
     return run_packed
@@ -559,7 +560,13 @@ def train_als(
         run = _trainer(chunk_user, chunk_item, (S_u, w_user, S_i, w_item))
         u_ship = user_idx.astype(np.uint16) if U_pad < 65536 else user_idx
         i_ship = item_idx.astype(np.uint16) if I_pad < 65536 else item_idx
-        P_f, Q_f = run(u_ship, i_ship, rating, seed)
+        # ratings ride fp16 when that's lossless (star/half-star scales
+        # are: MovieLens's 0.5..5.0 grid is exact in fp16)
+        r16 = rating.astype(np.float16)
+        r_ship = r16 if np.array_equal(
+            r16.astype(np.float32), rating
+        ) else rating
+        P_f, Q_f = run(u_ship, i_ship, r_ship, seed)
 
     P_f, Q_f = jax.device_get((P_f, Q_f))
     return ALSFactors(
