@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import importlib
 import json
+import logging
 import sys
 import urllib.request
 from typing import Optional
@@ -204,6 +205,11 @@ def cmd_train(args) -> int:
     from pio_tpu.parallel.context import ComputeContext
     from pio_tpu.workflow import WorkflowParams, build_engine, run_train
 
+    if args.checkpoint_dir and not args.checkpoint_every:
+        raise SystemExit(_err(
+            "--checkpoint-dir has no effect without --checkpoint-every N "
+            "(nothing would be snapshotted)"
+        ))
     variant = _load_variant(args.engine_json)
     engine, ep = build_engine(variant)
     wp = WorkflowParams(
@@ -213,6 +219,8 @@ def cmd_train(args) -> int:
         stop_after_prepare=args.stop_after_prepare,
         seed=args.seed,
         profile_dir=args.profile_dir,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
     )
     ctx = ComputeContext.create(seed=args.seed)
     instance_id = run_train(engine, ep, variant, wp, ctx=ctx)
@@ -451,7 +459,16 @@ def cmd_shell(args) -> int:
 # -------------------------------------------------------------------- parser
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
-        prog="pio-tpu", description="TPU-native ML server CLI"
+        prog="pio-tpu", description="TPU-native ML server CLI",
+        epilog="global flags (-v/-q) go BEFORE the verb: pio-tpu -v train …",
+    )
+    vq = p.add_mutually_exclusive_group()
+    vq.add_argument(
+        "-v", "--verbose", action="count", default=0,
+        help="debug logging (includes jax)",
+    )
+    vq.add_argument(
+        "-q", "--quiet", action="store_true", help="warnings only"
     )
     sub = p.add_subparsers(dest="verb", required=True)
 
@@ -507,6 +524,16 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument(
         "--profile-dir", default="",
         help="capture a jax.profiler trace of the train into this dir",
+    )
+    a.add_argument(
+        "--checkpoint-every", type=int, default=0,
+        help="snapshot training state every N steps; a preempted run "
+             "restarted with the same engine.json resumes automatically",
+    )
+    a.add_argument(
+        "--checkpoint-dir", default="",
+        help="explicit snapshot dir (default: per-engine-config under "
+             "$PIO_TPU_HOME)",
     )
     a.set_defaults(fn=cmd_train)
 
@@ -596,8 +623,26 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _configure_logging(verbosity: int) -> None:
+    """Console logging for CLI runs (reference log4j.properties +
+    ``WorkflowUtils.modifyLogging``): pio_tpu at INFO by default so
+    training status, checkpoint restores, and server events are visible;
+    -q → WARNING, -v → DEBUG (jax stays at WARNING unless -v)."""
+    level = (
+        logging.WARNING if verbosity < 0
+        else logging.DEBUG if verbosity > 0
+        else logging.INFO
+    )
+    logging.basicConfig(format="[%(levelname)s] [%(name)s] %(message)s")
+    logging.getLogger("pio_tpu").setLevel(level)
+    logging.getLogger("jax").setLevel(
+        logging.DEBUG if verbosity > 0 else logging.WARNING
+    )
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    _configure_logging(-1 if args.quiet else args.verbose)
     return args.fn(args)
 
 
