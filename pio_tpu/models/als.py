@@ -392,10 +392,18 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
     su, wu, si, wi = packed_shapes
 
     @jax.jit
-    def run_packed(u, i, r, seed):
-        # u/i may arrive uint16-compressed and r fp16-compressed (half
-        # the wire bytes each, when lossless); widen on device
-        u32, i32 = u.astype(jnp.int32), i.astype(jnp.int32)
+    def run_packed(u, i, r, u_hi, i_hi, seed):
+        # index compression over the wire (widened here, on device):
+        # ids < 2^16 arrive uint16; ids < 2^24 arrive as uint16 low plane
+        # + uint8 high plane (u_hi/i_hi; zeros-size-0 when unused);
+        # ratings arrive fp16 when the cast was lossless
+        def widen(lo, hi):
+            x = lo.astype(jnp.int32)
+            if hi.shape[0]:
+                x = x | (hi.astype(jnp.int32) << 16)
+            return x
+
+        u32, i32 = widen(u, u_hi), widen(i, i_hi)
         r32 = r.astype(jnp.float32)
         by_user = device_pack(u32, i32, r32, U_pad, wu, su)
         by_item = device_pack(i32, u32, r32, I_pad, wi, si)
@@ -558,15 +566,29 @@ def train_als(
                 "use a multi-device mesh"
             )
         run = _trainer(chunk_user, chunk_item, (S_u, w_user, S_i, w_item))
-        u_ship = user_idx.astype(np.uint16) if U_pad < 65536 else user_idx
-        i_ship = item_idx.astype(np.uint16) if I_pad < 65536 else item_idx
+        def _planes(idx, n_pad):
+            """(low, high) wire encoding: uint16 alone below 2^16, uint16
+            + uint8 high plane below 2^24 (3 B/id instead of 4), raw int32
+            beyond. The empty high plane means "unused"."""
+            none = np.zeros(0, np.uint8)
+            if n_pad < 65536:
+                return idx.astype(np.uint16), none
+            if n_pad < (1 << 24):
+                return (
+                    (idx & 0xFFFF).astype(np.uint16),
+                    (idx >> 16).astype(np.uint8),
+                )
+            return idx, none
+
+        u_ship, u_hi = _planes(user_idx, U_pad)
+        i_ship, i_hi = _planes(item_idx, I_pad)
         # ratings ride fp16 when that's lossless (star/half-star scales
         # are: MovieLens's 0.5..5.0 grid is exact in fp16)
         r16 = rating.astype(np.float16)
         r_ship = r16 if np.array_equal(
             r16.astype(np.float32), rating
         ) else rating
-        P_f, Q_f = run(u_ship, i_ship, r_ship, seed)
+        P_f, Q_f = run(u_ship, i_ship, r_ship, u_hi, i_hi, seed)
 
     P_f, Q_f = jax.device_get((P_f, Q_f))
     return ALSFactors(

@@ -148,6 +148,27 @@ class TestALS:
             assert (np.asarray(got[1]) == ref[1]).all(), (E, N, W)
             assert (np.asarray(got[2]) == ref[2]).all(), (E, N, W)
 
+    def test_wide_id_space_plane_encoding(self):
+        """Entity ids in [2^16, 2^24) ship as uint16+uint8 planes; a
+        mis-widened id would train the wrong rows."""
+        rng = np.random.default_rng(5)
+        hi_users = [65_536, 70_000, 99_999]  # beyond the uint16 range
+        u = np.array(hi_users * 40, np.int32)
+        i = rng.integers(0, 8, len(u)).astype(np.int32)
+        R = rng.normal(size=(3, 8)).astype(np.float32)
+        r = np.array(
+            [R[hi_users.index(uu), ii] for uu, ii in zip(u, i)], np.float32
+        )
+        f = train_als(
+            ComputeContext.local(), u, i, r, 100_000, 8,
+            ALSConfig(rank=4, iterations=10, reg=0.05),
+        )
+        pred = (f.user_factors[u] * f.item_factors[i]).sum(1)
+        rmse = float(np.sqrt(np.mean((pred - r) ** 2)))
+        assert rmse < 0.1, rmse
+        # untouched rows stay at their tiny init scale
+        assert np.abs(f.user_factors[500]).max() < 0.05
+
     def test_numpy_fallback_trains(self, synthetic, monkeypatch):
         monkeypatch.setenv("PIO_TPU_NO_NATIVE", "1")
         s = synthetic
