@@ -267,6 +267,58 @@ class TestRobustness:
         assert len(sub) == 100
 
 
+class TestConcurrency:
+    def test_parallel_writers_readers_compactors(self, backend):
+        """Threads hammering insert/find/count/compact on one app must
+        never see a torn read ("corrupt event log") or lose a write —
+        the per-file lock contract."""
+        import threading
+
+        errors = []
+        written = [0] * 4
+
+        def writer(t):
+            try:
+                for k in range(120):
+                    backend.insert(
+                        Event(event="rate", entity_type="user",
+                              entity_id=f"w{t}_{k}",
+                              properties={"rating": float(k % 5)},
+                              event_time=T(1)),
+                        7,
+                    )
+                    written[t] += 1
+            except Exception as e:  # pragma: no cover
+                errors.append(("writer", t, repr(e)))
+
+        def reader():
+            try:
+                for _ in range(60):
+                    backend.find(7, event_names=["rate"], limit=50)
+                    backend.count(7)
+            except Exception as e:  # pragma: no cover
+                errors.append(("reader", repr(e)))
+
+        def compactor():
+            try:
+                for _ in range(10):
+                    backend.compact(7)
+            except Exception as e:  # pragma: no cover
+                errors.append(("compactor", repr(e)))
+
+        threads = (
+            [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+            + [threading.Thread(target=reader) for _ in range(2)]
+            + [threading.Thread(target=compactor)]
+        )
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        assert not errors, errors
+        assert backend.count(7) == sum(written) == 480
+
+
 class TestRegistryWiring:
     def test_eventlog_type_serves_both_spis(self, tmp_path, monkeypatch):
         from pio_tpu.storage import Storage
