@@ -1,0 +1,27 @@
+"""Every examples/<t>/engine.json must bind: factory resolves, params
+validate (wrong names fail at build time, which is the point)."""
+
+import json
+import os
+
+import pytest
+
+import pio_tpu.templates  # noqa: F401
+from pio_tpu.workflow import build_engine, variant_from_dict
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+
+
+@pytest.mark.parametrize(
+    "name", sorted(os.listdir(EXAMPLES)) if os.path.isdir(EXAMPLES) else []
+)
+def test_example_engine_json_builds(name):
+    if not os.path.isdir(os.path.join(EXAMPLES, name)):
+        pytest.skip("not a template dir (e.g. README.md)")
+    path = os.path.join(EXAMPLES, name, "engine.json")
+    assert os.path.isfile(path), f"{name}/ has no engine.json"
+    variant = variant_from_dict(json.load(open(path)))
+    engine, ep = build_engine(variant)
+    assert ep.algorithm_params_list
