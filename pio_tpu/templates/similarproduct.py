@@ -43,6 +43,7 @@ from pio_tpu.controller import (
     SanityCheck,
     register_engine,
 )
+from pio_tpu.controller.metrics import AverageMetric
 from pio_tpu.data.bimap import BiMap
 from pio_tpu.models.als import ALSConfig, train_als
 from pio_tpu.parallel.context import ComputeContext
@@ -64,6 +65,10 @@ class DataSourceParams(Params):
     app_id: int = 0
     channel: str = ""
     view_event: str = "view"
+    eval_k: int = 0  # >0 enables k-fold read_eval
+    #: eval: context items per query / top-k window scored by HitRate
+    eval_query_items: int = 3
+    eval_num: int = 10
 
 
 @dataclasses.dataclass
@@ -115,6 +120,56 @@ class SimilarProductDataSource(DataSource):
             item_ids=frame.target_entity_id,
             item_categories=cats,
         )
+
+    def read_eval(self, ctx: ComputeContext):
+        """k-fold co-view holdout: the query carries a few items the user
+        viewed in the training fold, the actual is a held-out co-viewed
+        item — scored by HitRate@eval_num."""
+        p: DataSourceParams = self.params
+        if p.eval_k <= 0:
+            return []
+        if p.eval_k == 1:
+            raise ValueError("k-fold cross-validation needs eval_k >= 2")
+        td = self.read_training(ctx)
+        # dedupe (user, item) pairs: a repeat view split across folds
+        # would leak the held-out interaction into the training fold
+        seen = set()
+        keep = []
+        for idx, (u, i) in enumerate(zip(td.user_ids, td.item_ids)):
+            if (u, i) not in seen:
+                seen.add((u, i))
+                keep.append(idx)
+        keep = np.asarray(keep, np.int64)
+        td = TrainingData(
+            user_ids=td.user_ids[keep],
+            item_ids=td.item_ids[keep],
+            item_categories=td.item_categories,
+        )
+        n = len(td)
+        fold_of = np.arange(n) % p.eval_k
+        folds = []
+        for k in range(p.eval_k):
+            train = fold_of != k
+            td_k = TrainingData(
+                user_ids=td.user_ids[train],
+                item_ids=td.item_ids[train],
+                item_categories=td.item_categories,
+            )
+            by_user: Dict[str, List[str]] = {}
+            for u, i in zip(td_k.user_ids, td_k.item_ids):
+                by_user.setdefault(u, []).append(i)
+            qa = []
+            for u, i in zip(td.user_ids[~train], td.item_ids[~train]):
+                ctx_items = [
+                    x for x in by_user.get(u, ()) if x != i
+                ][: p.eval_query_items]
+                if not ctx_items:
+                    continue  # cold user in this fold — unanswerable
+                qa.append(
+                    (Query(items=tuple(ctx_items), num=p.eval_num), str(i))
+                )
+            folds.append((td_k, {"fold": k}, qa))
+        return folds
 
 
 # --------------------------------------------------------------- preparator
@@ -248,4 +303,59 @@ def similarproduct_engine() -> Engine:
         SimilarProductPreparator,
         {"als": SimilarProductAlgorithm},
         SimilarProductServing,
+    )
+
+
+# -------------------------------------------------------------- evaluation
+class HitRateMetric(AverageMetric):
+    """Fraction of held-out co-viewed items appearing in the top-k similars
+    (HitRate@k; the reference similar-product eval pattern)."""
+
+    def calculate_one(self, query, prediction, actual):
+        return 1.0 if any(
+            s.item == actual for s in prediction.item_scores
+        ) else 0.0
+
+
+def similarproduct_evaluation(
+    app_name: str = "",
+    eval_k: int = 3,
+    ranks=(8, 16),
+    num_iterations: int = 10,
+    eval_num: int = 10,
+):
+    """Ready-made `pio eval` sweep: k-fold HitRate@``eval_num`` over the
+    rank grid. Keep ``eval_num`` well below the catalog size or the
+    metric saturates (every item fits in the window).
+
+    Zero-arg CLI use reads the app from ``$PIO_TPU_EVAL_APP``:
+
+        PIO_TPU_EVAL_APP=myapp python -m pio_tpu eval \\
+            pio_tpu.templates.similarproduct:similarproduct_evaluation
+    """
+    from pio_tpu.controller.engine import EngineParams
+    from pio_tpu.controller.evaluation import (
+        EngineParamsGenerator, Evaluation,
+    )
+    from pio_tpu.templates.common import eval_app_name
+
+    if eval_k < 2:
+        raise ValueError("k-fold evaluation needs eval_k >= 2")
+    ds = DataSourceParams(
+        app_name=eval_app_name(app_name), eval_k=eval_k, eval_num=eval_num
+    )
+    grid = [
+        EngineParams(
+            data_source_params=ds,
+            algorithm_params_list=(
+                ("als", ALSAlgorithmParams(
+                    rank=r, num_iterations=num_iterations
+                )),
+            ),
+        )
+        for r in ranks
+    ]
+    return Evaluation(
+        similarproduct_engine(), HitRateMetric(),
+        engine_params_generator=EngineParamsGenerator(grid),
     )

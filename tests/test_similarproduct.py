@@ -197,3 +197,26 @@ class TestECommerce:
         _, serve = self._setup()
         res = serve(ecommerce.Query(user="u0", num=4, black_list=("i1",)))
         assert "i1" not in {s.item for s in res.item_scores}
+
+
+class TestShippedEvaluation:
+    def test_similarproduct_evaluation_sweep(self):
+        from pio_tpu.templates.similarproduct import (
+            similarproduct_evaluation,
+        )
+        from pio_tpu.workflow import run_evaluation
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "sp-eval"))
+        _seed_views(app_id)
+        # eval_num=1 on the 8-item catalog keeps the metric
+        # discriminative (HitRate@1; random chance ~1/7 per query)
+        ev = similarproduct_evaluation(
+            app_name="sp-eval", eval_k=3, ranks=(4,), num_iterations=8,
+            eval_num=1,
+        )
+        result = run_evaluation(
+            ev, ev.engine_params_generator, ctx=ComputeContext.create()
+        )
+        assert result.best_score > 0.4, result.best_score
+        insts = Storage.get_meta_data_evaluation_instances().get_all()
+        assert insts[0].status == "COMPLETED"
