@@ -48,6 +48,7 @@ from pio_tpu.controller import (
     register_engine,
 )
 from pio_tpu.controller.cross_validation import split_data
+from pio_tpu.controller.metrics import AverageMetric
 from pio_tpu.data.bimap import BiMap
 from pio_tpu.models.mlp import MLPConfig, MLPModel, train_mlp
 from pio_tpu.models.naive_bayes import (
@@ -339,4 +340,48 @@ def textclassification_engine() -> Engine:
         TextPreparator,
         {"mlp": MLPAlgorithm, "nb": NBAlgorithm},
         TextServing,
+    )
+
+
+# -------------------------------------------------------------- evaluation
+class TextAccuracyMetric(AverageMetric):
+    """Fraction of held-out documents labeled correctly."""
+
+    def calculate_one(self, query, prediction, actual):
+        return 1.0 if prediction.label == actual else 0.0
+
+
+def textclassification_evaluation(
+    app_name: str = "",
+    eval_k: int = 3,
+    hiddens=(64, 128),
+):
+    """Ready-made `pio eval` sweep: k-fold accuracy over the MLP hidden
+    width grid.
+
+    Zero-arg CLI use reads the app from ``$PIO_TPU_EVAL_APP``:
+
+        PIO_TPU_EVAL_APP=myapp python -m pio_tpu eval \\
+            pio_tpu.templates.textclassification:textclassification_evaluation
+    """
+    from pio_tpu.controller.engine import EngineParams
+    from pio_tpu.controller.evaluation import (
+        EngineParamsGenerator, Evaluation,
+    )
+    from pio_tpu.templates.common import eval_app_name
+
+    if eval_k < 2:
+        raise ValueError("k-fold evaluation needs eval_k >= 2")
+    ds = DataSourceParams(app_name=eval_app_name(app_name), eval_k=eval_k)
+    grid = [
+        EngineParams(
+            data_source_params=ds,
+            preparator_params=PreparatorParams(),
+            algorithm_params_list=(("mlp", MLPParams(hidden=h)),),
+        )
+        for h in hiddens
+    ]
+    return Evaluation(
+        textclassification_engine(), TextAccuracyMetric(),
+        engine_params_generator=EngineParamsGenerator(grid),
     )

@@ -282,3 +282,41 @@ class TestMLPServingCache:
         fn1 = m._serve_cache[0]
         m.logits(ids, w)
         assert m._serve_cache[0] is fn1
+
+
+class TestShippedEvaluation:
+    def test_textclassification_evaluation_sweep(self):
+        from pio_tpu.controller import ComputeContext
+        from pio_tpu.templates.textclassification import (
+            textclassification_evaluation,
+        )
+        from pio_tpu.workflow import run_evaluation
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "txt-eval"))
+        # k-fold needs more than the 9 base docs: repeat each doc with a
+        # neutral suffix so every fold's training set covers both labels
+        le = Storage.get_levents()
+        t0 = dt.datetime(2026, 4, 2, tzinfo=dt.timezone.utc)
+        n = 0
+        for label, docs in DOCS.items():
+            for text in docs:
+                for rep in range(3):
+                    le.insert(
+                        Event(
+                            "$set", "content", f"rep{n}",
+                            properties={"text": text + f" copy {rep}",
+                                        "label": label},
+                            event_time=t0 + dt.timedelta(minutes=n),
+                        ),
+                        app_id,
+                    )
+                    n += 1
+        ev = textclassification_evaluation(
+            app_name="txt-eval", eval_k=3, hiddens=(32,)
+        )
+        result = run_evaluation(
+            ev, ev.engine_params_generator, ctx=ComputeContext.create()
+        )
+        assert result.best_score > 0.6, result.best_score
+        insts = Storage.get_meta_data_evaluation_instances().get_all()
+        assert insts[0].status == "COMPLETED"
