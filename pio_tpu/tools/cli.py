@@ -403,6 +403,23 @@ def cmd_status(args) -> int:
     for name, healthy in sorted(checks.items()):
         _out(f"  {'OK ' if healthy else 'FAIL'} {name}")
     _out("(sanity check " + ("passed)" if ok else "FAILED)"))
+    try:
+        insts = _storage().get_meta_data_engine_instances().get_all()
+    except Exception:
+        # status must degrade gracefully on the exact broken-backend
+        # condition it reports (the FAIL lines above already said so)
+        insts = []
+    if insts:
+        _out("recent engine instances:")
+        for inst in sorted(
+            insts, key=lambda i: i.start_time, reverse=True
+        )[:5]:
+            secs = inst.env.get("train_seconds", "")
+            _out(
+                f"  {inst.id[:12]}  {inst.status:<9} "
+                f"{inst.engine_factory}"
+                + (f"  ({secs}s)" if secs else "")
+            )
     return 0 if ok else 1
 
 
