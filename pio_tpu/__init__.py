@@ -18,6 +18,11 @@ A from-scratch rebuild of the capabilities of Apache PredictionIO
 - ``pio_tpu.ops``        — Pallas kernels and TPU-friendly primitive ops
 - ``pio_tpu.parallel``   — mesh / sharding / collective helpers replacing Spark
                            shuffle + treeAggregate
+- ``pio_tpu.templates``  — bundled engines (recommendation, classification,
+                           similar-product, e-commerce, text classification,
+                           two-tower, sequence) [ref: examples/scala-parallel-*]
+- ``pio_tpu.native``     — C++ runtime components (event-log storage engine,
+                           ALS data packer), built with g++ on first use
 - ``pio_tpu.tools``      — the ``pio`` CLI equivalent
 
 Where the reference dispatches work to Spark executors, this package runs
