@@ -228,13 +228,15 @@ def cmd_train(args) -> int:
     return 0
 
 
-def _import_attr(spec: str):
+def _import_attr(spec: str, call: bool = True):
+    """Resolve ``module:attr``; with ``call`` (the eval-verb convention),
+    zero-arg callables are invoked to produce the object."""
     mod_name, _, attr = spec.partition(":")
     mod = importlib.import_module(mod_name)
     if not attr:
         return mod
     obj = getattr(mod, attr)
-    return obj() if callable(obj) else obj
+    return obj() if call and callable(obj) else obj
 
 
 def cmd_eval(args) -> int:
@@ -439,6 +441,42 @@ def cmd_template_list(args) -> int:
     return 0
 
 
+def cmd_run(args) -> int:
+    """Run a user entry point with the framework importable and storage
+    configured (reference ``pio run <main class> -- args``): the target is
+    ``module:function``, called with the passthrough argument list (or no
+    arguments if it accepts none)."""
+    import inspect
+    import os
+
+    # console-script installs don't put the invocation dir on sys.path the
+    # way `python -m` does — the primary use case is a script in cwd
+    if "" not in sys.path and os.getcwd() not in sys.path:
+        sys.path.insert(0, os.getcwd())
+    target = _import_attr(args.target, call=False)
+    if not callable(target):
+        return _err(f"{args.target!r} is not callable")
+    argv = list(args.args)
+    try:
+        params = inspect.signature(target).parameters.values()
+        takes_args = any(
+            p.kind in (
+                p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                p.VAR_POSITIONAL,
+            )
+            for p in params
+        )
+    except (TypeError, ValueError):  # some C-implemented callables
+        takes_args = bool(argv)
+    if argv and not takes_args:
+        return _err(
+            f"{args.target!r} accepts no positional arguments but "
+            f"passthrough args were given: {argv}"
+        )
+    out = target(argv) if takes_args else target()
+    return out if isinstance(out, int) else 0
+
+
 def cmd_shell(args) -> int:
     """Interactive shell with the framework preloaded.
 
@@ -637,6 +675,17 @@ def build_parser() -> argparse.ArgumentParser:
         dest="template_verb", required=True
     )
     t.add_parser("list").set_defaults(fn=cmd_template_list)
+
+    a = sub.add_parser(
+        "run", help="run a module:function entry point with the framework"
+    )
+    a.add_argument("target", help="entry point as module:function")
+    a.add_argument(
+        "args", nargs=argparse.REMAINDER,
+        help="passthrough arguments (everything after the target, "
+             "flag-like tokens included)",
+    )
+    a.set_defaults(fn=cmd_run)
     return p
 
 

@@ -156,3 +156,38 @@ class TestTrainDeployFlow:
     def test_undeploy_unreachable(self, capsys):
         code, _, err = run(capsys, "undeploy", "--port", "59999")
         assert code == 1 and "cannot reach" in err
+
+
+class TestRunVerb:
+    def test_run_calls_target_with_args(self, tmp_path, monkeypatch):
+        import sys
+
+        mod = tmp_path / "userjob.py"
+        mod.write_text(
+            "def main(argv):\n"
+            "    print('JOB', argv)\n"
+            "    return 0 if argv == ['a', 'b'] else 3\n"
+            "def noargs():\n"
+            "    print('NOARGS')\n"
+        )
+        monkeypatch.syspath_prepend(str(tmp_path))
+        sys.modules.pop("userjob", None)
+        from pio_tpu.tools.cli import main
+
+        assert main(["run", "userjob:main", "a", "b"]) == 0
+        assert main(["run", "userjob:main", "x"]) == 3
+        assert main(["run", "userjob:noargs"]) == 0
+        # flag-like passthrough needs no -- separator (REMAINDER)
+        assert main(["run", "userjob:main", "--flag", "v"]) == 3
+        # args to a no-arg target is an error, not silent discard
+        assert main(["run", "userjob:noargs", "oops"]) == 1
+
+    def test_run_rejects_non_callable(self, tmp_path, monkeypatch):
+        import sys
+
+        (tmp_path / "userdata.py").write_text("VALUE = 7\n")
+        monkeypatch.syspath_prepend(str(tmp_path))
+        sys.modules.pop("userdata", None)
+        from pio_tpu.tools.cli import main
+
+        assert main(["run", "userdata:VALUE"]) == 1
