@@ -167,6 +167,19 @@ class Storage:
                 cls._clients[key] = EventLogEvents(path)
             return cls._clients[key]
 
+    @classmethod
+    def sqlite_clients(cls) -> Dict[str, SQLiteClient]:
+        """repository label → SQLiteClient for every repository configured
+        on the sqlite backend (opening a client applies pending schema
+        migrations). The public surface for maintenance tooling
+        (`pio upgrade`); raises StorageConfigError on misconfiguration."""
+        out: Dict[str, SQLiteClient] = {}
+        for repo in REPOSITORIES:
+            cfg = _source_config(repo)
+            if cfg.type == "sqlite":
+                out[repo] = cls._sqlite_client(cfg)
+        return out
+
     # -- event stores -------------------------------------------------------
     @classmethod
     def get_levents(cls) -> base.LEvents:

@@ -102,6 +102,16 @@ CREATE TABLE IF NOT EXISTS models (
 
 from pio_tpu.utils.timeutil import from_micros as _from_us, to_micros as _to_us
 
+#: bump when _SCHEMA changes shape, and add a migration step below
+#: (reference analog: `pio upgrade` migrating storage between releases)
+SCHEMA_VERSION = 1
+
+#: from-version → LIST of single SQL statements bringing the db to
+#: from-version + 1. Statement lists (not scripts): sqlite3's
+#: executescript() force-commits, which would break the per-step
+#: transaction that makes a failed migration roll back cleanly.
+MIGRATIONS: dict = {}
+
 
 class SQLiteClient:
     """Per-thread connections to one SQLite file (or shared memory db)."""
@@ -112,8 +122,68 @@ class SQLiteClient:
         self._init_lock = threading.Lock()
         with self._init_lock:
             conn = self.conn()
-            conn.executescript(_SCHEMA)
+            self._migrate(conn)
             conn.commit()
+
+    @staticmethod
+    def _migrate(conn) -> None:
+        """Create or upgrade the schema, stamped via PRAGMA user_version.
+
+        Version 0 covers both fresh files and pre-versioning databases;
+        the CREATE IF NOT EXISTS script is idempotent over the latter.
+        A FILE NEWER than this build refuses to open (no downgrades).
+        """
+        v = conn.execute("PRAGMA user_version").fetchone()[0]
+        if v > SCHEMA_VERSION:
+            raise base.StorageError(
+                f"database schema v{v} is newer than this build's "
+                f"v{SCHEMA_VERSION}; upgrade pio-tpu instead"
+            )
+        if v == 0:
+            pre_versioning = conn.execute(
+                "SELECT 1 FROM sqlite_master WHERE type='table' "
+                "AND name='events'"
+            ).fetchone()
+            if pre_versioning:
+                # tables from a pre-versioning build: stamp v1 (their
+                # shape) and fall through the ladder like any old db
+                conn.execute("PRAGMA user_version = 1")
+                conn.commit()
+                v = 1
+            else:
+                # fresh file: current schema directly, no ladder
+                conn.executescript(_SCHEMA)
+                conn.execute(f"PRAGMA user_version = {SCHEMA_VERSION}")
+                conn.commit()
+                return
+        for step in range(v, SCHEMA_VERSION):
+            if step not in MIGRATIONS:
+                raise base.StorageError(
+                    f"no migration registered for schema v{step} → "
+                    f"v{step + 1} (SCHEMA_VERSION bumped without a "
+                    "MIGRATIONS entry)"
+                )
+            # one transaction per step, stamped inside it: a failure rolls
+            # the step back whole, and a concurrent migrator blocks on
+            # BEGIN IMMEDIATE then re-reads the version it races with
+            conn.commit()  # close any implicit transaction first
+            conn.execute("BEGIN IMMEDIATE")
+            try:
+                cur = conn.execute("PRAGMA user_version").fetchone()[0]
+                if cur != step:  # someone else already applied this step
+                    conn.rollback()
+                    continue
+                for stmt in MIGRATIONS[step]:
+                    conn.execute(stmt)
+                conn.execute(f"PRAGMA user_version = {step + 1}")
+                conn.commit()
+            except BaseException:
+                conn.rollback()
+                raise
+
+    @staticmethod
+    def schema_version(conn) -> int:
+        return conn.execute("PRAGMA user_version").fetchone()[0]
 
     def conn(self) -> sqlite3.Connection:
         c = getattr(self._local, "conn", None)

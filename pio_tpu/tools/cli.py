@@ -441,6 +441,38 @@ def cmd_template_list(args) -> int:
     return 0
 
 
+def cmd_upgrade(args) -> int:
+    """Migrate configured SQLite storage to this build's schema
+    (reference ``pio upgrade``). Opening a database applies pending
+    migrations, so this verb just touches every configured store and
+    reports the stamped schema version."""
+    import sqlite3
+
+    from pio_tpu.storage import StorageError
+    from pio_tpu.storage.sqlite import SCHEMA_VERSION, SQLiteClient
+
+    try:
+        clients = _storage().sqlite_clients()
+    except StorageError as e:  # schema newer than build, or misconfig
+        return _err(str(e))
+    except sqlite3.Error as e:  # failed migration SQL, locked db, ...
+        return _err(f"migration failed: {e}")
+    if not clients:
+        _out("no SQLite stores configured; nothing to migrate")
+        return 0
+    seen_paths = set()
+    for label, client in clients.items():
+        v = SQLiteClient.schema_version(client.conn())
+        note = " (same file as above)" if client.path in seen_paths else ""
+        seen_paths.add(client.path)
+        _out(
+            f"  {label}: {client.path} at schema v{v} "
+            f"(current v{SCHEMA_VERSION}){note}"
+        )
+    _out("storage schema up to date")
+    return 0
+
+
 def cmd_run(args) -> int:
     """Run a user entry point with the framework importable and storage
     configured (reference ``pio run <main class> -- args``): the target is
@@ -675,6 +707,10 @@ def build_parser() -> argparse.ArgumentParser:
         dest="template_verb", required=True
     )
     t.add_parser("list").set_defaults(fn=cmd_template_list)
+
+    sub.add_parser(
+        "upgrade", help="migrate storage to this build's schema"
+    ).set_defaults(fn=cmd_upgrade)
 
     a = sub.add_parser(
         "run", help="run a module:function entry point with the framework"

@@ -448,3 +448,102 @@ class TestRegistry:
         with pytest.raises(StorageConfigError):
             Storage.get_meta_data_apps()
         Storage.reset()
+
+
+# ------------------------------------------------------- schema migrations
+class TestSchemaMigrations:
+    def test_fresh_db_stamped_current(self, tmp_path):
+        from pio_tpu.storage import sqlite as sq
+
+        c = sq.SQLiteClient(str(tmp_path / "v.db"))
+        assert sq.SQLiteClient.schema_version(c.conn()) == sq.SCHEMA_VERSION
+
+    def test_migration_ladder_applies_and_stamps(self, tmp_path, monkeypatch):
+        from pio_tpu.storage import sqlite as sq
+
+        path = str(tmp_path / "m.db")
+        sq.SQLiteClient(path)  # create at v1
+        monkeypatch.setattr(sq, "SCHEMA_VERSION", 2)
+        monkeypatch.setattr(
+            sq, "MIGRATIONS",
+            {1: ["ALTER TABLE apps ADD COLUMN note TEXT"]},
+        )
+        c = sq.SQLiteClient(path)
+        assert sq.SQLiteClient.schema_version(c.conn()) == 2
+        c.conn().execute("SELECT note FROM apps")  # column exists
+        sq.SQLiteClient(path)  # idempotent reopen at current version
+
+    def test_failed_migration_rolls_back_whole_step(
+        self, tmp_path, monkeypatch
+    ):
+        import sqlite3
+
+        from pio_tpu.storage import sqlite as sq
+
+        path = str(tmp_path / "f.db")
+        sq.SQLiteClient(path)
+        monkeypatch.setattr(sq, "SCHEMA_VERSION", 2)
+        monkeypatch.setattr(
+            sq, "MIGRATIONS",
+            {1: ["ALTER TABLE apps ADD COLUMN note TEXT",
+                 "THIS IS NOT SQL"]},
+        )
+        with pytest.raises(sqlite3.OperationalError):
+            sq.SQLiteClient(path)
+        conn = sqlite3.connect(path)
+        # stamped version unchanged AND the step's first statement undone
+        assert conn.execute("PRAGMA user_version").fetchone()[0] == 1
+        with pytest.raises(sqlite3.OperationalError):
+            conn.execute("SELECT note FROM apps")
+        conn.close()
+
+    def test_newer_schema_refused(self, tmp_path):
+        import sqlite3
+
+        from pio_tpu.storage import sqlite as sq
+        from pio_tpu.storage.base import StorageError
+
+        path = str(tmp_path / "n.db")
+        sq.SQLiteClient(path)
+        conn = sqlite3.connect(path)
+        conn.execute("PRAGMA user_version = 99")
+        conn.commit()
+        conn.close()
+        with pytest.raises(StorageError, match="newer"):
+            sq.SQLiteClient(path)
+
+    def test_pre_versioning_db_goes_through_ladder(
+        self, tmp_path, monkeypatch
+    ):
+        import sqlite3
+
+        from pio_tpu.storage import sqlite as sq
+
+        path = str(tmp_path / "pre.db")
+        sq.SQLiteClient(path)
+        conn = sqlite3.connect(path)
+        conn.execute("PRAGMA user_version = 0")  # simulate pre-versioning
+        conn.commit()
+        conn.close()
+        monkeypatch.setattr(sq, "SCHEMA_VERSION", 2)
+        monkeypatch.setattr(
+            sq, "MIGRATIONS",
+            {1: ["ALTER TABLE apps ADD COLUMN note TEXT"]},
+        )
+        c = sq.SQLiteClient(path)
+        # the migration MUST have run (not fast-forward stamped past it)
+        assert sq.SQLiteClient.schema_version(c.conn()) == 2
+        c.conn().execute("SELECT note FROM apps")
+
+    def test_missing_migration_step_is_clear_error(
+        self, tmp_path, monkeypatch
+    ):
+        from pio_tpu.storage import sqlite as sq
+        from pio_tpu.storage.base import StorageError
+
+        path = str(tmp_path / "gap.db")
+        sq.SQLiteClient(path)
+        monkeypatch.setattr(sq, "SCHEMA_VERSION", 3)
+        monkeypatch.setattr(sq, "MIGRATIONS", {2: ["SELECT 1"]})
+        with pytest.raises(StorageError, match="no migration registered"):
+            sq.SQLiteClient(path)
