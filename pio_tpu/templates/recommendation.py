@@ -245,11 +245,39 @@ class ALSAlgorithm(Algorithm):
             return PredictedResult(
                 (ItemScore(query.item, float(scores[code])),)
             )
-        idx, vals = top_n(scores, query.num)
-        inv = model.item_index.inverse
-        return PredictedResult(
-            tuple(ItemScore(inv[int(i)], float(v)) for i, v in zip(idx, vals))
-        )
+        return _top_n_result(scores, query.num, model.item_index)
+
+    def batch_predict(self, model: ALSModel, queries):
+        """Vectorized offline scoring (reference ``batchPredictBase``):
+        known-user top-N queries batch into ONE [B, K] @ [K, N] matmul;
+        unknown users and single-item queries take the per-query path."""
+        out = []
+        bidx, bcodes, bq = [], [], []
+        for i, q in queries:
+            code = model.user_index.get(q.user)
+            if code is None or q.item:
+                out.append((i, self.predict(model, q)))
+            else:
+                bidx.append(i)
+                bcodes.append(code)
+                bq.append(q)
+        if bcodes:
+            # same math as scores_for_user, batched over the user rows
+            U = model.factors.user_factors[np.asarray(bcodes)]
+            scores = U @ model.factors.item_factors.T  # [B, n_items]
+            for i, q, row in zip(bidx, bq, scores):
+                out.append((i, _top_n_result(row, q.num, model.item_index)))
+        return out
+
+
+def _top_n_result(scores, num: int, item_index: BiMap) -> PredictedResult:
+    """Shared top-N → PredictedResult tail for predict and batch_predict
+    (one home, so online and offline scoring cannot diverge)."""
+    idx, vals = top_n(scores, num)
+    inv = item_index.inverse
+    return PredictedResult(
+        tuple(ItemScore(inv[int(i)], float(v)) for i, v in zip(idx, vals))
+    )
 
 
 class RecommendationServing(FirstServing):
