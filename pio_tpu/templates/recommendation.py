@@ -251,23 +251,12 @@ class ALSAlgorithm(Algorithm):
         """Vectorized offline scoring (reference ``batchPredictBase``):
         known-user top-N queries batch into ONE [B, K] @ [K, N] matmul;
         unknown users and single-item queries take the per-query path."""
-        out = []
-        bidx, bcodes, bq = [], [], []
-        for i, q in queries:
-            code = model.user_index.get(q.user)
-            if code is None or q.item:
-                out.append((i, self.predict(model, q)))
-            else:
-                bidx.append(i)
-                bcodes.append(code)
-                bq.append(q)
-        if bcodes:
+        return batched_user_topn(
+            self, model, queries, model.user_index, model.item_index,
             # same math as scores_for_user, batched over the user rows
-            U = model.factors.user_factors[np.asarray(bcodes)]
-            scores = U @ model.factors.item_factors.T  # [B, n_items]
-            for i, q, row in zip(bidx, bq, scores):
-                out.append((i, _top_n_result(row, q.num, model.item_index)))
-        return out
+            lambda codes: model.factors.user_factors[codes]
+            @ model.factors.item_factors.T,
+        )
 
 
 def _top_n_result(scores, num: int, item_index: BiMap) -> PredictedResult:
@@ -278,6 +267,29 @@ def _top_n_result(scores, num: int, item_index: BiMap) -> PredictedResult:
     return PredictedResult(
         tuple(ItemScore(inv[int(i)], float(v)) for i, v in zip(idx, vals))
     )
+
+
+def batched_user_topn(algo, model, queries, user_index, item_index,
+                      score_batch):
+    """Shared batch_predict routing for user→top-N recommenders (ALS,
+    two-tower): known-user top-N queries batch through ``score_batch``
+    (int codes → [B, n_items] scores); unknown users and single-item
+    queries fall back to ``algo.predict``."""
+    out = []
+    bidx, bcodes, bq = [], [], []
+    for i, q in queries:
+        code = user_index.get(q.user)
+        if code is None or q.item:
+            out.append((i, algo.predict(model, q)))
+        else:
+            bidx.append(i)
+            bcodes.append(code)
+            bq.append(q)
+    if bcodes:
+        scores = score_batch(np.asarray(bcodes))
+        for i, q, row in zip(bidx, bq, scores):
+            out.append((i, _top_n_result(row, q.num, item_index)))
+    return out
 
 
 class RecommendationServing(FirstServing):

@@ -43,7 +43,6 @@ from pio_tpu.models.two_tower import (
     TwoTowerModel,
     train_two_tower,
 )
-from pio_tpu.models.als import top_n
 from pio_tpu.parallel.context import ComputeContext
 from pio_tpu.parallel.mesh import MeshSpec, build_mesh
 from pio_tpu.templates.common import ItemScore, PredictedResult
@@ -52,6 +51,8 @@ from pio_tpu.templates.recommendation import (
     Query,
     RecommendationDataSource,
     RecommendationPreparator,
+    _top_n_result,
+    batched_user_topn,
 )
 
 
@@ -133,13 +134,16 @@ class TwoTowerAlgorithm(Algorithm):
             return PredictedResult(
                 (ItemScore(query.item, float(scores[icode])),)
             )
-        idx, vals = top_n(scores, query.num)
-        inv = model.item_index.inverse
-        return PredictedResult(
-            tuple(
-                ItemScore(inv[int(i)], float(v))
-                for i, v in zip(idx, vals)
-            )
+        return _top_n_result(scores, query.num, model.item_index)
+
+    def batch_predict(self, model: TwoTowerEngineModel, queries):
+        """Vectorized offline scoring: one tower matmul for every
+        known-user top-N query (shared routing with the ALS template)."""
+        return batched_user_topn(
+            self, model, queries, model.user_index, model.item_index,
+            lambda codes: model.model.scores(
+                model.model.user_vectors[codes]
+            ),
         )
 
 

@@ -201,3 +201,34 @@ class TestSequenceTemplate:
             Query(user="ghost", num=3),
         )
         assert result.item_scores == ()
+
+
+class TestTwoTowerBatchPredict:
+    def test_batch_matches_loop(self):
+        from pio_tpu.templates.twotower import Query, twotower_engine
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "tt-test"))
+        _seed_interactions(app_id)
+        variant = variant_from_dict({
+            "id": "ttb", "engineFactory": "templates.twotower",
+            "datasource": {"params": {"app_name": "tt-test",
+                                      "rate_event": "view"}},
+            "algorithms": [{"name": "twotower", "params": {
+                "embed_dim": 16, "hidden": 32, "out_dim": 16,
+                "steps": 100, "batch_size": 64}}],
+        })
+        engine, ep = build_engine(variant)
+        ctx = ComputeContext.create(seed=0)
+        iid = run_train(engine, ep, variant, ctx=ctx)
+        models = load_models_for_instance(iid, engine, ep, ctx)
+        algo, model = engine.algorithms_with_models(ep, models)[0]
+        queries = [
+            (i, Query(user=f"u{i % 6}", num=4)) for i in range(12)
+        ] + [(99, Query(user="stranger", num=4))]
+        loop = {i: algo.predict(model, q) for i, q in queries}
+        bat = dict(algo.batch_predict(model, queries))
+        assert set(loop) == set(bat)
+        for i in loop:
+            assert [s.item for s in loop[i].item_scores] == [
+                s.item for s in bat[i].item_scores
+            ], i

@@ -174,3 +174,29 @@ class TestShippedEvaluation:
         assert result.best_score < 2.0
         insts = Storage.get_meta_data_evaluation_instances().get_all()
         assert insts[0].status == "COMPLETED"
+
+
+class TestBatchPredict:
+    def test_batch_matches_loop(self):
+        from pio_tpu.templates.recommendation import Query
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "rec-test"))
+        _seed_events(app_id)
+        variant = variant_from_dict(VARIANT)
+        engine, ep = build_engine(variant)
+        ctx = ComputeContext.create(seed=0)
+        iid = run_train(engine, ep, variant, ctx=ctx)
+        models = load_models_for_instance(iid, engine, ep, ctx)
+        algo, model = engine.algorithms_with_models(ep, models)[0]
+        queries = (
+            [(i, Query(user=f"u{i % 10}", num=4)) for i in range(20)]
+            + [(90, Query(user="u1", num=1, item="i2"))]  # single-item
+            + [(91, Query(user="ghost", num=4))]          # unknown user
+        )
+        loop = {i: algo.predict(model, q) for i, q in queries}
+        bat = dict(algo.batch_predict(model, queries))
+        assert set(loop) == set(bat)
+        for i in loop:
+            assert [s.item for s in loop[i].item_scores] == [
+                s.item for s in bat[i].item_scores
+            ], i
