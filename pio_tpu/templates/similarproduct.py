@@ -280,16 +280,52 @@ class SimilarProductAlgorithm(Algorithm):
         basket = model.norm_factors[np.asarray(codes, np.int32)]
         scores = model.norm_factors @ basket.mean(axis=0)
 
-        mask = business_rule_mask(
-            len(scores),
-            model.item_index,
-            model.categories,
-            categories=query.categories,
-            white_list=query.white_list,
-            black_list=query.black_list,
-        )
-        mask[np.asarray(codes, np.int32)] = False  # never return the basket
-        return top_item_scores(scores, mask, query.num, model.item_index)
+        return _masked_top_result(model, codes, scores, query)
+
+    def batch_predict(self, model: SimilarProductModel, queries):
+        """Vectorized offline scoring: one [B, K] @ [K, N] matmul over all
+        resolvable baskets; business-rule masks stay per query (they
+        depend on each query's category/white/black lists)."""
+        out = []
+        bidx, bq, bcodes = [], [], []
+        for i, q in queries:
+            codes = [
+                c
+                for c in (model.item_index.get(x) for x in q.items)
+                if c is not None
+            ]
+            if not codes:
+                out.append((i, PredictedResult()))
+                continue
+            bidx.append(i)
+            bq.append(q)
+            bcodes.append(codes)
+        if bidx:
+            baskets = np.stack([
+                model.norm_factors[np.asarray(c, np.int32)].mean(axis=0)
+                for c in bcodes
+            ])
+            scores = baskets @ model.norm_factors.T  # [B, n_items]
+            for i, q, codes, row in zip(bidx, bq, bcodes, scores):
+                out.append((i, _masked_top_result(model, codes, row, q)))
+        return out
+
+
+def _masked_top_result(
+    model: SimilarProductModel, codes, scores, query: Query
+) -> PredictedResult:
+    """Shared business-rule mask + top-N tail for predict/batch_predict
+    (one home, so online and offline scoring cannot diverge)."""
+    mask = business_rule_mask(
+        len(scores),
+        model.item_index,
+        model.categories,
+        categories=query.categories,
+        white_list=query.white_list,
+        black_list=query.black_list,
+    )
+    mask[np.asarray(codes, np.int32)] = False  # never return the basket
+    return top_item_scores(scores, mask, query.num, model.item_index)
 
 
 class SimilarProductServing(FirstServing):

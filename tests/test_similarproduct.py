@@ -220,3 +220,34 @@ class TestShippedEvaluation:
         assert result.best_score > 0.4, result.best_score
         insts = Storage.get_meta_data_evaluation_instances().get_all()
         assert insts[0].status == "COMPLETED"
+
+
+class TestBatchPredict:
+    def test_batch_matches_loop(self):
+        from pio_tpu.templates.similarproduct import Query
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "sp-test"))
+        _seed_views(app_id)
+        variant = variant_from_dict({
+            "id": "sp-bp", "engineFactory": "templates.similarproduct",
+            "datasource": {"params": {"app_name": "sp-test"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 6, "num_iterations": 8}}],
+        })
+        engine, ep = build_engine(variant)
+        ctx = ComputeContext.create(seed=0)
+        iid = run_train(engine, ep, variant, ctx=ctx)
+        models = load_models_for_instance(iid, engine, ep, ctx)
+        algo, model = engine.algorithms_with_models(ep, models)[0]
+        queries = (
+            [(i, Query(items=(f"i{i % 8}",), num=3)) for i in range(16)]
+            + [(90, Query(items=("i1",), num=3, categories=("food",)))]
+            + [(91, Query(items=("ghost",), num=3))]  # unknown basket
+        )
+        loop = {i: algo.predict(model, q) for i, q in queries}
+        bat = dict(algo.batch_predict(model, queries))
+        assert set(loop) == set(bat)
+        for i in loop:
+            assert [s.item for s in loop[i].item_scores] == [
+                s.item for s in bat[i].item_scores
+            ], i
