@@ -221,9 +221,13 @@ class SeqRecAlgorithm(Algorithm):
         self, model: SeqRecEngineModel, query: Query
     ) -> Optional[List[int]]:
         if query.history:
-            fwd = model.item_index.to_dict()
+            # O(1) lookups — to_dict() would copy the whole index per query
             codes = [
-                fwd[i] + 1 for i in query.history if i in fwd
+                c + 1
+                for c in (
+                    model.item_index.get(i) for i in query.history
+                )
+                if c is not None
             ]
             return codes or None
         return model.user_histories.get(query.user)
@@ -234,19 +238,52 @@ class SeqRecAlgorithm(Algorithm):
         codes = self._history_codes(model, query)
         if not codes:
             return PredictedResult()  # unknown user / empty history
-        t = model.model.config.max_len
-        row = np.zeros((1, t), np.int32)
-        tail = codes[-t:]
-        row[0, : len(tail)] = tail
-        scores = model.model.next_item_scores(row)[0]
-        idx, vals = top_n(scores[1:], query.num)  # shift off the pad row
-        inv = model.item_index.inverse
-        return PredictedResult(
-            tuple(
-                ItemScore(inv[int(i)], float(v))
-                for i, v in zip(idx, vals)
-            )
-        )
+        scores = model.model.next_item_scores(
+            _history_rows([codes], model.model.config.max_len)
+        )[0]
+        return _seq_top_result(scores, query.num, model.item_index)
+
+    def batch_predict(self, model: SeqRecEngineModel, queries):
+        """Vectorized offline scoring: the transformer forward already
+        takes a [B, T] batch — stack every resolvable history and run
+        ONE device call instead of B."""
+        out = []
+        bidx, bq, bcodes = [], [], []
+        for i, q in queries:
+            codes = self._history_codes(model, q)
+            if not codes:
+                out.append((i, PredictedResult()))
+                continue
+            bidx.append(i)
+            bq.append(q)
+            bcodes.append(codes)
+        if bidx:
+            rows = _history_rows(bcodes, model.model.config.max_len)
+            scores = model.model.next_item_scores(rows)
+            for i, q, row in zip(bidx, bq, scores):
+                out.append(
+                    (i, _seq_top_result(row, q.num, model.item_index))
+                )
+        return out
+
+
+def _history_rows(code_lists, max_len: int) -> np.ndarray:
+    """Right-truncated, zero-padded [B, max_len] history batch."""
+    rows = np.zeros((len(code_lists), max_len), np.int32)
+    for r, codes in enumerate(code_lists):
+        tail = codes[-max_len:]
+        rows[r, : len(tail)] = tail
+    return rows
+
+
+def _seq_top_result(scores, num: int, item_index) -> PredictedResult:
+    """Shared top-N tail (scores[0] is the pad row, shifted off here) so
+    predict and batch_predict cannot diverge."""
+    idx, vals = top_n(scores[1:], num)
+    inv = item_index.inverse
+    return PredictedResult(
+        tuple(ItemScore(inv[int(i)], float(v)) for i, v in zip(idx, vals))
+    )
 
 
 class SequenceServing(FirstServing):

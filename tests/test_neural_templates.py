@@ -232,3 +232,46 @@ class TestTwoTowerBatchPredict:
             assert [s.item for s in loop[i].item_scores] == [
                 s.item for s in bat[i].item_scores
             ], i
+
+
+class TestSequenceBatchPredict:
+    def test_batch_matches_loop(self):
+        from pio_tpu.templates.sequence import Query
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "seq-test"))
+        le = Storage.get_levents()
+        t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        for u in range(8):
+            for k in range(10):
+                le.insert(
+                    Event(event="view", entity_type="user",
+                          entity_id=f"u{u}", target_entity_type="item",
+                          target_entity_id=f"i{(u + k) % 8}",
+                          event_time=t0 + dt.timedelta(minutes=k)),
+                    app_id,
+                )
+        variant = variant_from_dict({
+            "id": "sqb", "engineFactory": "templates.sequence",
+            "datasource": {"params": {"app_name": "seq-test",
+                                      "event_names": ["view"]}},
+            "algorithms": [{"name": "seqrec", "params": {
+                "d_model": 32, "n_heads": 4, "n_layers": 2, "ffn": 64,
+                "max_len": 16, "steps": 120, "learning_rate": 3e-3}}],
+        })
+        engine, ep = build_engine(variant)
+        ctx = ComputeContext.create(seed=0)
+        iid = run_train(engine, ep, variant, ctx=ctx)
+        models = load_models_for_instance(iid, engine, ep, ctx)
+        algo, model = engine.algorithms_with_models(ep, models)[0]
+        queries = (
+            [(i, Query(user=f"u{i % 6}", num=3)) for i in range(10)]
+            + [(90, Query(history=("i1", "i2"), num=3))]
+            + [(91, Query(user="stranger", num=3))]
+        )
+        loop = {i: algo.predict(model, q) for i, q in queries}
+        bat = dict(algo.batch_predict(model, queries))
+        assert set(loop) == set(bat)
+        for i in loop:
+            assert [s.item for s in loop[i].item_scores] == [
+                s.item for s in bat[i].item_scores
+            ], i
