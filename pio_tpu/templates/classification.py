@@ -220,6 +220,13 @@ class NaiveBayesAlgorithm(Algorithm):
         code = int(model.nb.predict(x)[0])
         return PredictedResult(label=model.label_index.inverse[code])
 
+    def batch_predict(self, model: NBClassifierModel, queries):
+        """One batched scoring call for the whole query file (the model
+        predict already takes [B, d])."""
+        return _batch_label_results(
+            model, queries, lambda X: model.nb.predict(X)
+        )
+
 
 @dataclasses.dataclass(frozen=True)
 class LogRegParams(Params):
@@ -266,6 +273,27 @@ class LogisticRegressionAlgorithm(Algorithm):
         x = query.vector(model.dim)
         code = int(model.lr.predict(x)[0])
         return PredictedResult(label=model.label_index.inverse[code])
+
+    def batch_predict(self, model: LogRegClassifierModel, queries):
+        """One batched scoring call for the whole query file."""
+        return _batch_label_results(
+            model, queries, lambda X: model.lr.predict(X)
+        )
+
+
+def _batch_label_results(model, queries, predict_codes):
+    """Shared batch tail for the attribute classifiers: stack the query
+    vectors, one model call, map codes back to labels. An invalid query
+    (wrong attr arity) raises exactly as the per-query path would."""
+    if not queries:
+        return []
+    # vector() yields [1, d] rows; concatenate → [B, d]
+    X = np.concatenate([q.vector(model.dim) for _, q in queries])
+    inv = model.label_index.inverse
+    return [
+        (i, PredictedResult(label=inv[int(c)]))
+        for (i, _), c in zip(queries, predict_codes(X))
+    ]
 
 
 class ClassificationServing(FirstServing):

@@ -272,12 +272,22 @@ class MLPAlgorithm(Algorithm):
         ids, w = _query_bag(
             model.vectorizer, query.text, model.bag_width, model.token_cap
         )
-        proba = model.mlp.predict_proba(ids, w)[0]
-        code = int(np.argmax(proba))
-        return PredictedResult(
-            label=model.label_index.inverse[code],
-            confidence=float(proba[code]),
+        return _proba_result(
+            model.mlp.predict_proba(ids, w)[0], model.label_index
         )
+
+    def batch_predict(self, model: TextMLPModel, queries):
+        """Tokenize per query on host, then one device forward per
+        bounded chunk of stacked [B, L] bags."""
+        out = []
+        for chunk in _chunks(queries):
+            ids, w = _stack_bags(model, chunk)
+            proba = model.mlp.predict_proba(ids, w)
+            out.extend(
+                (i, _proba_result(p, model.label_index))
+                for (i, _), p in zip(chunk, proba)
+            )
+        return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -320,13 +330,59 @@ class NBAlgorithm(Algorithm):
             model.vectorizer, query.text, model.bag_width, model.token_cap
         )
         log_p = model.nb.scores_bags(ids, w)[0]
-        code = int(np.argmax(log_p))
-        p = np.exp(log_p - log_p.max())
-        p = p / p.sum()
-        return PredictedResult(
-            label=model.label_index.inverse[code],
-            confidence=float(p[code]),
+        return _proba_result(_softmax(log_p), model.label_index)
+
+    def batch_predict(self, model: TextNBModel, queries):
+        """Tokenize per query on host, then one scores_bags call per
+        bounded chunk (its [C, B, L] gather scales with the chunk, so an
+        arbitrarily large query file must not ride one dispatch)."""
+        out = []
+        for chunk in _chunks(queries):
+            ids, w = _stack_bags(model, chunk)
+            log_p = model.nb.scores_bags(ids, w)
+            out.extend(
+                (i, _proba_result(_softmax(lp), model.label_index))
+                for (i, _), lp in zip(chunk, log_p)
+            )
+        return out
+
+
+#: batch-scoring chunk: bounds the [B, L] bags (and NB's [C, B, L]
+#: gather) regardless of query-file size, and keeps jit shape
+#: specialization to at most two variants (full chunks + the remainder)
+_BATCH_CHUNK = 1024
+
+
+def _chunks(queries, n: int = _BATCH_CHUNK):
+    for k in range(0, len(queries), n):
+        yield queries[k:k + n]
+
+
+def _stack_bags(model, queries):
+    """[B, L] id/weight bags from the queries' texts (host tokenize)."""
+    bags = [
+        _query_bag(
+            model.vectorizer, q.text, model.bag_width, model.token_cap
         )
+        for _, q in queries
+    ]
+    return (
+        np.concatenate([b[0] for b in bags]),
+        np.concatenate([b[1] for b in bags]),
+    )
+
+
+def _softmax(log_p: np.ndarray) -> np.ndarray:
+    p = np.exp(log_p - log_p.max())
+    return p / p.sum()
+
+
+def _proba_result(proba: np.ndarray, label_index) -> PredictedResult:
+    """Shared argmax+confidence tail so predict/batch_predict agree."""
+    code = int(np.argmax(proba))
+    return PredictedResult(
+        label=label_index.inverse[code], confidence=float(proba[code])
+    )
 
 
 class TextServing(FirstServing):

@@ -211,3 +211,31 @@ class TestShippedEvaluation:
         assert result.best_score > 0.8
         insts = Storage.get_meta_data_evaluation_instances().get_all()
         assert insts[0].status == "COMPLETED"
+
+
+class TestBatchPredict:
+    @pytest.mark.parametrize("algo", ["naivebayes", "logreg"])
+    def test_batch_matches_loop(self, algo):
+        from pio_tpu.workflow import run_train
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "cls-test"))
+        _seed_users(app_id)
+        variant = variant_from_dict({
+            "id": "cb", "engineFactory": "templates.classification",
+            "datasource": {"params": {"app_name": "cls-test"}},
+            "algorithms": [{"name": algo, "params": {}}],
+        })
+        engine, ep = build_engine(variant)
+        ctx = ComputeContext.create(seed=0)
+        iid = run_train(engine, ep, variant, ctx=ctx)
+        models = load_models_for_instance(iid, engine, ep, ctx)
+        a, model = engine.algorithms_with_models(ep, models)[0]
+        queries = [
+            (i, Query(attrs=(float(6 + i % 3), float(i % 2), 0.0)))
+            for i in range(12)
+        ]
+        loop = {i: a.predict(model, q) for i, q in queries}
+        bat = dict(a.batch_predict(model, queries))
+        assert {i: r.label for i, r in loop.items()} == {
+            i: r.label for i, r in bat.items()
+        }

@@ -320,3 +320,34 @@ class TestShippedEvaluation:
         assert result.best_score > 0.6, result.best_score
         insts = Storage.get_meta_data_evaluation_instances().get_all()
         assert insts[0].status == "COMPLETED"
+
+
+class TestBatchPredict:
+    @pytest.mark.parametrize("algo", ["mlp", "nb"])
+    def test_batch_matches_loop(self, algo):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "text-test"))
+        _seed_docs(app_id)
+        variant = _variant({"name": algo, "params": {}})
+        engine, ep = build_engine(variant)
+        from pio_tpu.controller import ComputeContext
+
+        ctx = ComputeContext.create(seed=0)
+        iid = run_train(engine, ep, variant, ctx=ctx)
+        models = load_models_for_instance(iid, engine, ep, ctx)
+        a, model = engine.algorithms_with_models(ep, models)[0]
+        from pio_tpu.templates.textclassification import Query
+
+        queries = [
+            (i, Query(text=t))
+            for i, t in enumerate(
+                DOCS["sports"][:2] + DOCS["tech"][:2]
+                + ["completely unrelated words entirely"]
+            )
+        ]
+        loop = {i: a.predict(model, q) for i, q in queries}
+        bat = dict(a.batch_predict(model, queries))
+        for i in loop:
+            assert loop[i].label == bat[i].label, i
+            assert loop[i].confidence == pytest.approx(
+                bat[i].confidence, abs=1e-5
+            )
