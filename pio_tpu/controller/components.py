@@ -106,6 +106,14 @@ class Algorithm(Component, Generic[PD, M, Q, P]):
         """Default: loop predict. Override with a vectorized device program."""
         return [(i, self.predict(model, q)) for i, q in queries]
 
+    def prepare_for_serving(self, model: M) -> M:
+        """One-time serving prep at deploy/load (the model-side half of the
+        reference's ``Engine.prepareDeploy``): upload factor tables to the
+        accelerator, build jitted scorers. Runs for both the query server
+        and batch predict (``Engine.algorithms_with_models``). Default:
+        return the model unchanged."""
+        return model
+
 
 # Reference-parity aliases (see module docstring): the P/L/P2L distinction is
 # a Spark artifact; on a mesh all algorithms are "distributed".

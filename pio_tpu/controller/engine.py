@@ -251,7 +251,11 @@ class Engine:
             raise ValueError(
                 f"{len(algos)} algorithms but {len(models)} models"
             )
-        return list(zip(algos, models))
+        # serving prep (reference Engine.prepareDeploy): one-time device
+        # upload / jitted-scorer build per (algorithm, model) pair
+        return [
+            (a, a.prepare_for_serving(m)) for a, m in zip(algos, models)
+        ]
 
 
 class SimpleEngine(Engine):

@@ -6,8 +6,11 @@ hand-written Pallas TPU kernels with plain-XLA fallbacks for CPU:
 
 - :func:`embedding_bag` — weighted embedding-bag lookup (TF-IDF × table,
   feature-bag × table) streaming rows HBM→VMEM via an async-DMA ring.
+- :class:`DeviceTopNScorer` — device-resident factor scoring for serving
+  (upload once at deploy, jitted matmul + top-k per request).
 """
 
 from pio_tpu.ops.embedding import embedding_bag, pack_bags
+from pio_tpu.ops.topn import DeviceTopNScorer
 
-__all__ = ["embedding_bag", "pack_bags"]
+__all__ = ["embedding_bag", "pack_bags", "DeviceTopNScorer"]

@@ -33,6 +33,35 @@ class PredictedResult:
         }
 
 
+class DeviceScorerModel:
+    """Lazy per-model :class:`DeviceTopNScorer` cache with pickle-drop —
+    one home for the serving-cache discipline shared by the factor-serving
+    engine models (ALS recommendation, two-tower). Subclasses return the
+    (row_factors, col_factors) pair from :meth:`_scorer_factors`."""
+
+    def _scorer_factors(self) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def scorer(self, warmup: bool = False):
+        """Device-resident factor scorer, built once per deploy lifetime
+        (factors upload on first use / at prepare_for_serving and stay on
+        the accelerator; queries ship only integer codes)."""
+        s = self.__dict__.get("_scorer")
+        if s is None:
+            from pio_tpu.ops.topn import DeviceTopNScorer
+
+            rows, cols = self._scorer_factors()
+            s = DeviceTopNScorer(rows, cols, warmup=warmup)
+            self.__dict__["_scorer"] = s
+        return s
+
+    def __getstate__(self):
+        # device handles and jitted closures never serialize
+        d = dict(self.__dict__)
+        d.pop("_scorer", None)
+        return d
+
+
 def eval_app_name(app_name: str) -> str:
     """App for a bundled `pio eval` sweep: the explicit argument, or the
     ``$PIO_TPU_EVAL_APP`` environment fallback for zero-arg CLI use —

@@ -39,11 +39,16 @@ from pio_tpu.controller import (
 from pio_tpu.controller.engine import EngineParams
 from pio_tpu.controller.metrics import OptionAverageMetric
 from pio_tpu.data.bimap import BiMap
-from pio_tpu.models.als import ALSConfig, ALSFactors, top_n, train_als
+from pio_tpu.models.als import ALSConfig, ALSFactors, train_als
 from pio_tpu.parallel.context import ComputeContext
 from pio_tpu.storage import Storage
 from pio_tpu.storage.frame import EventFrame
-from pio_tpu.templates.common import ItemScore, PredictedResult, resolve_app
+from pio_tpu.templates.common import (
+    DeviceScorerModel,
+    ItemScore,
+    PredictedResult,
+    resolve_app,
+)
 
 
 # --------------------------------------------------------------- data source
@@ -196,16 +201,13 @@ class ALSAlgorithmParams(Params):
 
 
 @dataclasses.dataclass
-class ALSModel:
+class ALSModel(DeviceScorerModel):
     factors: ALSFactors
     user_index: BiMap
     item_index: BiMap
 
-    def scores_for_user(self, user: str) -> Optional[np.ndarray]:
-        code = self.user_index.get(user)
-        if code is None:
-            return None
-        return self.factors.user_factors[code] @ self.factors.item_factors.T
+    def _scorer_factors(self):
+        return self.factors.user_factors, self.factors.item_factors
 
 
 class ALSAlgorithm(Algorithm):
@@ -234,46 +236,54 @@ class ALSAlgorithm(Algorithm):
         )
         return ALSModel(factors, pd.user_index, pd.item_index)
 
+    def prepare_for_serving(self, model: ALSModel) -> ALSModel:
+        """Upload the factor matrices to the accelerator once at deploy and
+        pre-compile the single-query bucket (SURVEY.md §7 hard part (d):
+        amortize host↔device transfer across the serving lifetime)."""
+        model.scorer(warmup=True)
+        return model
+
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
-        scores = model.scores_for_user(query.user)
-        if scores is None:
+        code = model.user_index.get(query.user)
+        if code is None:
             return PredictedResult()  # unknown user (parity: empty result)
         if query.item:
-            code = model.item_index.get(query.item)
-            if code is None:
+            icode = model.item_index.get(query.item)
+            if icode is None:
                 return PredictedResult()
-            return PredictedResult(
-                (ItemScore(query.item, float(scores[code])),)
-            )
-        return _top_n_result(scores, query.num, model.item_index)
+            score = model.scorer().score_pairs([code], [icode])[0]
+            return PredictedResult((ItemScore(query.item, float(score)),))
+        if query.num <= 0:
+            return PredictedResult()
+        idx, vals = model.scorer().top_n_batch(
+            np.asarray([code], np.int32), query.num
+        )
+        return _result_from_topn(idx[0], vals[0], model.item_index)
 
     def batch_predict(self, model: ALSModel, queries):
         """Vectorized offline scoring (reference ``batchPredictBase``):
-        known-user top-N queries batch into ONE [B, K] @ [K, N] matmul;
-        unknown users and single-item queries take the per-query path."""
+        known-user top-N queries batch into ONE device dispatch per chunk
+        ([B, K] @ [K, N] matmul + top-k on the accelerator); unknown users
+        and single-item queries take the per-query path."""
         return batched_user_topn(
             self, model, queries, model.user_index, model.item_index,
-            # same math as scores_for_user, batched over the user rows
-            lambda codes: model.factors.user_factors[codes]
-            @ model.factors.item_factors.T,
+            model.scorer(),
         )
 
 
-def _top_n_result(scores, num: int, item_index: BiMap) -> PredictedResult:
-    """Shared top-N → PredictedResult tail for predict and batch_predict
-    (one home, so online and offline scoring cannot diverge)."""
-    idx, vals = top_n(scores, num)
+def _result_from_topn(idx, vals, item_index: BiMap) -> PredictedResult:
+    """(top-n indices, scores) → PredictedResult — the only step that
+    touches host Python: mapping integer codes back to string item ids."""
     inv = item_index.inverse
     return PredictedResult(
         tuple(ItemScore(inv[int(i)], float(v)) for i, v in zip(idx, vals))
     )
 
 
-def batched_user_topn(algo, model, queries, user_index, item_index,
-                      score_batch):
+def batched_user_topn(algo, model, queries, user_index, item_index, scorer):
     """Shared batch_predict routing for user→top-N recommenders (ALS,
-    two-tower): known-user top-N queries batch through ``score_batch``
-    (int codes → [B, n_items] scores); unknown users and single-item
+    two-tower): known-user top-N queries batch through the device scorer
+    (one matmul + top-k dispatch per chunk); unknown users and single-item
     queries fall back to ``algo.predict``."""
     out = []
     bidx, bcodes, bq = [], [], []
@@ -286,9 +296,12 @@ def batched_user_topn(algo, model, queries, user_index, item_index,
             bcodes.append(code)
             bq.append(q)
     if bcodes:
-        scores = score_batch(np.asarray(bcodes))
-        for i, q, row in zip(bidx, bq, scores):
-            out.append((i, _top_n_result(row, q.num, item_index)))
+        kmax = max(q.num for q in bq)
+        idx, vals = scorer.top_n_batch(np.asarray(bcodes, np.int32), kmax)
+        for i, q, ri, rv in zip(bidx, bq, idx, vals):
+            out.append(
+                (i, _result_from_topn(ri[:q.num], rv[:q.num], item_index))
+            )
     return out
 
 

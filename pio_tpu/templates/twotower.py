@@ -45,13 +45,17 @@ from pio_tpu.models.two_tower import (
 )
 from pio_tpu.parallel.context import ComputeContext
 from pio_tpu.parallel.mesh import MeshSpec, build_mesh
-from pio_tpu.templates.common import ItemScore, PredictedResult
+from pio_tpu.templates.common import (
+    DeviceScorerModel,
+    ItemScore,
+    PredictedResult,
+)
 from pio_tpu.templates.recommendation import (
     PreparedData,
     Query,
     RecommendationDataSource,
     RecommendationPreparator,
-    _top_n_result,
+    _result_from_topn,
     batched_user_topn,
 )
 
@@ -71,10 +75,13 @@ class TwoTowerParams(Params):
 
 
 @dataclasses.dataclass
-class TwoTowerEngineModel:
+class TwoTowerEngineModel(DeviceScorerModel):
     model: TwoTowerModel
     user_index: BiMap
     item_index: BiMap
+
+    def _scorer_factors(self):
+        return self.model.user_vectors, self.model.item_vectors
 
 
 class TwoTowerAlgorithm(Algorithm):
@@ -118,32 +125,39 @@ class TwoTowerAlgorithm(Algorithm):
         )
         return TwoTowerEngineModel(model, pd.user_index, pd.item_index)
 
+    def prepare_for_serving(
+        self, model: TwoTowerEngineModel
+    ) -> TwoTowerEngineModel:
+        """Upload both tower-output tables to the accelerator once at
+        deploy and pre-compile the single-query bucket."""
+        model.scorer(warmup=True)
+        return model
+
     def predict(
         self, model: TwoTowerEngineModel, query: Query
     ) -> PredictedResult:
         code = model.user_index.get(query.user)
         if code is None:
             return PredictedResult()  # unknown user → empty (ALS parity)
-        scores = model.model.scores(
-            model.model.user_vectors[code][None]
-        )[0]
         if query.item:
             icode = model.item_index.get(query.item)
             if icode is None:
                 return PredictedResult()
-            return PredictedResult(
-                (ItemScore(query.item, float(scores[icode])),)
-            )
-        return _top_n_result(scores, query.num, model.item_index)
+            score = model.scorer().score_pairs([code], [icode])[0]
+            return PredictedResult((ItemScore(query.item, float(score)),))
+        if query.num <= 0:
+            return PredictedResult()
+        idx, vals = model.scorer().top_n_batch(
+            np.asarray([code], np.int32), query.num
+        )
+        return _result_from_topn(idx[0], vals[0], model.item_index)
 
     def batch_predict(self, model: TwoTowerEngineModel, queries):
-        """Vectorized offline scoring: one tower matmul for every
-        known-user top-N query (shared routing with the ALS template)."""
+        """Vectorized offline scoring: one device dispatch per chunk of
+        known-user top-N queries (shared routing with the ALS template)."""
         return batched_user_topn(
             self, model, queries, model.user_index, model.item_index,
-            lambda codes: model.model.scores(
-                model.model.user_vectors[codes]
-            ),
+            model.scorer(),
         )
 
 

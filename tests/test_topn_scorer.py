@@ -1,0 +1,206 @@
+"""DeviceTopNScorer — device-resident serving scorer (pio_tpu/ops/topn.py).
+
+Device and host paths must agree exactly (same factors, same queries);
+the device path is forced on the simulated CPU backend via prefer_device.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from pio_tpu.ops.topn import DeviceTopNScorer, _bucket
+
+
+def _factors(n_rows=37, n_cols=53, k=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.normal(size=(n_rows, k)).astype(np.float32),
+        rng.normal(size=(n_cols, k)).astype(np.float32),
+    )
+
+
+def test_bucket():
+    assert _bucket(1, 512) == 1
+    assert _bucket(3, 512) == 4
+    assert _bucket(16, 512) == 16
+    assert _bucket(700, 512) == 512
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_topn_matches_naive(device):
+    rows, cols = _factors()
+    s = DeviceTopNScorer(rows, cols, prefer_device=device)
+    codes = np.array([0, 3, 36, 7], np.int32)
+    idx, vals = s.top_n_batch(codes, 5)
+    assert idx.shape == (4, 5) and vals.shape == (4, 5)
+    full = rows[codes] @ cols.T
+    for b in range(4):
+        want = np.argsort(-full[b])[:5]
+        np.testing.assert_array_equal(idx[b], want)
+        np.testing.assert_allclose(vals[b], full[b][want], rtol=1e-5)
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_topn_exclusion(device):
+    rows, cols = _factors()
+    s = DeviceTopNScorer(rows, cols, prefer_device=device)
+    codes = np.array([1, 2], np.int32)
+    full = rows[codes] @ cols.T
+    # exclude each row's natural top-1; pad second row's slots with the
+    # sentinel (>= n_cols)
+    top1 = np.argsort(-full, axis=1)[:, 0]
+    excl = np.stack([
+        [top1[0], int(np.argsort(-full[0])[1])],
+        [top1[1], s.n_cols],  # sentinel slot
+    ]).astype(np.int32)
+    idx, vals = s.top_n_batch(codes, 3, exclude=excl)
+    assert top1[0] not in idx[0]
+    assert int(np.argsort(-full[0])[1]) not in idx[0]
+    assert top1[1] not in idx[1]
+    # row 1 keeps its rank-2 item (only top-1 excluded)
+    assert int(np.argsort(-full[1])[1]) == idx[1][0]
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_large_batch_chunks_and_n_clamp(device):
+    rows, cols = _factors(n_rows=600, n_cols=17)
+    s = DeviceTopNScorer(rows, cols, prefer_device=device)
+    codes = np.arange(600, dtype=np.int32) % 600
+    # n > n_cols clamps to n_cols; B > _MAX_BATCH_BUCKET chunks internally
+    idx, vals = s.top_n_batch(codes, 99)
+    assert idx.shape == (600, 17)
+    full = rows[codes] @ cols.T
+    np.testing.assert_array_equal(idx[123], np.argsort(-full[123]))
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_pairs_and_scores(device):
+    rows, cols = _factors()
+    s = DeviceTopNScorer(rows, cols, prefer_device=device)
+    rc = np.array([0, 5], np.int32)
+    cc = np.array([7, 9], np.int32)
+    np.testing.assert_allclose(
+        s.score_pairs(rc, cc),
+        np.einsum("bk,bk->b", rows[rc], cols[cc]),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        s.scores_batch(rc), rows[rc] @ cols.T, rtol=1e-5
+    )
+
+
+def test_adaptive_routing_by_link_speed():
+    """Auto mode routes by batch size: a slow link sends small batches to
+    the host mirror; a fast link sends everything to the device."""
+    rows, cols = _factors()
+    slow = DeviceTopNScorer(rows, cols, link_rtt_s=10.0)  # tunneled link
+    assert slow.on_device
+    assert slow.min_device_batch > 1_000  # B=1 stays on host
+    assert not slow._route_to_device(1)
+    fast = DeviceTopNScorer(rows, cols, link_rtt_s=0.0)  # local PCIe/ICI
+    assert fast.min_device_batch == 1
+    assert fast._route_to_device(1)
+    # both produce identical results for the same query
+    codes = np.array([4, 9], np.int32)
+    i1, v1 = slow.top_n_batch(codes, 3)
+    i2, v2 = fast.top_n_batch(codes, 3)
+    np.testing.assert_array_equal(i1, i2)
+    np.testing.assert_allclose(v1, v2, rtol=1e-5)
+
+
+def test_env_override_forces_host(monkeypatch):
+    monkeypatch.setenv("PIO_TPU_SERVE_DEVICE", "0")
+    rows, cols = _factors()
+    s = DeviceTopNScorer(rows, cols)
+    assert not s.on_device
+    monkeypatch.setenv("PIO_TPU_SERVE_DEVICE", "1")
+    s = DeviceTopNScorer(rows, cols)
+    assert s.on_device and s.min_device_batch == 1
+
+
+def test_pair_routing_stays_on_host_for_small_batches():
+    """Pair dots are ~n_cols× cheaper than a score row on host, so their
+    device break-even batch is much larger."""
+    rows, cols = _factors()
+    s = DeviceTopNScorer(rows, cols, link_rtt_s=1e-3)
+    assert s.min_pair_batch >= s.min_device_batch
+    np.testing.assert_allclose(
+        s.score_pairs([1], [2]), [float(rows[1] @ cols[2])], rtol=1e-5
+    )
+
+
+def test_predict_num_zero_returns_empty():
+    """query.num <= 0 must yield an empty result on the online path too
+    (parity with the pre-scorer behavior and with batch_predict)."""
+    from pio_tpu.data.bimap import BiMap
+    from pio_tpu.models.als import ALSFactors
+    from pio_tpu.templates.recommendation import ALSAlgorithm, ALSModel, Query
+
+    rows, cols = _factors()
+    m = ALSModel(
+        ALSFactors(rows, cols),
+        BiMap.string_int([f"u{i}" for i in range(len(rows))]),
+        BiMap.string_int([f"i{i}" for i in range(len(cols))]),
+    )
+    algo = ALSAlgorithm(None)
+    assert algo.predict(m, Query(user="u1", num=0)).item_scores == ()
+    assert dict(algo.batch_predict(
+        m, [(0, Query(user="u1", num=0))]
+    ))[0].item_scores == ()
+
+
+def test_empty_batch():
+    rows, cols = _factors()
+    s = DeviceTopNScorer(rows, cols, prefer_device=True)
+    idx, vals = s.top_n_batch(np.empty(0, np.int32), 5)
+    assert idx.shape == (0, 5)
+
+
+def test_rank_mismatch_rejected():
+    rows, cols = _factors()
+    with pytest.raises(ValueError):
+        DeviceTopNScorer(rows, cols[:, :4])
+
+
+def test_model_pickle_drops_scorer():
+    """Deployed models lazily cache a scorer; serialization must drop the
+    device handles (they rebuild on the next host)."""
+    from pio_tpu.data.bimap import BiMap
+    from pio_tpu.models.als import ALSFactors
+    from pio_tpu.templates.recommendation import ALSModel
+
+    rows, cols = _factors()
+    m = ALSModel(
+        ALSFactors(rows, cols),
+        BiMap.string_int([f"u{i}" for i in range(len(rows))]),
+        BiMap.string_int([f"i{i}" for i in range(len(cols))]),
+    )
+    m.scorer(warmup=False)
+    assert "_scorer" in m.__dict__
+    m2 = pickle.loads(pickle.dumps(m))
+    assert "_scorer" not in m2.__dict__
+    # and the revived model still serves
+    idx, vals = m2.scorer().top_n_batch(np.array([0], np.int32), 3)
+    assert idx.shape == (1, 3)
+
+
+def test_prepare_for_serving_attaches_scorer():
+    """Engine.algorithms_with_models runs the deploy-time serving prep."""
+    from pio_tpu.controller.engine import EngineParams
+    from pio_tpu.data.bimap import BiMap
+    from pio_tpu.models.als import ALSFactors
+    from pio_tpu.templates.recommendation import (
+        ALSModel, recommendation_engine,
+    )
+
+    rows, cols = _factors()
+    model = ALSModel(
+        ALSFactors(rows, cols),
+        BiMap.string_int([f"u{i}" for i in range(len(rows))]),
+        BiMap.string_int([f"i{i}" for i in range(len(cols))]),
+    )
+    engine = recommendation_engine()
+    ep = EngineParams(algorithm_params_list=(("als", None),))
+    pairs = engine.algorithms_with_models(ep, [model])
+    assert "_scorer" in pairs[0][1].__dict__
