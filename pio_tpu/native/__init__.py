@@ -136,5 +136,10 @@ def als_pack_lib():
             ctypes.c_int32, i64p, ctypes.c_int64, i32p, i32p, f32p,
         ]
         lib.als_pack_fill.restype = ctypes.c_int
+        lib.als_sort_by_entity.argtypes = [
+            i32p, i32p, f32p, ctypes.c_int64, ctypes.c_int32, i64p,
+            i32p, f32p,
+        ]
+        lib.als_sort_by_entity.restype = ctypes.c_int
         _cache["als_pack"] = lib
         return lib

@@ -233,9 +233,12 @@ class DeviceTopNScorer:
             pad = bb - chunk.shape[0]
             cp = np.pad(chunk, (0, pad))
             if exclude is not None:
+                # bucket the exclusion width too — every distinct raw E
+                # would otherwise trigger a fresh XLA compile per request
+                E = exclude.shape[1]
                 ep = np.pad(
                     exclude[lo:lo + _MAX_BATCH_BUCKET],
-                    ((0, pad), (0, 0)),
+                    ((0, pad), (0, _bucket(max(E, 1), 1 << 30) - E)),
                     constant_values=self.n_cols,  # OOB sentinel → dropped
                 )
                 vals, idx = _topn_fn(k, True)(
@@ -297,12 +300,18 @@ class DeviceTopNScorer:
         return self._top_n_host(codes, n, exclude)
 
     def scores_batch(self, codes: np.ndarray) -> np.ndarray:
-        """Full ``[B, n_cols]`` score matrix (host numpy out)."""
+        """Full ``[B, n_cols]`` score matrix (host numpy out).
+
+        Unlike top-N, the result is B × n_cols floats back over the link —
+        on a slow link that payload, not the matmul, dominates, so the
+        device route is taken only when the link probe found it effectively
+        free (min_device_batch == 1, i.e. a local device or forced mode).
+        """
         import jax
 
         codes = np.asarray(codes, np.int32)
         B = codes.shape[0]
-        if not self._route_to_device(B):
+        if B == 0 or self.min_device_batch > 1 or not self.on_device:
             return self._rows_np[codes] @ self._cols_np.T
         out = np.empty((B, self.n_cols), np.float32)
         for lo in range(0, B, _MAX_BATCH_BUCKET):
@@ -328,11 +337,15 @@ class DeviceTopNScorer:
             )
         import jax
 
-        # pairs are cheap — one bucketed dispatch, no chunk loop needed
-        bb = _bucket(B, 1 << 20)
-        pad = bb - B
-        out = jax.device_get(_pairs_fn()(
-            self._rows_dev, self._cols_dev,
-            np.pad(rc, (0, pad)), np.pad(cc, (0, pad)),
-        ))
-        return np.asarray(out[:B])
+        chunk_cap = 1 << 20
+        out = np.empty(B, np.float32)
+        for lo in range(0, B, chunk_cap):
+            rcc, ccc = rc[lo:lo + chunk_cap], cc[lo:lo + chunk_cap]
+            bb = _bucket(rcc.shape[0], chunk_cap)
+            pad = bb - rcc.shape[0]
+            got = jax.device_get(_pairs_fn()(
+                self._rows_dev, self._cols_dev,
+                np.pad(rcc, (0, pad)), np.pad(ccc, (0, pad)),
+            ))
+            out[lo:lo + rcc.shape[0]] = got[: rcc.shape[0]]
+        return out

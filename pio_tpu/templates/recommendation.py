@@ -22,7 +22,7 @@ Query ``{"user": "u1", "num": 4}`` →
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 
@@ -244,21 +244,9 @@ class ALSAlgorithm(Algorithm):
         return model
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
-        code = model.user_index.get(query.user)
-        if code is None:
-            return PredictedResult()  # unknown user (parity: empty result)
-        if query.item:
-            icode = model.item_index.get(query.item)
-            if icode is None:
-                return PredictedResult()
-            score = model.scorer().score_pairs([code], [icode])[0]
-            return PredictedResult((ItemScore(query.item, float(score)),))
-        if query.num <= 0:
-            return PredictedResult()
-        idx, vals = model.scorer().top_n_batch(
-            np.asarray([code], np.int32), query.num
+        return predict_user_topn(
+            model, query, model.user_index, model.item_index
         )
-        return _result_from_topn(idx[0], vals[0], model.item_index)
 
     def batch_predict(self, model: ALSModel, queries):
         """Vectorized offline scoring (reference ``batchPredictBase``):
@@ -280,6 +268,29 @@ def _result_from_topn(idx, vals, item_index: BiMap) -> PredictedResult:
     )
 
 
+def predict_user_topn(model, query, user_index: BiMap,
+                      item_index: BiMap) -> PredictedResult:
+    """Shared online predict for user→top-N recommenders (ALS, two-tower):
+    one home for the unknown-user guard, the single-item pair branch, the
+    num<=0 guard, and the scorer dispatch — so the two templates (and the
+    batched path below) cannot diverge. ``model`` is any DeviceScorerModel."""
+    code = user_index.get(query.user)
+    if code is None:
+        return PredictedResult()  # unknown user (parity: empty result)
+    if query.item:
+        icode = item_index.get(query.item)
+        if icode is None:
+            return PredictedResult()
+        score = model.scorer().score_pairs([code], [icode])[0]
+        return PredictedResult((ItemScore(query.item, float(score)),))
+    if query.num <= 0:
+        return PredictedResult()
+    idx, vals = model.scorer().top_n_batch(
+        np.asarray([code], np.int32), query.num
+    )
+    return _result_from_topn(idx[0], vals[0], item_index)
+
+
 def batched_user_topn(algo, model, queries, user_index, item_index, scorer):
     """Shared batch_predict routing for user→top-N recommenders (ALS,
     two-tower): known-user top-N queries batch through the device scorer
@@ -291,6 +302,10 @@ def batched_user_topn(algo, model, queries, user_index, item_index, scorer):
         code = user_index.get(q.user)
         if code is None or q.item:
             out.append((i, algo.predict(model, q)))
+        elif q.num <= 0:
+            # same empty-result contract as predict_user_topn (a negative
+            # num must not slice kmax+num items off the batched result)
+            out.append((i, PredictedResult()))
         else:
             bidx.append(i)
             bcodes.append(code)

@@ -26,9 +26,6 @@ code changes.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
-
-import numpy as np
 
 from pio_tpu.controller import (
     Algorithm,
@@ -45,18 +42,14 @@ from pio_tpu.models.two_tower import (
 )
 from pio_tpu.parallel.context import ComputeContext
 from pio_tpu.parallel.mesh import MeshSpec, build_mesh
-from pio_tpu.templates.common import (
-    DeviceScorerModel,
-    ItemScore,
-    PredictedResult,
-)
+from pio_tpu.templates.common import DeviceScorerModel, PredictedResult
 from pio_tpu.templates.recommendation import (
     PreparedData,
     Query,
     RecommendationDataSource,
     RecommendationPreparator,
-    _result_from_topn,
     batched_user_topn,
+    predict_user_topn,
 )
 
 
@@ -136,21 +129,9 @@ class TwoTowerAlgorithm(Algorithm):
     def predict(
         self, model: TwoTowerEngineModel, query: Query
     ) -> PredictedResult:
-        code = model.user_index.get(query.user)
-        if code is None:
-            return PredictedResult()  # unknown user → empty (ALS parity)
-        if query.item:
-            icode = model.item_index.get(query.item)
-            if icode is None:
-                return PredictedResult()
-            score = model.scorer().score_pairs([code], [icode])[0]
-            return PredictedResult((ItemScore(query.item, float(score)),))
-        if query.num <= 0:
-            return PredictedResult()
-        idx, vals = model.scorer().top_n_batch(
-            np.asarray([code], np.int32), query.num
+        return predict_user_topn(
+            model, query, model.user_index, model.item_index
         )
-        return _result_from_topn(idx[0], vals[0], model.item_index)
 
     def batch_predict(self, model: TwoTowerEngineModel, queries):
         """Vectorized offline scoring: one device dispatch per chunk of

@@ -123,6 +123,63 @@ class TestALS:
         assert (bo.reshape(S, W) == ref[1]).all()
         assert (br.reshape(S, W) == ref[2]).all()
 
+    def test_native_sort_by_entity_matches_numpy(self):
+        """C++ counting sort (the counts wire-format producer) must match
+        numpy's stable argsort exactly."""
+        from pio_tpu.models.als import (
+            _f32p, _i32p, _i64p, _native_packer,
+        )
+
+        native = _native_packer()
+        if native is None:
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(5)
+        E, N = 40_000, 321
+        ent = rng.integers(0, N, E).astype(np.int32)
+        other = rng.integers(0, 7777, E).astype(np.int32)
+        rat = rng.random(E).astype(np.float32)
+        counts = np.zeros(N, np.int64)
+        native.als_pack_count(_i32p(ent), E, N, 16, _i64p(counts))
+        o_sorted = np.empty(E, np.int32)
+        r_sorted = np.empty(E, np.float32)
+        native.als_sort_by_entity(
+            _i32p(ent), _i32p(other), _f32p(rat), E, N, _i64p(counts),
+            _i32p(o_sorted), _f32p(r_sorted),
+        )
+        order = np.argsort(ent, kind="stable")
+        assert (o_sorted == other[order]).all()
+        assert (r_sorted == rat[order]).all()
+
+    def test_native_and_numpy_paths_agree_bitwise(self, synthetic,
+                                                  monkeypatch):
+        """Single-device training must not depend on which host packer
+        produced the wire format (same stable edge order → same floats)."""
+        s = synthetic
+        f1 = train_als(
+            ComputeContext.local(), s["u"], s["i"], s["r"], s["U"], s["I"],
+            CFG,
+        )
+        monkeypatch.setenv("PIO_TPU_NO_NATIVE", "1")
+        f2 = train_als(
+            ComputeContext.local(), s["u"], s["i"], s["r"], s["U"], s["I"],
+            CFG,
+        )
+        assert (f1.user_factors == f2.user_factors).all()
+        assert (f1.item_factors == f2.item_factors).all()
+
+    def test_non_grid_ratings_train(self):
+        """Ratings off the uint8/fp16 grids ride the f32 wire fallback."""
+        rng = np.random.default_rng(3)
+        E = 400
+        u = rng.integers(0, 30, E).astype(np.int32)
+        i = rng.integers(0, 20, E).astype(np.int32)
+        r = (rng.random(E) * 3.7 + 0.123).astype(np.float32)  # not fp16-exact
+        f = train_als(ComputeContext.local(), u, i, r, 30, 20,
+                      ALSConfig(rank=4, iterations=3, reg=0.05))
+        assert np.isfinite(f.user_factors).all()
+        pred = (f.user_factors[u] * f.item_factors[i]).sum(1)
+        assert np.sqrt(np.mean((pred - r) ** 2)) < 1.0
+
     def test_device_pack_matches_host_packers(self):
         """The on-device packer must be bit-identical to the host layout
         (the trainer's correctness rides on ascending block_ent for

@@ -150,6 +150,56 @@ def test_predict_num_zero_returns_empty():
     ))[0].item_scores == ()
 
 
+def test_pairs_beyond_chunk_cap():
+    """score_pairs must chunk, not crash, past the 2^20 dispatch cap."""
+    rows, cols = _factors(n_rows=50, n_cols=60)
+    s = DeviceTopNScorer(rows, cols, prefer_device=True)
+    rng = np.random.default_rng(1)
+    B = (1 << 20) + 3
+    rc = rng.integers(0, 50, B).astype(np.int32)
+    cc = rng.integers(0, 60, B).astype(np.int32)
+    got = s.score_pairs(rc, cc)
+    assert got.shape == (B,)
+    np.testing.assert_allclose(
+        got[-5:], np.einsum("bk,bk->b", rows[rc[-5:]], cols[cc[-5:]]),
+        rtol=1e-5,
+    )
+
+
+def test_exclusion_widths_share_compiles():
+    """Exclusion width is bucketed: different raw E values give the same
+    (correct) answer and reuse pow-2-bucketed jitted shapes."""
+    rows, cols = _factors()
+    s = DeviceTopNScorer(rows, cols, prefer_device=True)
+    codes = np.array([3], np.int32)
+    full = rows[3] @ cols.T
+    top = np.argsort(-full)
+    for E in (1, 2, 3, 5, 9):
+        excl = np.array([top[:E]], np.int32)
+        idx, _ = s.top_n_batch(codes, 3, exclude=excl)
+        np.testing.assert_array_equal(idx[0], top[E:E + 3])
+
+
+def test_batch_negative_num_matches_online():
+    """num <= 0 gives an empty result on BOTH serving paths."""
+    from pio_tpu.data.bimap import BiMap
+    from pio_tpu.models.als import ALSFactors
+    from pio_tpu.templates.recommendation import ALSAlgorithm, ALSModel, Query
+
+    rows, cols = _factors()
+    m = ALSModel(
+        ALSFactors(rows, cols),
+        BiMap.string_int([f"u{i}" for i in range(len(rows))]),
+        BiMap.string_int([f"i{i}" for i in range(len(cols))]),
+    )
+    algo = ALSAlgorithm(None)
+    q = Query(user="u2", num=-1)
+    assert algo.predict(m, q).item_scores == ()
+    got = dict(algo.batch_predict(m, [(0, Query(user="u1", num=5)), (1, q)]))
+    assert got[1].item_scores == ()
+    assert len(got[0].item_scores) == 5
+
+
 def test_empty_batch():
     rows, cols = _factors()
     s = DeviceTopNScorer(rows, cols, prefer_device=True)
