@@ -1,18 +1,34 @@
-"""Benchmark harness — headline metric from BASELINE.json.
+"""Benchmark harness — all five BASELINE.json configs.
 
-Metric: examples/sec/chip on the Recommendation (ALS) template at
-MovieLens-25M scale (25M ratings, 162,541 users, 59,047 items). One
-"example" = one rating edge processed through one full ALS iteration
-(both half-steps). The reference publishes no numbers (BASELINE.md), so
-``vs_baseline`` is measured against our own single-host XLA-CPU run of the
-same program — the "Spark-free CPU ALS reference anchor" from SURVEY.md §6.
+Headline metric (unchanged since round 1): examples/sec/chip on the
+Recommendation (ALS) template at MovieLens-25M scale (25M ratings,
+162,541 users, 59,047 items). One "example" = one rating edge processed
+through one full ALS iteration (both half-steps). The reference publishes
+no numbers (BASELINE.md), so ``vs_baseline`` is measured against our own
+single-host XLA-CPU run of the same program — the "Spark-free CPU ALS
+reference anchor" from SURVEY.md §6.
+
+``p50_predict_ms`` is measured THROUGH A LIVE QUERY SERVER: the trained
+headline model is persisted to the real storage stack, deployed behind
+``create_query_server``, and timed over HTTP ``POST /queries.json`` —
+JSON binding, plugin hooks, serving.serve and the device scorer all
+included. ``p50_inproc_ms`` keeps the round-1 in-process number for
+continuity.
+
+``secondary`` covers the remaining BASELINE.json configs:
+  - classification      LogReg SGD (treeAggregate → psum all-reduce)
+  - similarproduct      implicit ALS (MLlib trainImplicit analog)
+  - textclassification  Pallas embedding-bag vs plain-XLA lowering
+  - twotower            contrastive two-tower retrieval training
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-     "p50_predict_ms": N}   # last field: serving-path p50 (auxiliary)
+     "p50_predict_ms": N, "p50_inproc_ms": N, "secondary": {...}}
 
 Env knobs (for smoke runs): PIO_TPU_BENCH_EDGES, PIO_TPU_BENCH_ITERS,
-PIO_TPU_BENCH_RANK, PIO_TPU_BENCH_CPU_EDGES.
+PIO_TPU_BENCH_RANK, PIO_TPU_BENCH_CPU_EDGES, PIO_TPU_BENCH_QUERIES,
+PIO_TPU_BENCH_SECONDARY=0 (skip the secondary block),
+PIO_TPU_BENCH_SCALE (0<s≤1 scales every secondary workload).
 """
 
 from __future__ import annotations
@@ -20,6 +36,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -40,45 +57,252 @@ def _synth_ratings(n_edges: int, n_users: int, n_items: int, seed: int = 0):
     return user_idx, item_idx, rating
 
 
-def _time_train(ctx, u, i, r, n_users, n_items, cfg, repeats=3):
+def _best_of(fn, repeats=3):
     """Warmup/compile once, then best-of-``repeats`` timed runs (the
     host↔device link shares a tunnel whose bandwidth fluctuates run to
-    run; min time is the stable throughput estimate).
-
-    Returns (seconds, trained factors) — the factors feed the serving
-    latency measurement.
-    """
-    from pio_tpu.models.als import train_als
-
-    train_als(ctx, u, i, r, n_users, n_items, cfg)  # warmup/compile
-    best, factors = None, None
+    run; min time is the stable throughput estimate). Returns
+    (seconds, last result)."""
+    fn()  # warmup/compile
+    best, out = None, None
     for _ in range(repeats):
         t0 = time.perf_counter()
-        factors = train_als(ctx, u, i, r, n_users, n_items, cfg)
+        out = fn()
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
-    return best, factors
+    return best, out
 
 
-def _predict_p50_ms(factors, n_users: int, n_queries: int = 300) -> float:
-    """p50 of the serving hot path (BASELINE.md's second tracked metric):
-    one user row against the full item-factor matrix + top-10, exactly
-    what Query-server POST /queries.json does per request."""
-    from pio_tpu.models.als import predict_scores, top_n
+# --------------------------------------------------------------- headline
+def _time_train(ctx, u, i, r, n_users, n_items, cfg, repeats=4):
+    """repeats=4 on the headline: the tunneled link's bandwidth swings
+    ~2.5× between runs and the edge shipment is the dominant term, so more
+    samples of min() materially stabilize the reported rate."""
+    from pio_tpu.models.als import train_als
 
+    return _best_of(
+        lambda: train_als(ctx, u, i, r, n_users, n_items, cfg), repeats
+    )
+
+
+def _predict_p50_inproc_ms(factors, n_users: int, n_queries: int) -> float:
+    """Round-1 continuity metric: the serving math in-process (no HTTP).
+    Uses the same adaptive scorer the server uses."""
+    from pio_tpu.ops.topn import DeviceTopNScorer
+
+    scorer = DeviceTopNScorer(
+        factors.user_factors, factors.item_factors, warmup=True
+    )
     lat = []
     for q in range(n_queries):
-        user = (q * 7919) % n_users
+        user = np.asarray([(q * 7919) % n_users], np.int32)
         t0 = time.perf_counter()
-        scores = predict_scores(
-            factors.user_factors, factors.item_factors, user
-        )
-        top_n(scores, 10)
+        scorer.top_n_batch(user, 10)
         lat.append(time.perf_counter() - t0)
     return float(np.percentile(np.array(lat) * 1000.0, 50))
 
 
+# ------------------------------------------------- through-server serving
+def _bench_server_p50(factors, n_users: int, n_items: int,
+                      n_queries: int) -> float:
+    """Deploy the trained factors behind a real query server (storage
+    round trip included) and report HTTP POST /queries.json p50 in ms."""
+    import socket
+    import urllib.request
+
+    from pio_tpu.controller import (
+        Algorithm, DataSource, Engine, FirstServing, IdentityPreparator,
+        register_engine,
+    )
+    from pio_tpu.controller.engine import EngineParams
+    from pio_tpu.controller.params import EmptyParams
+    from pio_tpu.data.bimap import BiMap
+    from pio_tpu.server.query_server import create_query_server
+    from pio_tpu.templates.recommendation import ALSModel, Query
+    from pio_tpu.workflow.core_workflow import run_train
+    from pio_tpu.workflow.engine_json import variant_from_dict
+
+    class BenchDataSource(DataSource):
+        def read_training(self, ctx):
+            return None
+
+    class BenchServeAlgorithm(Algorithm):
+        """Serves the pre-trained headline factors (train wraps, not fits —
+        the server benchmark measures serving, not a second training)."""
+
+        query_class = Query
+
+        def train(self, ctx, pd):
+            return ALSModel(
+                factors,
+                BiMap({f"u{i}": i for i in range(n_users)}),
+                BiMap({f"i{i}": i for i in range(n_items)}),
+            )
+
+        def predict(self, model, query):
+            from pio_tpu.templates.recommendation import predict_user_topn
+
+            return predict_user_topn(
+                model, query, model.user_index, model.item_index
+            )
+
+        def prepare_for_serving(self, model):
+            model.scorer(warmup=True)
+            return model
+
+    register_engine("bench.recommendation")(
+        lambda: Engine(
+            BenchDataSource, IdentityPreparator,
+            {"als": BenchServeAlgorithm}, FirstServing,
+        )
+    )
+    variant = variant_from_dict({
+        "id": "bench-recommendation",
+        "version": "1",
+        "engineFactory": "bench.recommendation",
+        "algorithms": [{"name": "als", "params": {}}],
+    })
+    engine_params = EngineParams(
+        algorithm_params_list=(("als", EmptyParams()),)
+    )
+    from pio_tpu.workflow.engine_json import build_engine
+
+    engine, _ = build_engine(variant)
+    run_train(engine, engine_params, variant)
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server, _service = create_query_server(
+        variant, host="127.0.0.1", port=port
+    )
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{port}/queries.json"
+
+        def post(body):
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+
+        got = post({"user": "u1", "num": 10})  # warm (compile + route)
+        assert got.get("itemScores"), got
+        lat = []
+        for q in range(n_queries):
+            body = {"user": f"u{(q * 7919) % n_users}", "num": 10}
+            t0 = time.perf_counter()
+            post(body)
+            lat.append(time.perf_counter() - t0)
+        return float(np.percentile(np.array(lat) * 1000.0, 50))
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------- secondary
+def _bench_classification(ctx, scale: float) -> float:
+    """BASELINE config #2: LogReg (treeAggregate ≡ psum all-reduce).
+    examples/sec = rows touched per optimizer iteration × iterations."""
+    from pio_tpu.models.logreg import LogRegConfig, train_logreg
+
+    n, d, c = int(100_000 * scale), 256, 10
+    iters = 30
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d, c))
+    y = np.argmax(X @ w_true, axis=1).astype(np.int32)
+    cfg = LogRegConfig(iterations=iters, learning_rate=0.05)
+    dt, _ = _best_of(
+        lambda: train_logreg(ctx, X, y, c, cfg), repeats=2
+    )
+    return n * iters / dt
+
+
+def _bench_similarproduct(ctx, scale: float) -> float:
+    """BASELINE config #3: implicit ALS (MLlib trainImplicit analog)."""
+    from pio_tpu.models.als import ALSConfig, train_als
+
+    n_edges = int(5_000_000 * scale)
+    n_users, n_items = int(50_000 * scale) + 64, int(20_000 * scale) + 64
+    iters = 3
+    rng = np.random.default_rng(2)
+    u = rng.integers(0, n_users, n_edges).astype(np.int32)
+    i = (rng.random(n_edges) ** 2 * n_items).astype(np.int32)
+    r = np.ones(n_edges, np.float32)
+    cfg = ALSConfig(rank=16, iterations=iters, reg=0.1, implicit=True,
+                    alpha=40.0)
+    dt, _ = _best_of(
+        lambda: train_als(ctx, u, i, r, n_users, n_items, cfg), repeats=2
+    )
+    return n_edges * iters / dt
+
+
+def _bench_textclass(scale: float) -> dict:
+    """BASELINE config #4: the embedding-bag hot op — Pallas kernel vs the
+    plain-XLA gather+einsum lowering, tokens/sec (B·L per call)."""
+    import jax
+
+    from pio_tpu.ops.embedding import (
+        _embedding_bag_pallas, _embedding_bag_xla, _use_pallas,
+    )
+
+    V, D = 50_000, 256
+    B, L = int(4096 * scale) or 8, 64
+    rng = np.random.default_rng(3)
+    table = jax.device_put(rng.normal(size=(V, D)).astype(np.float32))
+    ids = jax.device_put(rng.integers(0, V, (B, L)).astype(np.int32))
+    w = jax.device_put(rng.random((B, L)).astype(np.float32))
+    tokens = B * L
+
+    def timed(fn):
+        jf = jax.jit(fn)
+        dt, _ = _best_of(
+            lambda: jax.block_until_ready(jf(table, ids, w)), repeats=3
+        )
+        return tokens / dt
+
+    out = {"xla_tokens_per_sec": round(timed(_embedding_bag_xla), 1)}
+    if _use_pallas(table):
+        out["pallas_tokens_per_sec"] = round(
+            timed(_embedding_bag_pallas), 1
+        )
+        out["pallas_speedup"] = round(
+            out["pallas_tokens_per_sec"] / out["xla_tokens_per_sec"], 3
+        )
+    return out
+
+
+def _bench_twotower(ctx, scale: float) -> float:
+    """BASELINE config #5: two-tower retrieval training, examples/sec
+    (one example = one positive pair through a contrastive step)."""
+    from pio_tpu.models.two_tower import TwoTowerConfig, train_two_tower
+    from pio_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    n_pairs = int(500_000 * scale)
+    n_users, n_items = int(100_000 * scale) + 64, int(50_000 * scale) + 64
+    steps, batch = 30, 4096
+    rng = np.random.default_rng(4)
+    u = rng.integers(0, n_users, n_pairs).astype(np.int32)
+    i = rng.integers(0, n_items, n_pairs).astype(np.int32)
+    cfg = TwoTowerConfig(embed_dim=64, hidden=128, out_dim=64, steps=steps,
+                         batch_size=batch)
+    mesh = build_mesh(  # the tower shardings need a model axis too
+        MeshSpec(data=-1, model=1), devices=list(ctx.mesh.devices.flat)
+    )
+    dt, _ = _best_of(
+        lambda: train_two_tower(mesh, u, i, n_users, n_items, cfg),
+        repeats=2,
+    )
+    return steps * batch / dt
+
+
 def main() -> None:
+    # isolate the serving benchmark's storage in a throwaway home (must be
+    # set before the first Storage touch; always overridden — bench junk
+    # must never land in a real deployment home)
+    os.environ["PIO_TPU_HOME"] = tempfile.mkdtemp(prefix="pio_tpu_bench_")
     import jax
 
     from pio_tpu.models.als import ALSConfig
@@ -90,6 +314,7 @@ def main() -> None:
     n_items = max(64, int(ML25M_ITEMS * min(scale, 1.0)))
     iters = int(os.environ.get("PIO_TPU_BENCH_ITERS", 3))
     rank = int(os.environ.get("PIO_TPU_BENCH_RANK", 16))
+    n_queries = int(os.environ.get("PIO_TPU_BENCH_QUERIES", 200))
     cfg = ALSConfig(rank=rank, iterations=iters, reg=0.1)
 
     u, i, r = _synth_ratings(n_edges, n_users, n_items)
@@ -99,7 +324,13 @@ def main() -> None:
     ctx = ComputeContext(mesh=default_mesh(("data",), devices=devices))
     dt, factors = _time_train(ctx, u, i, r, n_users, n_items, cfg)
     rate_per_chip = n_edges * iters / dt / n_chips
-    p50_ms = _predict_p50_ms(factors, n_users)
+    p50_inproc = _predict_p50_inproc_ms(factors, n_users, n_queries)
+    try:
+        p50_server = _bench_server_p50(factors, n_users, n_items, n_queries)
+    except Exception as exc:  # the headline number must survive a serving
+        # stack failure; report the hole rather than crash
+        print(f"# server p50 failed: {exc}", file=sys.stderr)
+        p50_server = None
 
     # CPU anchor: same XLA program, single host CPU device, subsampled edges.
     cpu_edges = int(os.environ.get("PIO_TPU_BENCH_CPU_EDGES",
@@ -119,15 +350,40 @@ def main() -> None:
     except Exception as exc:  # pragma: no cover - CPU backend always present
         print(f"# cpu anchor failed: {exc}", file=sys.stderr)
 
+    secondary = {}
+    if os.environ.get("PIO_TPU_BENCH_SECONDARY", "1") != "0":
+        sscale = float(os.environ.get("PIO_TPU_BENCH_SCALE", "1"))
+        for name, fn in (
+            ("classification_examples_per_sec",
+             lambda: _bench_classification(ctx, sscale)),
+            ("similarproduct_examples_per_sec",
+             lambda: _bench_similarproduct(ctx, sscale)),
+            ("textclassification",
+             lambda: _bench_textclass(sscale)),
+            ("twotower_examples_per_sec",
+             lambda: _bench_twotower(ctx, sscale)),
+        ):
+            try:
+                v = fn()
+                secondary[name] = round(v, 1) if isinstance(v, float) else v
+            except Exception as exc:
+                print(f"# secondary {name} failed: {exc}", file=sys.stderr)
+
     vs_baseline = rate_per_chip / cpu_rate if cpu_rate else 1.0
-    print(json.dumps({
+    out = {
         "metric": "ALS@MovieLens-25M examples/sec/chip",
         "value": round(rate_per_chip, 1),
         "unit": "examples/sec/chip",
         "vs_baseline": round(vs_baseline, 2),
-        # BASELINE.md's second tracked metric, as an auxiliary field
-        "p50_predict_ms": round(p50_ms, 3),
-    }))
+        # BASELINE.md's second tracked metric: serving p50 through a LIVE
+        # query server (HTTP); p50_inproc_ms is the round-1 continuity number
+        "p50_predict_ms": (
+            round(p50_server, 3) if p50_server is not None else None
+        ),
+        "p50_inproc_ms": round(p50_inproc, 3),
+        "secondary": secondary,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -152,6 +152,109 @@ def _contrastive_loss(user_p, item_p, uids, iids, cfg, d_axis, m_axis):
     return loss / total
 
 
+@dataclasses.dataclass(frozen=True)
+class _TTTrainer:
+    """Cached jitted pieces of one (mesh, static-config) two-tower setup."""
+
+    place: "callable"  # (params, uids, iids) → sharded device trees
+    chunk: "callable"  # (state, uids_d, iids_d, n static) → state
+    tx_init: "callable"
+    vectors: "callable"  # (tower_params, vocab static) → [vocab, D]
+
+
+@functools.lru_cache(maxsize=32)
+def _build_tt_trainer(mesh, cfg: TwoTowerConfig, n_batches: int,
+                      batch: int) -> _TTTrainer:
+    """One compiled trainer per (mesh, shape-static config) — the
+    als._build_trainer discipline, so bench repeats / eval sweeps /
+    retrains don't pay XLA again."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    d_axis = "data" if mesh is not None else None
+    m_axis = "model" if mesh is not None else None
+    tx = optax.adam(cfg.learning_rate)
+    specs = {"user": _tower_specs(), "item": _tower_specs()}
+
+    def global_loss(params, ub, ib):
+        if mesh is None:
+            return _contrastive_loss(
+                params["user"], params["item"], ub, ib, cfg, None, None
+            )
+
+        def inner(user_p, item_p, ub, ib):
+            return _contrastive_loss(
+                user_p, item_p, ub, ib, cfg, d_axis, m_axis
+            )
+
+        return shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(specs["user"], specs["item"], P("data"), P("data")),
+            out_specs=P(),
+            check_vma=False,
+        )(params["user"], params["item"], ub, ib)
+
+    def place(params, uids, iids):
+        if mesh is None:
+            return params, jnp.asarray(uids), jnp.asarray(iids)
+        param_shardings = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            specs,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+        )
+        params = jax.tree.map(jax.device_put, params, param_shardings)
+        data_sh = NamedSharding(mesh, P(None))
+        return (
+            params,
+            jax.device_put(jnp.asarray(uids), data_sh),
+            jax.device_put(jnp.asarray(iids), data_sh),
+        )
+
+    @functools.partial(jax.jit, static_argnums=3)
+    def chunk(state, uids_d, iids_d, n):
+        step0, params, opt_state = state
+
+        def step(carry, i):
+            params, opt_state = carry
+            start = ((step0 + i) % n_batches) * batch
+            ub = jax.lax.dynamic_slice_in_dim(uids_d, start, batch)
+            ib = jax.lax.dynamic_slice_in_dim(iids_d, start, batch)
+            loss, grads = jax.value_and_grad(global_loss)(params, ub, ib)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, opt_state), _ = jax.lax.scan(
+            step, (params, opt_state), jnp.arange(n)
+        )
+        return step0 + n, params, opt_state
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def vectors(tower_params, vocab):
+        all_ids = jnp.arange(vocab)
+        if mesh is None:
+            return _tower_forward(tower_params, all_ids, None)
+
+        def inner(tp, ids):
+            return _tower_forward(tp, ids, m_axis)
+
+        return shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(_tower_specs(), P("data")),
+            out_specs=P("data"),
+            check_vma=False,
+        )(tower_params, all_ids)
+
+    return _TTTrainer(
+        place=place, chunk=chunk, tx_init=jax.jit(tx.init),
+        vectors=vectors,
+    )
+
+
 def train_two_tower(
     mesh,
     user_ids: np.ndarray,
@@ -174,15 +277,10 @@ def train_two_tower(
     """
     import jax
     import jax.numpy as jnp
-    import optax
-    from jax import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     cfg = config
     n_data = mesh_axis_size(mesh, "data")
     n_model = mesh_axis_size(mesh, "model")
-    d_axis = "data" if mesh is not None else None
-    m_axis = "model" if mesh is not None else None
 
     # vocab rounded up so tables shard evenly; batch to a data multiple
     vu = _round_up(max(n_users, 1), n_model)
@@ -200,67 +298,26 @@ def train_two_tower(
     iids = np.resize(iids, reps)
     n_batches = reps // batch
 
+    # jitted trainer cached per (mesh, static config) — repeated calls
+    # (bench repeats, eval sweeps, serving retrains) recompile only on
+    # shape changes (the als._build_trainer discipline). seed/steps/
+    # batch_size are zeroed in the key: they don't shape the program.
+    tt = _build_tt_trainer(
+        mesh,
+        dataclasses.replace(cfg, steps=0, seed=0, batch_size=0),
+        n_batches, batch,
+    )
+
     ku, ki = jax.random.split(jax.random.PRNGKey(cfg.seed))
     params = {
         "user": _init_tower(ku, vu, cfg),
         "item": _init_tower(ki, vi, cfg),
     }
     params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
-    tx = optax.adam(cfg.learning_rate)
+    params, uids_d, iids_d = tt.place(params, uids, iids)
 
-    specs = {"user": _tower_specs(), "item": _tower_specs()}
-
-    def global_loss(params, ub, ib):
-        if mesh is None:
-            return _contrastive_loss(
-                params["user"], params["item"], ub, ib, cfg, None, None
-            )
-
-        def inner(user_p, item_p, ub, ib):
-            return _contrastive_loss(
-                user_p, item_p, ub, ib, cfg, d_axis, m_axis
-            )
-
-        return shard_map(
-            inner,
-            mesh=mesh,
-            in_specs=(specs["user"], specs["item"], P("data"), P("data")),
-            out_specs=P(),
-            check_vma=False,
-        )(params["user"], params["item"], ub, ib)
-
-    if mesh is not None:
-        param_shardings = jax.tree.map(
-            lambda spec: NamedSharding(mesh, spec),
-            specs,
-            is_leaf=lambda x: isinstance(
-                x, jax.sharding.PartitionSpec
-            ),
-        )
-        params = jax.tree.map(jax.device_put, params, param_shardings)
-        data_sh = NamedSharding(mesh, P(None))
-        uids_d = jax.device_put(jnp.asarray(uids), data_sh)
-        iids_d = jax.device_put(jnp.asarray(iids), data_sh)
-    else:
-        uids_d, iids_d = jnp.asarray(uids), jnp.asarray(iids)
-
-    @functools.partial(jax.jit, static_argnums=1)
     def chunk_fn(state, n):
-        step0, params, opt_state = state
-
-        def step(carry, i):
-            params, opt_state = carry
-            start = ((step0 + i) % n_batches) * batch
-            ub = jax.lax.dynamic_slice_in_dim(uids_d, start, batch)
-            ib = jax.lax.dynamic_slice_in_dim(iids_d, start, batch)
-            loss, grads = jax.value_and_grad(global_loss)(params, ub, ib)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            return (optax.apply_updates(params, updates), opt_state), loss
-
-        (params, opt_state), _ = jax.lax.scan(
-            step, (params, opt_state), jnp.arange(n)
-        )
-        return step0 + n, params, opt_state
+        return tt.chunk(state, uids_d, iids_d, n)
 
     from pio_tpu.workflow.checkpoint import (
         run_chunked_steps,
@@ -273,7 +330,7 @@ def train_two_tower(
         "two_tower", dataclasses.replace(cfg, steps=0), n_users, n_items,
         reps, int(uids.sum()), int(iids.sum()),
     )
-    state = (jnp.int32(0), params, jax.jit(tx.init)(params))
+    state = (jnp.int32(0), params, tt.tx_init(params))
     state = run_chunked_steps(
         state, cfg.steps, chunk_fn,
         checkpoint=checkpoint, checkpoint_every=checkpoint_every,
@@ -281,34 +338,11 @@ def train_two_tower(
     )
     fitted = state[1]
 
-    # materialize full vector tables (chunked matmuls, replicated output)
-    def vectors(tower_params, vocab, specs_t):
-        all_ids = jnp.arange(vocab)
-        if mesh is None:
-            return np.asarray(
-                _tower_forward(tower_params, all_ids, None)
-            )
-
-        def inner(tp, ids):
-            return _tower_forward(tp, ids, m_axis)
-
-        out = shard_map(
-            inner,
-            mesh=mesh,
-            in_specs=(specs_t, P("data")),
-            out_specs=P("data"),
-            check_vma=False,
-        )(tower_params, all_ids)
-        return np.asarray(out)
-
+    # materialize full vector tables (replicated output)
     vu_pad = _round_up(vu, max(n_data, 1))
     vi_pad = _round_up(vi, max(n_data, 1))
-    user_vecs = vectors(
-        fitted["user"], vu_pad, specs["user"]
-    )[:n_users]
-    item_vecs = vectors(
-        fitted["item"], vi_pad, specs["item"]
-    )[:n_items]
+    user_vecs = np.asarray(tt.vectors(fitted["user"], vu_pad))[:n_users]
+    item_vecs = np.asarray(tt.vectors(fitted["item"], vi_pad))[:n_items]
     return TwoTowerModel(
         user_vectors=user_vecs, item_vectors=item_vecs, config=cfg
     )

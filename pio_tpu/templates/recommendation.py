@@ -300,12 +300,11 @@ def batched_user_topn(algo, model, queries, user_index, item_index, scorer):
     bidx, bcodes, bq = [], [], []
     for i, q in queries:
         code = user_index.get(q.user)
-        if code is None or q.item:
+        # num <= 0 rides the online path too: predict_user_topn owns that
+        # empty-result contract (a negative num must not slice kmax+num
+        # items off the batched result)
+        if code is None or q.item or q.num <= 0:
             out.append((i, algo.predict(model, q)))
-        elif q.num <= 0:
-            # same empty-result contract as predict_user_topn (a negative
-            # num must not slice kmax+num items off the batched result)
-            out.append((i, PredictedResult()))
         else:
             bidx.append(i)
             bcodes.append(code)
