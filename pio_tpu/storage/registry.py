@@ -20,7 +20,9 @@ Unset → quickstart defaults under ``$PIO_TPU_HOME`` (default
 ``~/.pio_tpu``): SQLite for metadata + events, localfs for models.
 Backend types: ``sqlite``, ``memory``, ``parquet`` (events only),
 ``eventlog`` (events only — native C++ append-only log, the at-scale
-event store), ``localfs`` (models only).
+event store), ``localfs`` (models only), ``searchable`` (aliases ``fts``,
+``elasticsearch`` — the ES-analog: sqlite + FTS5 full-text search over
+events, apps, and run metadata; serves METADATA and EVENTDATA).
 """
 
 from __future__ import annotations
@@ -76,6 +78,11 @@ class _SourceConfig:
         self.path = path
 
 
+#: config aliases → canonical backend type ("elasticsearch" lets reference
+#: configs select the ES-analog without edits)
+_TYPE_ALIASES = {"fts": "searchable", "elasticsearch": "searchable"}
+
+
 def _source_config(repo: str) -> _SourceConfig:
     src_name = os.environ.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "")
     if src_name:
@@ -86,7 +93,8 @@ def _source_config(repo: str) -> _SourceConfig:
                 f"PIO_STORAGE_SOURCES_{src_name}_TYPE"
             )
         path = os.environ.get(f"PIO_STORAGE_SOURCES_{src_name}_PATH")
-        return _SourceConfig(src_name, type_.lower(), path)
+        t = type_.lower()
+        return _SourceConfig(src_name, _TYPE_ALIASES.get(t, t), path)
     # quickstart defaults
     if repo == "MODELDATA":
         return _SourceConfig("DEFAULT_FS", "localfs", None)
@@ -111,6 +119,17 @@ class Storage:
             return cls._clients[key]  # type: ignore[return-value]
 
     @classmethod
+    def _searchable_client(cls, cfg: _SourceConfig):
+        from pio_tpu.storage.searchable import SearchableClient
+
+        path = cfg.path or os.path.join(pio_home(), "pio-search.db")
+        key = f"searchable:{path}"
+        with cls._lock:
+            if key not in cls._clients:
+                cls._clients[key] = SearchableClient(path)
+            return cls._clients[key]
+
+    @classmethod
     def _memory(cls, kind: str, factory):
         with cls._lock:
             if kind not in cls._mem:
@@ -126,17 +145,29 @@ class Storage:
 
     # -- metadata stores ----------------------------------------------------
     @classmethod
-    def _meta(cls, sqlite_cls, mem_kind: str, mem_factory):
+    def _meta(cls, sqlite_cls, mem_kind: str, mem_factory,
+              searchable_cls_name: str = ""):
         cfg = _source_config("METADATA")
         if cfg.type == "sqlite":
             return sqlite_cls(cls._sqlite_client(cfg))
         if cfg.type == "memory":
             return cls._memory(mem_kind, mem_factory)
+        if cfg.type == "searchable":
+            # ES-analog: same relational traits + FTS5 search() where the
+            # store has a searchable body (apps, run records). Imported
+            # lazily by name so non-searchable deployments never load it.
+            from pio_tpu.storage import searchable
+
+            impl = (
+                getattr(searchable, searchable_cls_name)
+                if searchable_cls_name else sqlite_cls
+            )
+            return impl(cls._searchable_client(cfg))
         raise StorageConfigError(f"backend {cfg.type!r} cannot serve METADATA")
 
     @classmethod
     def get_meta_data_apps(cls) -> base.Apps:
-        return cls._meta(SQLiteApps, "apps", MemApps)
+        return cls._meta(SQLiteApps, "apps", MemApps, "SearchableApps")
 
     @classmethod
     def get_meta_data_access_keys(cls) -> base.AccessKeys:
@@ -148,12 +179,16 @@ class Storage:
 
     @classmethod
     def get_meta_data_engine_instances(cls) -> base.EngineInstances:
-        return cls._meta(SQLiteEngineInstances, "engine_instances", MemEngineInstances)
+        return cls._meta(
+            SQLiteEngineInstances, "engine_instances", MemEngineInstances,
+            "SearchableEngineInstances",
+        )
 
     @classmethod
     def get_meta_data_evaluation_instances(cls) -> base.EvaluationInstances:
         return cls._meta(
-            SQLiteEvaluationInstances, "evaluation_instances", MemEvaluationInstances
+            SQLiteEvaluationInstances, "evaluation_instances",
+            MemEvaluationInstances, "SearchableEvaluationInstances",
         )
 
     @classmethod
@@ -178,6 +213,10 @@ class Storage:
             cfg = _source_config(repo)
             if cfg.type == "sqlite":
                 out[repo] = cls._sqlite_client(cfg)
+            elif cfg.type == "searchable":
+                # the ES-analog rides the same schema/migration ladder —
+                # `pio upgrade` must see it too
+                out[repo] = cls._searchable_client(cfg)
         return out
 
     # -- event stores -------------------------------------------------------
@@ -190,6 +229,10 @@ class Storage:
             return cls._memory("levents", MemLEvents)
         if cfg.type == "eventlog":
             return cls._eventlog(cfg)
+        if cfg.type == "searchable":
+            from pio_tpu.storage.searchable import SearchableEvents
+
+            return SearchableEvents(cls._searchable_client(cfg))
         if cfg.type == "parquet":
             raise StorageConfigError(
                 "parquet backend is bulk-only (PEvents); pair it with sqlite "
@@ -206,6 +249,10 @@ class Storage:
             return MemPEvents(cls._memory("levents", MemLEvents))
         if cfg.type == "eventlog":
             return base.PEventsAdapter(cls._eventlog(cfg))
+        if cfg.type == "searchable":
+            from pio_tpu.storage.searchable import SearchableEvents
+
+            return SQLitePEvents(SearchableEvents(cls._searchable_client(cfg)))
         if cfg.type == "parquet":
             path = cfg.path or os.path.join(pio_home(), "events")
             return ParquetPEvents(path)
@@ -222,6 +269,10 @@ class Storage:
         if cfg.type == "localfs":
             path = cfg.path or os.path.join(pio_home(), "models")
             return LocalFSModels(path)
+        if cfg.type == "searchable":
+            # model blobs have no searchable body; the plain sqlite trait
+            # over the same file serves them
+            return SQLiteModels(cls._searchable_client(cfg))
         raise StorageConfigError(f"backend {cfg.type!r} cannot serve MODELDATA")
 
     # -- health -------------------------------------------------------------

@@ -1,0 +1,201 @@
+"""Searchable storage backend — the Elasticsearch-analog.
+
+Fills SURVEY.md §2.3's "Elasticsearch (searchable meta store + events)"
+slot (reference ``storage/elasticsearch/.../ESApps..ESLEvents..ESPEvents``,
+UNVERIFIED paths). The reference delegates searchability to an external ES
+cluster; the TPU-first rebuild keeps the capability in-process: SQLite FTS5
+(BM25-ranked, unicode tokenizer) over the same file the relational tables
+live in — no network service, same SPI, one extra capability:
+``search(...)`` on events, apps, and run metadata.
+
+Index maintenance is done by SQL **triggers**, not Python overrides, so
+every write path (INSERT OR REPLACE upserts, bulk deletes, future verbs)
+keeps the index consistent by construction. ``PRAGMA recursive_triggers``
+is enabled per connection because REPLACE conflict resolution only fires
+delete triggers with it on.
+
+The indexed "body" of each row is a concatenation of its searchable
+columns (including raw JSON text for properties/params — the FTS tokenizer
+splits on punctuation, making JSON keys and values matchable terms).
+
+Select it with::
+
+    PIO_STORAGE_SOURCES_MYES_TYPE=searchable    # aliases: fts, elasticsearch
+    PIO_STORAGE_SOURCES_MYES_PATH=/path/to/pio-search.db
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import List, Optional
+
+from pio_tpu.storage import base
+from pio_tpu.storage.records import App, EngineInstance, EvaluationInstance
+from pio_tpu.storage.sqlite import (
+    SQLiteApps,
+    SQLiteClient,
+    SQLiteEngineInstances,
+    SQLiteEvaluationInstances,
+    SQLiteEvents,
+    _chan,
+    _row_to_event,
+)
+
+#: body expressions per indexed table (also used by the trigger DDL and
+#: the one-time backfill — single home so they cannot diverge)
+_BODY = {
+    "events": (
+        "coalesce({p}.event,'') || ' ' || coalesce({p}.entity_type,'') || "
+        "' ' || coalesce({p}.entity_id,'') || ' ' || "
+        "coalesce({p}.target_entity_type,'') || ' ' || "
+        "coalesce({p}.target_entity_id,'') || ' ' || "
+        "coalesce({p}.properties,'') || ' ' || coalesce({p}.tags,'')"
+    ),
+    "apps": "coalesce({p}.name,'') || ' ' || coalesce({p}.description,'')",
+    "engine_instances": (
+        "coalesce({p}.id,'') || ' ' || coalesce({p}.status,'') || ' ' || "
+        "coalesce({p}.engine_id,'') || ' ' || "
+        "coalesce({p}.engine_factory,'') || ' ' || "
+        "coalesce({p}.engine_variant,'') || ' ' || "
+        "coalesce({p}.data_source_params,'') || ' ' || "
+        "coalesce({p}.algorithms_params,'') || ' ' || "
+        "coalesce({p}.serving_params,'')"
+    ),
+    "evaluation_instances": (
+        "coalesce({p}.id,'') || ' ' || coalesce({p}.status,'') || ' ' || "
+        "coalesce({p}.evaluation_class,'') || ' ' || "
+        "coalesce({p}.engine_params_generator_class,'') || ' ' || "
+        "coalesce({p}.evaluator_results,'')"
+    ),
+}
+
+
+def _fts_ddl(table: str) -> List[str]:
+    body_new = _BODY[table].format(p="new")
+    return [
+        f"CREATE VIRTUAL TABLE IF NOT EXISTS {table}_fts USING fts5(body)",
+        f"""CREATE TRIGGER IF NOT EXISTS {table}_fts_ai
+            AFTER INSERT ON {table} BEGIN
+              INSERT INTO {table}_fts(rowid, body)
+              VALUES (new.rowid, {body_new});
+            END""",
+        f"""CREATE TRIGGER IF NOT EXISTS {table}_fts_ad
+            AFTER DELETE ON {table} BEGIN
+              DELETE FROM {table}_fts WHERE rowid = old.rowid;
+            END""",
+        f"""CREATE TRIGGER IF NOT EXISTS {table}_fts_au
+            AFTER UPDATE ON {table} BEGIN
+              DELETE FROM {table}_fts WHERE rowid = old.rowid;
+              INSERT INTO {table}_fts(rowid, body)
+              VALUES (new.rowid, {body_new});
+            END""",
+    ]
+
+
+class SearchableClient(SQLiteClient):
+    """SQLiteClient + FTS5 index tables and sync triggers."""
+
+    def __init__(self, path: str):
+        super().__init__(path)
+        conn = self.conn()
+        for table in _BODY:
+            for stmt in _fts_ddl(table):
+                conn.execute(stmt)
+            # adopt an existing plain-sqlite file: backfill rows written
+            # before the index existed. Count-guarded so the common
+            # already-indexed open skips the O(n) scan, and OR IGNORE so
+            # two processes racing the first adoption can't collide on
+            # duplicate FTS rowids.
+            n_rows, n_idx = conn.execute(
+                f"SELECT (SELECT count(*) FROM {table}), "
+                f"(SELECT count(*) FROM {table}_fts)"
+            ).fetchone()
+            if n_rows != n_idx:
+                conn.execute(
+                    f"INSERT OR IGNORE INTO {table}_fts(rowid, body) "
+                    f"SELECT t.rowid, {_BODY[table].format(p='t')} "
+                    f"FROM {table} t WHERE t.rowid NOT IN "
+                    f"(SELECT rowid FROM {table}_fts)"
+                )
+        conn.commit()
+
+    def conn(self) -> sqlite3.Connection:
+        fresh = getattr(self._local, "conn", None) is None
+        c = super().conn()
+        if fresh:
+            # REPLACE-resolution deletes only fire the _ad triggers with
+            # this on; per-connection, so set once when the thread-local
+            # connection is created (close() → recreate re-applies it)
+            c.execute("PRAGMA recursive_triggers=ON")
+        return c
+
+
+class SearchError(base.StorageError):
+    """Malformed FTS query string (surfaced with the sqlite detail)."""
+
+
+def _match(conn, table: str, query: str, where: str, args: tuple,
+           limit: Optional[int]):
+    sql = (
+        f"SELECT t.* FROM {table} t JOIN {table}_fts f ON t.rowid = f.rowid "
+        f"WHERE {table}_fts MATCH ? {where} ORDER BY bm25({table}_fts)"
+    )
+    params: list = [query, *args]
+    if limit is not None and limit >= 0:
+        sql += " LIMIT ?"
+        params.append(limit)
+    try:
+        return conn.execute(sql, params).fetchall()
+    except sqlite3.OperationalError as e:
+        # only MATCH-parse failures are the caller's fault; locks and
+        # other infrastructure errors must propagate unblamed
+        if "fts5" in str(e).lower():
+            raise SearchError(f"bad search query {query!r}: {e}") from e
+        raise
+
+
+class SearchableEvents(SQLiteEvents):
+    """LEvents/PEvents + BM25 full-text search over event bodies."""
+
+    def search(
+        self,
+        app_id: int,
+        query: str,
+        channel_id=None,
+        limit: Optional[int] = None,
+    ):
+        """Events of one app/channel matching an FTS5 query string
+        (terms, ``AND``/``OR``/``NOT``, ``"phrases"``, ``prefix*``),
+        best BM25 rank first."""
+        rows = _match(
+            self._c.conn(), "events", query,
+            "AND t.app_id = ? AND t.channel_id = ?",
+            (app_id, _chan(channel_id)), limit,
+        )
+        return [_row_to_event(r) for r in rows]
+
+
+class SearchableApps(SQLiteApps):
+    def search(self, query: str, limit: Optional[int] = None) -> List[App]:
+        rows = _match(self._c.conn(), "apps", query, "", (), limit)
+        return [App(id=r[0], name=r[1], description=r[2]) for r in rows]
+
+
+class SearchableEngineInstances(SQLiteEngineInstances):
+    def search(
+        self, query: str, limit: Optional[int] = None
+    ) -> List[EngineInstance]:
+        rows = _match(
+            self._c.conn(), "engine_instances", query, "", (), limit
+        )
+        return [self._row(r) for r in rows]
+
+
+class SearchableEvaluationInstances(SQLiteEvaluationInstances):
+    def search(
+        self, query: str, limit: Optional[int] = None
+    ) -> List[EvaluationInstance]:
+        rows = _match(
+            self._c.conn(), "evaluation_instances", query, "", (), limit
+        )
+        return [self._row(r) for r in rows]

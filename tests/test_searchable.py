@@ -1,0 +1,231 @@
+"""Searchable (ES-analog) backend — FTS5 capabilities beyond the base SPI.
+
+The relational conformance runs in tests/test_storage.py (the backend is
+one of the parameterized fixtures there); this file covers what makes it
+the Elasticsearch slot: BM25 full-text search over events, apps, and run
+metadata, index consistency through every write path (triggers, not
+Python), and adopting a pre-existing plain-sqlite file.
+"""
+
+import datetime as dt
+
+import pytest
+
+from pio_tpu.data.event import Event
+from pio_tpu.storage.records import App, EngineInstance, EvaluationInstance
+from pio_tpu.storage.searchable import (
+    SearchableApps,
+    SearchableClient,
+    SearchableEngineInstances,
+    SearchableEvaluationInstances,
+    SearchableEvents,
+    SearchError,
+)
+from pio_tpu.storage.registry import Storage
+
+
+def T(h, m=0):
+    return dt.datetime(2026, 3, 1, h, m, tzinfo=dt.timezone.utc)
+
+
+def ev(name, t, eid="u1", props=None, target=None):
+    return Event(
+        name, "user", eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=props or {}, event_time=t,
+    )
+
+
+@pytest.fixture()
+def client(tmp_path):
+    return SearchableClient(str(tmp_path / "search.db"))
+
+
+class TestEventSearch:
+    def test_match_terms_and_properties(self, client):
+        events = SearchableEvents(client)
+        events.insert(ev("rate", T(1), props={"genre": "scifi thriller"}), 7)
+        events.insert(ev("rate", T(2), props={"genre": "romance"}), 7)
+        events.insert(ev("buy", T(3), target="i9"), 7)
+
+        got = events.search(7, "scifi")
+        assert len(got) == 1 and got[0].properties["genre"].startswith("scifi")
+        # property KEYS are terms too (JSON text is tokenized)
+        assert len(events.search(7, "genre")) == 2
+        # entity/target ids are searchable
+        assert events.search(7, "i9")[0].event == "buy"
+
+    def test_boolean_and_prefix_queries(self, client):
+        events = SearchableEvents(client)
+        events.insert(ev("rate", T(1), props={"tag": "alpha beta"}), 1)
+        events.insert(ev("rate", T(2), props={"tag": "alpha gamma"}), 1)
+        assert len(events.search(1, "alpha AND gamma")) == 1
+        assert len(events.search(1, "alpha NOT gamma")) == 1
+        assert len(events.search(1, "gam*")) == 1
+
+    def test_scoped_by_app_and_channel(self, client):
+        events = SearchableEvents(client)
+        events.insert(ev("rate", T(1), props={"k": "needle"}), 1)
+        events.insert(ev("rate", T(1), props={"k": "needle"}), 2)
+        events.insert(ev("rate", T(1), props={"k": "needle"}), 1,
+                      channel_id=5)
+        assert len(events.search(1, "needle")) == 1
+        assert len(events.search(1, "needle", channel_id=5)) == 1
+        assert len(events.search(2, "needle")) == 1
+        assert len(events.search(3, "needle")) == 0
+
+    def test_index_follows_delete_and_upsert(self, client):
+        events = SearchableEvents(client)
+        eid = events.insert(ev("rate", T(1), props={"k": "original"}), 1)
+        assert len(events.search(1, "original")) == 1
+        # upsert same id: old body must leave the index (REPLACE path)
+        events.insert(
+            Event("rate", "user", "u1", properties={"k": "replaced"},
+                  event_time=T(2), event_id=eid), 1,
+        )
+        assert len(events.search(1, "original")) == 0
+        assert len(events.search(1, "replaced")) == 1
+        events.delete(eid, 1)
+        assert len(events.search(1, "replaced")) == 0
+
+    def test_index_follows_bulk_remove(self, client):
+        events = SearchableEvents(client)
+        for k in range(4):
+            events.insert(ev("rate", T(k + 1), props={"k": "bulk"}), 1)
+        events.remove(1)
+        assert len(events.search(1, "bulk")) == 0
+
+    def test_limit_and_rank_order(self, client):
+        events = SearchableEvents(client)
+        # one strongly-matching doc (term twice) and weaker ones
+        events.insert(ev("rate", T(1), props={"a": "zed zed"}), 1)
+        for k in range(3):
+            events.insert(
+                ev("rate", T(k + 2), props={"a": "zed filler extra"}), 1
+            )
+        got = events.search(1, "zed", limit=2)
+        assert len(got) == 2
+        assert got[0].properties["a"] == "zed zed"  # best BM25 first
+
+    def test_bad_query_raises_search_error(self, client):
+        events = SearchableEvents(client)
+        events.insert(ev("rate", T(1)), 1)
+        with pytest.raises(SearchError):
+            events.search(1, 'AND AND (')
+
+
+class TestMetaSearch:
+    def test_apps(self, client):
+        apps = SearchableApps(client)
+        apps.insert(App(0, "shop", description="retail storefront events"))
+        apps.insert(App(0, "news", description="article clicks"))
+        assert apps.search("storefront")[0].name == "shop"
+        assert apps.search("missingterm") == []
+
+    def test_engine_instances(self, client):
+        insts = SearchableEngineInstances(client)
+        now = T(1)
+        iid = insts.insert(EngineInstance(
+            id="", status="COMPLETED", start_time=now, end_time=now,
+            engine_id="reco", engine_version="1", engine_variant="v",
+            engine_factory="templates.recommendation",
+            algorithms_params='[{"name": "als", "rank": 16}]',
+        ))
+        insts.insert(EngineInstance(
+            id="", status="FAILED", start_time=now, end_time=now,
+            engine_id="cls", engine_version="1", engine_variant="v",
+            engine_factory="templates.classification",
+        ))
+        got = insts.search("recommendation")
+        assert [i.id for i in got] == [iid]
+        # params JSON is searchable; so is status
+        assert insts.search("als")[0].id == iid
+        assert insts.search("FAILED")[0].engine_id == "cls"
+        # index follows update()
+        rec = insts.get(iid)
+        import dataclasses
+
+        insts.update(dataclasses.replace(rec, status="DELETED"))
+        assert insts.search("recommendation AND DELETED")[0].id == iid
+
+    def test_evaluation_instances(self, client):
+        evals = SearchableEvaluationInstances(client)
+        now = T(2)
+        iid = evals.insert(EvaluationInstance(
+            id="", status="EVALCOMPLETED", start_time=now, end_time=now,
+            evaluation_class="PrecisionEval",
+            evaluator_results="precision at ten 0.42",
+        ))
+        assert evals.search("precision")[0].id == iid
+
+
+class TestAdoptionAndRegistry:
+    def test_adopts_plain_sqlite_file(self, tmp_path):
+        """Opening an existing plain-sqlite db backfills the FTS index."""
+        from pio_tpu.storage.sqlite import SQLiteClient, SQLiteEvents
+
+        path = str(tmp_path / "adopt.db")
+        plain = SQLiteEvents(SQLiteClient(path))
+        plain.insert(ev("rate", T(1), props={"k": "preexisting"}), 1)
+        plain._c.close()
+
+        events = SearchableEvents(SearchableClient(path))
+        assert len(events.search(1, "preexisting")) == 1
+
+    def test_upgrade_surface_sees_searchable(self, tmp_home, monkeypatch):
+        """`pio upgrade` (Storage.sqlite_clients) must migrate the
+        ES-analog's db too — it rides the same schema ladder."""
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "ES")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_ES_TYPE", "searchable")
+        Storage.reset()
+        try:
+            clients = Storage.sqlite_clients()
+            assert "METADATA" in clients
+            assert isinstance(clients["METADATA"], SearchableClient)
+        finally:
+            Storage.reset()
+
+    def test_concurrent_adoption_race_is_safe(self, tmp_path):
+        """Two clients adopting the same plain file must not collide on
+        duplicate FTS rowids (INSERT OR IGNORE backfill)."""
+        from pio_tpu.storage.sqlite import SQLiteClient, SQLiteEvents
+
+        path = str(tmp_path / "race.db")
+        plain = SQLiteEvents(SQLiteClient(path))
+        plain.insert(ev("rate", T(1), props={"k": "racer"}), 1)
+        plain._c.close()
+        a = SearchableClient(path)
+        b = SearchableClient(path)  # second adoption: backfill is a no-op
+        assert len(SearchableEvents(b).search(1, "racer")) == 1
+        a.close()
+        b.close()
+
+    def test_registry_env_wiring_and_alias(self, tmp_home, monkeypatch):
+        """TYPE=elasticsearch selects the analog; all three repos served."""
+        for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+            monkeypatch.setenv(
+                f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "ES"
+            )
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_ES_TYPE", "elasticsearch")
+        monkeypatch.setenv(
+            "PIO_STORAGE_SOURCES_ES_PATH", str(tmp_home / "es.db")
+        )
+        Storage.reset()
+        try:
+            le = Storage.get_levents()
+            le.insert(ev("rate", T(1), props={"k": "wired"}), 3)
+            assert len(le.search(3, "wired")) == 1
+            apps = Storage.get_meta_data_apps()
+            apps.insert(App(0, "esapp", description="searchable wiring"))
+            assert apps.search("wiring")[0].name == "esapp"
+            # PEvents + Models ride the same file
+            assert len(Storage.get_pevents().find(3)) == 1
+            from pio_tpu.storage.records import Model
+
+            Storage.get_model_data_models().insert(Model("m1", b"blob"))
+            assert Storage.get_model_data_models().get("m1").models == b"blob"
+            checks = Storage.verify_all_data_objects()
+            assert all(checks.values()), checks
+        finally:
+            Storage.reset()

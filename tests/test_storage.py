@@ -78,12 +78,18 @@ def _eventlog(tmp_path):
         pytest.skip(f"native eventlog unavailable: {e}")
 
 
-@pytest.fixture(params=["memory", "sqlite", "eventlog"])
+@pytest.fixture(params=["memory", "sqlite", "eventlog", "searchable"])
 def levents(request, tmp_path):
     if request.param == "memory":
         return MemLEvents()
     if request.param == "eventlog":
         return _eventlog(tmp_path)
+    if request.param == "searchable":
+        from pio_tpu.storage.searchable import (
+            SearchableClient, SearchableEvents,
+        )
+
+        return SearchableEvents(SearchableClient(str(tmp_path / "se.db")))
     return SQLiteEvents(SQLiteClient(str(tmp_path / "le.db")))
 
 
@@ -157,7 +163,8 @@ class TestLEventsConformance:
 
 
 # ------------------------------------------------------------------ PEvents
-@pytest.fixture(params=["memory", "sqlite", "parquet", "eventlog"])
+@pytest.fixture(params=["memory", "sqlite", "parquet", "eventlog",
+                        "searchable"])
 def pevents(request, tmp_path):
     if request.param == "memory":
         return MemPEvents(MemLEvents())
@@ -167,6 +174,14 @@ def pevents(request, tmp_path):
         from pio_tpu.storage.base import PEventsAdapter
 
         return PEventsAdapter(_eventlog(tmp_path))
+    if request.param == "searchable":
+        from pio_tpu.storage.searchable import (
+            SearchableClient, SearchableEvents,
+        )
+
+        return SQLitePEvents(
+            SearchableEvents(SearchableClient(str(tmp_path / "spe.db")))
+        )
     return ParquetPEvents(str(tmp_path / "events"))
 
 
@@ -236,8 +251,24 @@ def test_parquet_compact(tmp_path):
 
 
 # ------------------------------------------------------------------ meta
-@pytest.fixture(params=["memory", "sqlite"])
-def meta(request, sqlite_client):
+@pytest.fixture(params=["memory", "sqlite", "searchable"])
+def meta(request, sqlite_client, tmp_path):
+    if request.param == "searchable":
+        from pio_tpu.storage.searchable import (
+            SearchableApps,
+            SearchableClient,
+            SearchableEngineInstances,
+            SearchableEvaluationInstances,
+        )
+
+        c = SearchableClient(str(tmp_path / "smeta.db"))
+        return dict(
+            apps=SearchableApps(c),
+            keys=SQLiteAccessKeys(c),
+            channels=SQLiteChannels(c),
+            engine_instances=SearchableEngineInstances(c),
+            evaluation_instances=SearchableEvaluationInstances(c),
+        )
     if request.param == "memory":
         return dict(
             apps=MemApps(),
