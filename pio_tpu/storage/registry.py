@@ -22,7 +22,9 @@ Backend types: ``sqlite``, ``memory``, ``parquet`` (events only),
 ``eventlog`` (events only — native C++ append-only log, the at-scale
 event store), ``localfs`` (models only), ``searchable`` (aliases ``fts``,
 ``elasticsearch`` — the ES-analog: sqlite + FTS5 full-text search over
-events, apps, and run metadata; serves METADATA and EVENTDATA).
+events, apps, and run metadata; serves METADATA and EVENTDATA), ``blob``
+(models only — content-addressed, URI-schemed store filling the HDFS/S3
+slot; ``PATH=file:///...`` today, gs/s3/hdfs register the same SPI).
 """
 
 from __future__ import annotations
@@ -273,6 +275,15 @@ class Storage:
             # model blobs have no searchable body; the plain sqlite trait
             # over the same file serves them
             return SQLiteModels(cls._searchable_client(cfg))
+        if cfg.type == "blob":
+            from pio_tpu.storage.blobstore import (
+                BlobModels, open_blob_backend,
+            )
+
+            uri = cfg.path or "file://" + os.path.join(
+                pio_home(), "blobmodels"
+            )
+            return BlobModels(open_blob_backend(uri))
         raise StorageConfigError(f"backend {cfg.type!r} cannot serve MODELDATA")
 
     # -- health -------------------------------------------------------------

@@ -101,16 +101,24 @@ class SearchableClient(SQLiteClient):
         for table in _BODY:
             for stmt in _fts_ddl(table):
                 conn.execute(stmt)
-            # adopt an existing plain-sqlite file: backfill rows written
-            # before the index existed. Count-guarded so the common
-            # already-indexed open skips the O(n) scan, and OR IGNORE so
-            # two processes racing the first adoption can't collide on
-            # duplicate FTS rowids.
+            # adopt an existing plain-sqlite file: two-way sync of rows
+            # written (or deleted) while no index/triggers existed.
+            # Count-guarded so the common already-indexed open skips the
+            # O(n) scan; OR IGNORE so two processes racing the first
+            # adoption can't collide on duplicate FTS rowids; the DELETE
+            # clears stale entries so the counts converge instead of
+            # rescanning forever. (Open the same file as `searchable`
+            # everywhere — a plain-sqlite writer on the side bypasses the
+            # triggers between opens.)
             n_rows, n_idx = conn.execute(
                 f"SELECT (SELECT count(*) FROM {table}), "
                 f"(SELECT count(*) FROM {table}_fts)"
             ).fetchone()
             if n_rows != n_idx:
+                conn.execute(
+                    f"DELETE FROM {table}_fts WHERE rowid NOT IN "
+                    f"(SELECT rowid FROM {table})"
+                )
                 conn.execute(
                     f"INSERT OR IGNORE INTO {table}_fts(rowid, body) "
                     f"SELECT t.rowid, {_BODY[table].format(p='t')} "
@@ -147,9 +155,12 @@ def _match(conn, table: str, query: str, where: str, args: tuple,
     try:
         return conn.execute(sql, params).fetchall()
     except sqlite3.OperationalError as e:
-        # only MATCH-parse failures are the caller's fault; locks and
-        # other infrastructure errors must propagate unblamed
-        if "fts5" in str(e).lower():
+        # MATCH-parse failures are the caller's fault — 'fts5: syntax
+        # error' for malformed expressions, 'no such column' for ES-style
+        # field:term filters naming a non-column. Locks and other
+        # infrastructure errors must propagate unblamed.
+        msg = str(e).lower()
+        if "fts5" in msg or "no such column" in msg:
             raise SearchError(f"bad search query {query!r}: {e}") from e
         raise
 

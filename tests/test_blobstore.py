@@ -1,0 +1,128 @@
+"""Content-addressed blob Models store (pio_tpu/storage/blobstore.py).
+
+The Models-trait conformance runs in tests/test_storage.py (the backend is
+a parameterized fixture there); this file covers the content-addressing
+semantics that make it the HDFS/S3 slot: dedupe, digest verification,
+ref-count garbage collection, and the URI-scheme registry.
+"""
+
+import pytest
+
+from pio_tpu.storage.base import StorageError
+from pio_tpu.storage.blobstore import (
+    BlobModels,
+    FileBlobBackend,
+    open_blob_backend,
+    register_blob_scheme,
+)
+from pio_tpu.storage.records import Model
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return BlobModels(FileBlobBackend(str(tmp_path / "blobs")))
+
+
+def test_identical_models_dedupe(store, tmp_path):
+    store.insert(Model("a", b"same-bytes"))
+    store.insert(Model("b", b"same-bytes"))
+    backend = store._b
+    objects = [k for k in backend.list("objects")]
+    assert len(objects) == 1  # one blob, two refs
+    assert store.get("a").models == b"same-bytes"
+    assert store.get("b").models == b"same-bytes"
+
+
+def test_reinsert_replaces_pointer(store):
+    store.insert(Model("m", b"v1"))
+    store.insert(Model("m", b"v2"))
+    assert store.get("m").models == b"v2"
+
+
+def test_gc_keeps_shared_blob(store):
+    store.insert(Model("a", b"shared"))
+    store.insert(Model("b", b"shared"))
+    assert store.delete("a")
+    assert store.get("b").models == b"shared"  # blob survives b's ref
+    assert store.delete("b")
+    assert store._b.list("objects") == []  # last ref gone → object gc'd
+
+
+def test_delete_missing_is_false(store):
+    assert store.delete("nope") is False
+
+
+def test_slash_and_underscore_ids_do_not_collide(store):
+    store.insert(Model("a/b", b"slash"))
+    store.insert(Model("a_b", b"under"))
+    assert store.get("a/b").models == b"slash"
+    assert store.get("a_b").models == b"under"
+
+
+def test_overwrite_gcs_old_object(store):
+    store.insert(Model("m", b"v1"))
+    store.insert(Model("m", b"v2"))
+    assert len(store._b.list("objects")) == 1  # v1's blob reclaimed
+    store.delete("m")
+    assert store._b.list("objects") == []
+
+
+def test_corrupt_object_detected(store, tmp_path):
+    store.insert(Model("m", b"payload"))
+    # flip bytes in the stored object behind the store's back
+    (obj,) = store._b.list("objects")
+    store._b.put(obj, b"tampered")
+    with pytest.raises(StorageError, match="digest mismatch"):
+        store.get("m")
+
+
+def test_missing_object_detected(store):
+    store.insert(Model("m", b"payload"))
+    (obj,) = store._b.list("objects")
+    store._b.delete(obj)
+    with pytest.raises(StorageError, match="missing"):
+        store.get("m")
+
+
+def test_key_escape_rejected(tmp_path):
+    b = FileBlobBackend(str(tmp_path / "root"))
+    with pytest.raises(StorageError, match="escapes"):
+        b.put("../outside", b"x")
+
+
+def test_uri_scheme_registry(tmp_path):
+    # file:// and bare paths resolve to the file backend
+    m = BlobModels(open_blob_backend("file://" + str(tmp_path / "b1")))
+    m.insert(Model("x", b"1"))
+    assert m.get("x").models == b"1"
+    m2 = BlobModels(open_blob_backend(str(tmp_path / "b2")))
+    m2.insert(Model("y", b"2"))
+    assert m2.get("y").models == b"2"
+    # unregistered scheme: actionable error
+    with pytest.raises(StorageError, match="no blob backend registered"):
+        open_blob_backend("gs://bucket/prefix")
+    # a third-party scheme plugs in without touching BlobModels
+    register_blob_scheme(
+        "memtest", lambda loc: FileBlobBackend(str(tmp_path / "m" / loc))
+    )
+    m3 = BlobModels(open_blob_backend("memtest://ns1"))
+    m3.insert(Model("z", b"3"))
+    assert m3.get("z").models == b"3"
+
+
+def test_registry_env_wiring(tmp_home, monkeypatch):
+    from pio_tpu.storage.registry import Storage
+
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "BLOB")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_BLOB_TYPE", "blob")
+    monkeypatch.setenv(
+        "PIO_STORAGE_SOURCES_BLOB_PATH", "file://" + str(tmp_home / "mb")
+    )
+    Storage.reset()
+    try:
+        models = Storage.get_model_data_models()
+        models.insert(Model("inst1", b"weights"))
+        assert Storage.get_model_data_models().get("inst1").models == b"weights"
+        assert (tmp_home / "mb" / "refs").exists()
+    finally:
+        Storage.reset()

@@ -113,6 +113,28 @@ class TestEventSearch:
         events.insert(ev("rate", T(1)), 1)
         with pytest.raises(SearchError):
             events.search(1, 'AND AND (')
+        # ES-style field:term naming a non-column is a bad query too
+        with pytest.raises(SearchError):
+            events.search(1, "status:FAILED")
+
+    def test_sidechannel_writes_resync_on_open(self, tmp_path):
+        """Rows deleted through a PLAIN sqlite client (no triggers) are
+        purged from the index at the next searchable open — the two-way
+        backfill converges instead of rescanning forever."""
+        from pio_tpu.storage.sqlite import SQLiteClient, SQLiteEvents
+
+        path = str(tmp_path / "side.db")
+        sc = SearchableClient(path)
+        events = SearchableEvents(sc)
+        eid = events.insert(ev("rate", T(1), props={"k": "ghost"}), 1)
+        events.insert(ev("rate", T(2), props={"k": "keeper"}), 1)
+        sc.close()
+        plain = SQLiteEvents(SQLiteClient(path))  # bypasses the triggers
+        plain.delete(eid, 1)
+        plain._c.close()
+        events2 = SearchableEvents(SearchableClient(path))
+        assert len(events2.search(1, "ghost")) == 0  # stale row purged
+        assert len(events2.search(1, "keeper")) == 1
 
 
 class TestMetaSearch:

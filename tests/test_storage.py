@@ -373,12 +373,18 @@ class TestMetaConformance:
 
 
 # ------------------------------------------------------------------ models
-@pytest.fixture(params=["memory", "sqlite", "localfs"])
+@pytest.fixture(params=["memory", "sqlite", "localfs", "blob"])
 def models(request, sqlite_client, tmp_path):
     if request.param == "memory":
         return MemModels()
     if request.param == "sqlite":
         return SQLiteModels(sqlite_client)
+    if request.param == "blob":
+        from pio_tpu.storage.blobstore import BlobModels, open_blob_backend
+
+        return BlobModels(
+            open_blob_backend("file://" + str(tmp_path / "blobs"))
+        )
     return LocalFSModels(str(tmp_path / "models"))
 
 
