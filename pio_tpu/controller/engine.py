@@ -40,19 +40,26 @@ log = logging.getLogger("pio_tpu.engine")
 
 
 def serve_fold(serving, algorithms, models, qa):
-    """One eval fold's query loop: supplement → per-algo predict → serve.
+    """One eval fold's query loop: supplement → per-algo batch predict →
+    serve.
 
     Shared by :meth:`Engine.eval` and the FastEval path so serving
-    semantics can't diverge. Returns [(query, prediction, actual)].
+    semantics can't diverge. Dispatches through ``batch_predict`` (whose
+    default is a predict loop) so algorithms with a vectorized override —
+    one device matmul per fold, constraint snapshots once per call — get
+    it during evaluation too, not just `pio batchpredict`.
+    Returns [(query, prediction, actual)].
     """
-    qpa = []
-    for q, actual in qa:
-        q = serving.supplement(q)
-        preds = [
-            algo.predict(model, q) for algo, model in zip(algorithms, models)
-        ]
-        qpa.append((q, serving.serve(q, preds), actual))
-    return qpa
+    supplemented = [(serving.supplement(q), actual) for q, actual in qa]
+    indexed = [(i, q) for i, (q, _a) in enumerate(supplemented)]
+    per_algo = [
+        dict(algo.batch_predict(model, indexed))
+        for algo, model in zip(algorithms, models)
+    ]
+    return [
+        (q, serving.serve(q, [preds[i] for preds in per_algo]), actual)
+        for i, (q, actual) in enumerate(supplemented)
+    ]
 
 
 @dataclasses.dataclass(frozen=True)

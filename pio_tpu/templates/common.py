@@ -62,6 +62,32 @@ class DeviceScorerModel:
         return d
 
 
+def dedup_pair_indices(a, b) -> np.ndarray:
+    """Indices of the first occurrence of each ``(a[i], b[i])`` pair, in
+    order. K-fold holdouts dedupe interactions first so a repeated pair
+    split across folds can't leak the held-out interaction into the
+    training fold."""
+    seen = set()
+    keep = []
+    for idx, pair in enumerate(zip(a, b)):
+        if pair not in seen:
+            seen.add(pair)
+            keep.append(idx)
+    return np.asarray(keep, np.int64)
+
+
+def fold_assignments(n: int, k: int, seed: int = 0) -> np.ndarray:
+    """Deterministic randomized fold labels ``[n] → {0..k-1}``.
+
+    A sequential ``arange(n) % k`` is hazardous on time-ordered event
+    frames: all users' minute-0 events come first, so index parity can
+    systematically place entire users in one fold (observed: a 2-fold
+    split training on only the odd users). A seeded permutation keeps
+    folds reproducible without that structure."""
+    rng = np.random.default_rng(seed)
+    return rng.permutation(n) % k
+
+
 def eval_app_name(app_name: str) -> str:
     """App for a bundled `pio eval` sweep: the explicit argument, or the
     ``$PIO_TPU_EVAL_APP`` environment fallback for zero-arg CLI use —

@@ -43,6 +43,8 @@ from pio_tpu.storage import Storage
 from pio_tpu.templates.common import (
     PredictedResult,
     business_rule_mask,
+    dedup_pair_indices,
+    fold_assignments,
     l2_normalize_rows,
     top_item_scores,
 )
@@ -66,6 +68,50 @@ class DataSourceParams(
 
 class ECommerceDataSource(SimilarProductDataSource):
     params_class = DataSourceParams
+
+    def read_eval(self, ctx: ComputeContext):
+        """k-fold held-out-view protocol, personalized: the query asks
+        top-``eval_num`` recs for the USER (this template's query shape),
+        the actual is a held-out viewed item — scored by HitRate@eval_num.
+        (The parent's basket-shaped protocol doesn't fit e-commerce
+        queries.)"""
+        p = self.params
+        if p.eval_k <= 0:
+            return []
+        if p.eval_k == 1:
+            raise ValueError("k-fold cross-validation needs eval_k >= 2")
+        td = self.read_training(ctx)
+        keep = dedup_pair_indices(td.user_ids, td.item_ids)
+        users, items = td.user_ids[keep], td.item_ids[keep]
+        fold_of = fold_assignments(len(users), p.eval_k)
+        folds = []
+        for k in range(p.eval_k):
+            train = fold_of != k
+            td_k = type(td)(
+                user_ids=users[train],
+                item_ids=items[train],
+                item_categories=td.item_categories,
+            )
+            seen: Dict[str, List[str]] = {}
+            for u, i in zip(users[train], items[train]):
+                seen.setdefault(str(u), []).append(str(i))
+            # the query black-lists the user's training-fold items — the
+            # standard seen-exclusion protocol (a recommender ranks seen
+            # items first, so without it the held-out item can never win),
+            # expressed through the template's own business-rule surface
+            qa = [
+                (
+                    Query(
+                        user=str(u), num=p.eval_num,
+                        black_list=tuple(seen[str(u)]),
+                    ),
+                    str(i),
+                )
+                for u, i in zip(users[~train], items[~train])
+                if str(u) in seen  # cold-in-fold users are unanswerable
+            ]
+            folds.append((td_k, {"fold": k}, qa))
+        return folds
 
 
 class ECommercePreparator(SimilarProductPreparator):
@@ -177,26 +223,36 @@ class ECommAlgorithm(Algorithm):
             return set()
         return set(pm.get_opt("items") or [])
 
-    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+    def _cold_scores(
+        self, model: ECommModel, query: Query
+    ) -> Optional[np.ndarray]:
+        """Cold user: basket = recent views from the live event store."""
         p: ECommAlgorithmParams = self.params
-        ucode = model.user_index.get(query.user)
-        if ucode is not None:
-            scores = model.item_factors @ model.user_factors[ucode]
-        else:
-            # cold user: basket = recent views from the live event store
-            recent = self._recent_items(
-                model, query.user, p.similar_events, p.num_recent_events
-            )
-            codes = [
-                c
-                for c in (model.item_index.get(i) for i in recent)
-                if c is not None
-            ]
-            if not codes:
-                return PredictedResult()
-            basket = model.norm_item_factors[np.asarray(codes, np.int32)]
-            scores = model.norm_item_factors @ basket.mean(axis=0)
+        recent = self._recent_items(
+            model, query.user, p.similar_events, p.num_recent_events
+        )
+        codes = [
+            c
+            for c in (model.item_index.get(i) for i in recent)
+            if c is not None
+        ]
+        if not codes:
+            return None
+        basket = model.norm_item_factors[np.asarray(codes, np.int32)]
+        return model.norm_item_factors @ basket.mean(axis=0)
 
+    def _apply_rules(
+        self,
+        model: ECommModel,
+        query: Query,
+        scores: np.ndarray,
+        unavailable: Set[str],
+    ) -> PredictedResult:
+        """Business-rule masks + top-N tail, shared by predict and
+        batch_predict so online and offline scoring cannot diverge.
+        ``unavailable`` is the constraint snapshot (fresh per predict,
+        one snapshot per batch_predict call)."""
+        p: ECommAlgorithmParams = self.params
         mask = business_rule_mask(
             len(scores),
             model.item_index,
@@ -205,19 +261,65 @@ class ECommAlgorithm(Algorithm):
             white_list=query.white_list,
             black_list=query.black_list,
         )
-        for i in self._unavailable_items(model):
+        for i in unavailable:
             c = model.item_index.get(i)
             if c is not None:
                 mask[c] = False
         if p.unseen_only:
+            # per-user live lookup stays per query — it IS the semantic
+            # point of this template's serve-time freshness
             for i in self._recent_items(
                 model, query.user, p.seen_events, p.num_recent_events
             ):
                 c = model.item_index.get(i)
                 if c is not None:
                     mask[c] = False
-
         return top_item_scores(scores, mask, query.num, model.item_index)
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        ucode = model.user_index.get(query.user)
+        if ucode is not None:
+            scores = model.item_factors @ model.user_factors[ucode]
+        else:
+            scores = self._cold_scores(model, query)
+            if scores is None:
+                return PredictedResult()
+        return self._apply_rules(
+            model, query, scores, self._unavailable_items(model)
+        )
+
+    def batch_predict(self, model: ECommModel, queries):
+        """Vectorized offline scoring: known-user queries batch into ONE
+        [B, K] @ [K, N] matmul and the unavailable-items constraint is
+        snapshotted once per call; per-user freshness lookups (cold-user
+        baskets, unseen_only) stay live per query — those live reads are
+        this template's semantic point."""
+        unavailable = self._unavailable_items(model)
+        out = []
+        bidx, bq, bcodes = [], [], []
+        for i, q in queries:
+            code = model.user_index.get(q.user)
+            if code is None:
+                scores = self._cold_scores(model, q)
+                out.append((
+                    i,
+                    PredictedResult() if scores is None
+                    else self._apply_rules(model, q, scores, unavailable),
+                ))
+            else:
+                bidx.append(i)
+                bq.append(q)
+                bcodes.append(code)
+        if bidx:
+            mat = (
+                model.user_factors[np.asarray(bcodes, np.int32)]
+                @ model.item_factors.T
+            )  # [B, n_items]
+            for i, q, scores in zip(bidx, bq, mat):
+                out.append(
+                    (i, self._apply_rules(model, q, scores, unavailable))
+                )
+        return out
 
 
 class ECommerceServing(FirstServing):
@@ -231,4 +333,51 @@ def ecommerce_engine() -> Engine:
         ECommercePreparator,
         {"ecomm": ECommAlgorithm},
         ECommerceServing,
+    )
+
+
+# -------------------------------------------------------------- evaluation
+def ecommerce_evaluation(
+    app_name: str = "",
+    eval_k: int = 3,
+    eval_num: int = 10,
+    ranks=(8, 16),
+    num_iterations: int = 10,
+):
+    """Ready-made `pio eval` sweep: k-fold HitRate@``eval_num`` on
+    held-out views, personalized queries, over the rank grid. Each eval
+    query black-lists the user's training-fold items (the seen-exclusion
+    protocol read_eval builds); otherwise business rules run exactly as
+    in serving, including the unavailable-items constraint.
+
+    Zero-arg CLI use reads the app from ``$PIO_TPU_EVAL_APP``:
+
+        PIO_TPU_EVAL_APP=myapp python -m pio_tpu eval \\
+            pio_tpu.templates.ecommerce:ecommerce_evaluation
+    """
+    from pio_tpu.controller.engine import EngineParams
+    from pio_tpu.controller.evaluation import (
+        EngineParamsGenerator, Evaluation,
+    )
+    from pio_tpu.templates.common import eval_app_name
+    from pio_tpu.templates.similarproduct import HitRateMetric
+
+    if eval_k < 2:
+        raise ValueError("k-fold evaluation needs eval_k >= 2")
+    app = eval_app_name(app_name)
+    ds = DataSourceParams(app_name=app, eval_k=eval_k, eval_num=eval_num)
+    grid = [
+        EngineParams(
+            data_source_params=ds,
+            algorithm_params_list=(
+                ("ecomm", ECommAlgorithmParams(
+                    app_name=app, rank=r, num_iterations=num_iterations,
+                )),
+            ),
+        )
+        for r in ranks
+    ]
+    return Evaluation(
+        ecommerce_engine(), HitRateMetric(),
+        engine_params_generator=EngineParamsGenerator(grid),
     )

@@ -47,6 +47,8 @@ from pio_tpu.templates.common import (
     DeviceScorerModel,
     ItemScore,
     PredictedResult,
+    dedup_pair_indices,
+    fold_assignments,
     resolve_app,
 )
 
@@ -63,6 +65,12 @@ class DataSourceParams(Params):
     buy_event: str = "buy"
     buy_rating: float = 4.0
     eval_k: int = 0  # >0 enables k-fold read_eval
+    #: eval protocol: "rating" scores held-out ratings (MSE-style metrics);
+    #: "hitrate" asks top-``eval_num`` recs and scores held-out item hits
+    #: (the two-tower template's protocol — rating regression is
+    #: meaningless for a contrastive retrieval model)
+    eval_mode: str = "rating"
+    eval_num: int = 10
 
 
 @dataclasses.dataclass
@@ -126,10 +134,25 @@ class RecommendationDataSource(DataSource):
             # k=1 would make every training fold empty and fail deep in
             # ALS with a misleading "no ratings" error
             raise ValueError("k-fold cross-validation needs eval_k >= 2")
+        if p.eval_mode not in ("rating", "hitrate"):
+            raise ValueError(
+                f"eval_mode must be 'rating' or 'hitrate', got {p.eval_mode!r}"
+            )
         frame, _ = self._read_frame()
         td_all = self._to_training_data(frame)
+        if p.eval_mode == "hitrate":
+            # dedupe (user, item) pairs — a repeat interaction split
+            # across folds would leak the held-out pair into training
+            # (rating mode keeps duplicates: they are distinct
+            # observations for a regression metric)
+            keep = dedup_pair_indices(td_all.user_ids, td_all.item_ids)
+            td_all = TrainingData(
+                user_ids=td_all.user_ids[keep],
+                item_ids=td_all.item_ids[keep],
+                ratings=td_all.ratings[keep],
+            )
         n = len(td_all)
-        fold_of = np.arange(n) % p.eval_k
+        fold_of = fold_assignments(n, p.eval_k)
         folds = []
         for k in range(p.eval_k):
             train = fold_of != k
@@ -139,17 +162,44 @@ class RecommendationDataSource(DataSource):
                 item_ids=td_all.item_ids[train],
                 ratings=td_all.ratings[train],
             )
-            qa = [
-                (
-                    Query(user=str(u), num=1, item=str(i)),
-                    float(r),
-                )
-                for u, i, r in zip(
-                    td_all.user_ids[test],
-                    td_all.item_ids[test],
-                    td_all.ratings[test],
-                )
-            ]
+            if p.eval_mode == "hitrate":
+                # held-out interaction retrieval: top-N query with the
+                # user's training-fold items black-listed (the standard
+                # seen-exclusion protocol — a recommender ranks items it
+                # trained on first, so without the exclusion the held-out
+                # item is structurally disadvantaged); actual = the
+                # held-out item id (scored by HitRateMetric). Users or
+                # items absent from the training fold are unanswerable
+                # and skipped, as in the other templates' protocols.
+                seen: dict = {}
+                for u, i in zip(td.user_ids, td.item_ids):
+                    seen.setdefault(str(u), []).append(str(i))
+                train_items = set(td.item_ids)
+                qa = [
+                    (
+                        Query(
+                            user=str(u), num=p.eval_num,
+                            black_list=tuple(seen[str(u)]),
+                        ),
+                        str(i),
+                    )
+                    for u, i in zip(
+                        td_all.user_ids[test], td_all.item_ids[test]
+                    )
+                    if str(u) in seen and i in train_items
+                ]
+            else:
+                qa = [
+                    (
+                        Query(user=str(u), num=1, item=str(i)),
+                        float(r),
+                    )
+                    for u, i, r in zip(
+                        td_all.user_ids[test],
+                        td_all.item_ids[test],
+                        td_all.ratings[test],
+                    )
+                ]
             folds.append((td, {"fold": k}, qa))
         return folds
 
@@ -188,6 +238,10 @@ class Query:
     user: str
     num: int = 10
     item: str = ""  # when set, score just this item (used by eval)
+    #: items to exclude from the top-N (already-purchased exclusion; the
+    #: hitrate eval's seen-item protocol) — applied ON DEVICE via the
+    #: scorer's masked top-k, not by post-filtering
+    black_list: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -285,10 +339,32 @@ def predict_user_topn(model, query, user_index: BiMap,
         return PredictedResult((ItemScore(query.item, float(score)),))
     if query.num <= 0:
         return PredictedResult()
-    idx, vals = model.scorer().top_n_batch(
-        np.asarray([code], np.int32), query.num
+    scorer = model.scorer()
+    idx, vals = scorer.top_n_batch(
+        np.asarray([code], np.int32), query.num,
+        exclude=_exclude_rows([query], item_index, scorer.n_cols),
     )
     return _result_from_topn(idx[0], vals[0], item_index)
+
+
+def _exclude_rows(queries, item_index: BiMap, sentinel: int):
+    """Per-query black_list item ids → padded ``[B, E]`` exclusion codes
+    for the scorer (sentinel-filled; None when no query excludes
+    anything). One home shared by the online and batched paths."""
+    lists = [
+        [
+            c for c in (item_index.get(i) for i in q.black_list)
+            if c is not None
+        ]
+        for q in queries
+    ]
+    width = max((len(ls) for ls in lists), default=0)
+    if width == 0:
+        return None
+    out = np.full((len(lists), width), sentinel, np.int32)
+    for r, ls in enumerate(lists):
+        out[r, : len(ls)] = ls
+    return out
 
 
 def batched_user_topn(algo, model, queries, user_index, item_index, scorer):
@@ -311,7 +387,10 @@ def batched_user_topn(algo, model, queries, user_index, item_index, scorer):
             bq.append(q)
     if bcodes:
         kmax = max(q.num for q in bq)
-        idx, vals = scorer.top_n_batch(np.asarray(bcodes, np.int32), kmax)
+        idx, vals = scorer.top_n_batch(
+            np.asarray(bcodes, np.int32), kmax,
+            exclude=_exclude_rows(bq, item_index, scorer.n_cols),
+        )
         for i, q, ri, rv in zip(bidx, bq, idx, vals):
             out.append(
                 (i, _result_from_topn(ri[:q.num], rv[:q.num], item_index))

@@ -45,7 +45,12 @@ from pio_tpu.models.seqrec import SeqRecConfig, SeqRecModel, train_seqrec
 from pio_tpu.parallel.context import ComputeContext
 from pio_tpu.parallel.mesh import MeshSpec, build_mesh
 from pio_tpu.storage import Storage
-from pio_tpu.templates.common import ItemScore, PredictedResult, resolve_app
+from pio_tpu.templates.common import (
+    ItemScore,
+    PredictedResult,
+    fold_assignments,
+    resolve_app,
+)
 
 
 # --------------------------------------------------------------- data source
@@ -57,6 +62,8 @@ class DataSourceParams(Params):
     #: events whose target entity enters the user's history, in time order
     event_names: Tuple[str, ...] = ("view", "buy", "rate")
     min_history: int = 2
+    eval_k: int = 0  # >0 enables k-fold leave-last-out read_eval
+    eval_num: int = 10
 
 
 @dataclasses.dataclass
@@ -100,6 +107,40 @@ class SequenceDataSource(DataSource):
             u: h for u, h in histories.items() if len(h) >= p.min_history
         }
         return TrainingData(histories=histories)
+
+    def read_eval(self, ctx: ComputeContext):
+        """k-fold leave-last-out next-item protocol: users split into k
+        folds; a fold's users train on their history MINUS the last item
+        and are queried with that prefix, the actual being the held-out
+        last item (HitRate@eval_num ≡ next-item accuracy when
+        eval_num=1). Other folds' users train on their full history."""
+        p: DataSourceParams = self.params
+        if p.eval_k <= 0:
+            return []
+        if p.eval_k == 1:
+            raise ValueError("k-fold cross-validation needs eval_k >= 2")
+        td = self.read_training(ctx)
+        users = sorted(td.histories)
+        # randomized (seeded) user folds: sorted user ids often encode
+        # signup order, so sequential r % k would correlate folds with
+        # user cohorts (see common.fold_assignments)
+        fold_of = fold_assignments(len(users), p.eval_k)
+        folds = []
+        for k in range(p.eval_k):
+            train_h: Dict[str, List[str]] = {}
+            qa = []
+            for r, u in enumerate(users):
+                h = td.histories[u]
+                if fold_of[r] == k and len(h) > p.min_history:
+                    train_h[u] = h[:-1]
+                    qa.append(
+                        (Query(history=tuple(h[:-1]), num=p.eval_num),
+                         str(h[-1]))
+                    )
+                else:
+                    train_h[u] = h
+            folds.append((TrainingData(histories=train_h), {"fold": k}, qa))
+        return folds
 
 
 # --------------------------------------------------------------- preparator
@@ -297,4 +338,53 @@ def sequence_engine() -> Engine:
         SequencePreparator,
         {"seqrec": SeqRecAlgorithm},
         SequenceServing,
+    )
+
+
+# -------------------------------------------------------------- evaluation
+def sequence_evaluation(
+    app_name: str = "",
+    eval_k: int = 3,
+    eval_num: int = 10,
+    layer_grid=(1, 2),
+    steps: int = 200,
+    d_model: int = 32,
+    max_len: int = 32,
+):
+    """Ready-made `pio eval` sweep: k-fold leave-last-out
+    HitRate@``eval_num`` (next-item accuracy at eval_num=1) over a
+    transformer-depth grid.
+
+    Zero-arg CLI use reads the app from ``$PIO_TPU_EVAL_APP``:
+
+        PIO_TPU_EVAL_APP=myapp python -m pio_tpu eval \\
+            pio_tpu.templates.sequence:sequence_evaluation
+    """
+    from pio_tpu.controller.engine import EngineParams
+    from pio_tpu.controller.evaluation import (
+        EngineParamsGenerator, Evaluation,
+    )
+    from pio_tpu.templates.common import eval_app_name
+    from pio_tpu.templates.similarproduct import HitRateMetric
+
+    if eval_k < 2:
+        raise ValueError("k-fold evaluation needs eval_k >= 2")
+    ds = DataSourceParams(
+        app_name=eval_app_name(app_name), eval_k=eval_k, eval_num=eval_num
+    )
+    grid = [
+        EngineParams(
+            data_source_params=ds,
+            algorithm_params_list=(
+                ("seqrec", SeqRecParams(
+                    d_model=d_model, n_layers=n, steps=steps,
+                    max_len=max_len,
+                )),
+            ),
+        )
+        for n in layer_grid
+    ]
+    return Evaluation(
+        sequence_engine(), HitRateMetric(),
+        engine_params_generator=EngineParamsGenerator(grid),
     )

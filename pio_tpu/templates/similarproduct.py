@@ -52,6 +52,8 @@ from pio_tpu.templates.common import (
     ItemScore,
     PredictedResult,
     business_rule_mask,
+    dedup_pair_indices,
+    fold_assignments,
     l2_normalize_rows,
     resolve_app,
     top_item_scores,
@@ -131,22 +133,14 @@ class SimilarProductDataSource(DataSource):
         if p.eval_k == 1:
             raise ValueError("k-fold cross-validation needs eval_k >= 2")
         td = self.read_training(ctx)
-        # dedupe (user, item) pairs: a repeat view split across folds
-        # would leak the held-out interaction into the training fold
-        seen = set()
-        keep = []
-        for idx, (u, i) in enumerate(zip(td.user_ids, td.item_ids)):
-            if (u, i) not in seen:
-                seen.add((u, i))
-                keep.append(idx)
-        keep = np.asarray(keep, np.int64)
+        keep = dedup_pair_indices(td.user_ids, td.item_ids)
         td = TrainingData(
             user_ids=td.user_ids[keep],
             item_ids=td.item_ids[keep],
             item_categories=td.item_categories,
         )
         n = len(td)
-        fold_of = np.arange(n) % p.eval_k
+        fold_of = fold_assignments(n, p.eval_k)
         folds = []
         for k in range(p.eval_k):
             train = fold_of != k

@@ -154,3 +154,53 @@ def twotower_engine() -> Engine:
         {"twotower": TwoTowerAlgorithm},
         TwoTowerServing,
     )
+
+
+# -------------------------------------------------------------- evaluation
+def twotower_evaluation(
+    app_name: str = "",
+    eval_k: int = 3,
+    eval_num: int = 10,
+    out_dims=(32, 64),
+    steps: int = 300,
+    batch_size: int = 256,
+):
+    """Ready-made `pio eval` sweep: k-fold HitRate@``eval_num`` on held-out
+    interactions over an output-dimension grid (retrieval quality is what
+    a contrastive model optimizes — rating regression would be
+    meaningless for it).
+
+    Zero-arg CLI use reads the app from ``$PIO_TPU_EVAL_APP``:
+
+        PIO_TPU_EVAL_APP=myapp python -m pio_tpu eval \\
+            pio_tpu.templates.twotower:twotower_evaluation
+    """
+    from pio_tpu.controller.engine import EngineParams
+    from pio_tpu.controller.evaluation import (
+        EngineParamsGenerator, Evaluation,
+    )
+    from pio_tpu.templates.common import eval_app_name
+    from pio_tpu.templates.recommendation import DataSourceParams
+    from pio_tpu.templates.similarproduct import HitRateMetric
+
+    if eval_k < 2:
+        raise ValueError("k-fold evaluation needs eval_k >= 2")
+    ds = DataSourceParams(
+        app_name=eval_app_name(app_name), eval_k=eval_k,
+        eval_mode="hitrate", eval_num=eval_num,
+    )
+    grid = [
+        EngineParams(
+            data_source_params=ds,
+            algorithm_params_list=(
+                ("twotower", TwoTowerParams(
+                    out_dim=d, steps=steps, batch_size=batch_size,
+                )),
+            ),
+        )
+        for d in out_dims
+    ]
+    return Evaluation(
+        twotower_engine(), HitRateMetric(),
+        engine_params_generator=EngineParamsGenerator(grid),
+    )

@@ -1,0 +1,298 @@
+"""Ready-made evaluations for the e-commerce, two-tower, and sequence
+templates — with these, every bundled template is `pio eval`-able
+(SURVEY.md §2.5: each reference template ships an Evaluation).
+
+Also covers the e-commerce vectorized batch_predict (one matmul per batch
+of known users, constraint snapshot per call) against the per-query loop.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+import pio_tpu.templates  # noqa: F401  (registers engine factories)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.storage import App, Storage
+from pio_tpu.workflow import (
+    build_engine,
+    load_models_for_instance,
+    run_evaluation,
+    run_train,
+    variant_from_dict,
+)
+
+
+@pytest.fixture(autouse=True)
+def _home(tmp_home):
+    return tmp_home
+
+
+def _seed_grouped_views(app_id, n_users=12, n_items=8, per_user=8):
+    """u views items of group u % 2 (tech/food split), repeatedly."""
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 4, 1, tzinfo=dt.timezone.utc)
+    for i in range(n_items):
+        le.insert(
+            Event("$set", "item", f"i{i}",
+                  properties={"categories": ["tech" if i < 4 else "food"]},
+                  event_time=t0),
+            app_id,
+        )
+    rng = np.random.default_rng(0)
+    k = 0
+    for u in range(n_users):
+        lo = 0 if u % 2 == 0 else 4
+        for _ in range(per_user):
+            i = lo + int(rng.integers(0, 4))
+            le.insert(
+                Event("view", "user", f"u{u}", "item", f"i{i}",
+                      event_time=t0 + dt.timedelta(minutes=k)),
+                app_id,
+            )
+            k += 1
+
+
+def _seed_cycles(app_id, n_users=12, V=6, length=9):
+    """User u walks the item cycle starting at u % V — the next item is
+    deterministic, so next-item eval has a learnable answer."""
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 4, 2, tzinfo=dt.timezone.utc)
+    for u in range(n_users):
+        for k in range(length):
+            le.insert(
+                Event("view", "user", f"u{u}", "item",
+                      f"i{(u + k) % V}",
+                      event_time=t0 + dt.timedelta(minutes=k)),
+                app_id,
+            )
+
+
+class TestECommerceEvaluation:
+    def test_eval_sweep(self):
+        from pio_tpu.templates.ecommerce import ecommerce_evaluation
+
+        Storage.get_meta_data_apps().insert(App(0, "ec-eval"))
+        app_id = Storage.get_meta_data_apps().get_by_name("ec-eval").id
+        _seed_grouped_views(app_id)
+        ev = ecommerce_evaluation(
+            app_name="ec-eval", eval_k=3, ranks=(4,), num_iterations=8,
+            eval_num=2,
+        )
+        result = run_evaluation(
+            ev, ev.engine_params_generator, ctx=ComputeContext.create()
+        )
+        # grouped data: held-out views come from the user's own 4-item
+        # group, so HitRate@2 must clear random-over-catalog (2/8)
+        assert result.best_score > 0.3, result.best_score
+        insts = Storage.get_meta_data_evaluation_instances().get_all()
+        assert insts[0].status == "COMPLETED"
+
+    def test_eval_k1_rejected(self):
+        from pio_tpu.templates.ecommerce import (
+            DataSourceParams, ECommerceDataSource,
+        )
+
+        ds = ECommerceDataSource(
+            DataSourceParams(app_name="x", eval_k=1)
+        )
+        with pytest.raises(ValueError, match="eval_k >= 2"):
+            ds.read_eval(ComputeContext.local())
+
+
+class TestTwoTowerEvaluation:
+    def test_eval_sweep(self):
+        from pio_tpu.templates.twotower import twotower_evaluation
+
+        Storage.get_meta_data_apps().insert(App(0, "tt-eval"))
+        app_id = Storage.get_meta_data_apps().get_by_name("tt-eval").id
+        # the recommendation datasource reads rate/buy; seed buys
+        le = Storage.get_levents()
+        t0 = dt.datetime(2026, 4, 3, tzinfo=dt.timezone.utc)
+        rng = np.random.default_rng(1)
+        for u in range(12):
+            lo = 0 if u % 2 == 0 else 4
+            for k in range(8):
+                le.insert(
+                    Event("buy", "user", f"u{u}", "item",
+                          f"i{lo + int(rng.integers(0, 4))}",
+                          event_time=t0 + dt.timedelta(minutes=k)),
+                    app_id,
+                )
+        ev = twotower_evaluation(
+            app_name="tt-eval", eval_k=2, eval_num=4, out_dims=(8,),
+            steps=80, batch_size=32,
+        )
+        result = run_evaluation(
+            ev, ev.engine_params_generator, ctx=ComputeContext.create()
+        )
+        assert 0.0 <= result.best_score <= 1.0
+        # HitRate@4 on an 8-item catalog: even weak retrieval beats 0
+        assert result.best_score > 0.2, result.best_score
+
+    def test_hitrate_mode_read_eval_shape(self):
+        from pio_tpu.templates.recommendation import (
+            DataSourceParams, RecommendationDataSource,
+        )
+
+        Storage.get_meta_data_apps().insert(App(0, "hr-shape"))
+        app_id = Storage.get_meta_data_apps().get_by_name("hr-shape").id
+        le = Storage.get_levents()
+        t0 = dt.datetime(2026, 4, 4, tzinfo=dt.timezone.utc)
+        for u in range(4):
+            for i in range(4):
+                # duplicate interactions: the dedup must keep the held-out
+                # pair out of the training fold
+                for _ in range(2):
+                    le.insert(
+                        Event("buy", "user", f"u{u}", "item", f"i{i}",
+                              event_time=t0),
+                        app_id,
+                    )
+        ds = RecommendationDataSource(DataSourceParams(
+            app_name="hr-shape", eval_k=2, eval_mode="hitrate", eval_num=3,
+        ))
+        folds = ds.read_eval(ComputeContext.local())
+        assert len(folds) == 2
+        for td, _info, qa in folds:
+            train_pairs = set(zip(td.user_ids, td.item_ids))
+            for q, actual in qa:
+                assert q.num == 3 and q.item == ""  # top-N, not pair-score
+                # no cross-fold leakage even with duplicate events
+                assert (q.user, actual) not in train_pairs
+                # seen-exclusion: the query black-lists the user's
+                # training-fold items, never the held-out answer
+                assert actual not in q.black_list
+                assert set(q.black_list) == {
+                    i for u, i in train_pairs if u == q.user
+                }
+
+    def test_blacklist_respected_in_serving(self):
+        """Query.black_list must mask items on BOTH serving paths."""
+        import numpy as np
+
+        from pio_tpu.data.bimap import BiMap
+        from pio_tpu.models.als import ALSFactors
+        from pio_tpu.templates.recommendation import (
+            ALSAlgorithm, ALSModel, Query,
+        )
+
+        rng = np.random.default_rng(0)
+        m = ALSModel(
+            ALSFactors(
+                rng.normal(size=(5, 6)).astype(np.float32),
+                rng.normal(size=(9, 6)).astype(np.float32),
+            ),
+            BiMap.string_int([f"u{i}" for i in range(5)]),
+            BiMap.string_int([f"i{i}" for i in range(9)]),
+        )
+        algo = ALSAlgorithm(None)
+        full = algo.predict(m, Query(user="u1", num=3))
+        top1 = full.item_scores[0].item
+        q = Query(user="u1", num=3, black_list=(top1, "ghost"))
+        masked = algo.predict(m, q)
+        assert top1 not in [s.item for s in masked.item_scores]
+        bat = dict(algo.batch_predict(m, [(0, q)]))[0]
+        assert [s.item for s in bat.item_scores] == [
+            s.item for s in masked.item_scores
+        ]
+
+    def test_bad_eval_mode_rejected(self):
+        from pio_tpu.templates.recommendation import (
+            DataSourceParams, RecommendationDataSource,
+        )
+
+        ds = RecommendationDataSource(DataSourceParams(
+            app_name="x", eval_k=2, eval_mode="nonsense",
+        ))
+        with pytest.raises(ValueError, match="eval_mode"):
+            ds.read_eval(ComputeContext.local())
+
+
+class TestSequenceEvaluation:
+    def test_eval_sweep(self):
+        from pio_tpu.templates.sequence import sequence_evaluation
+
+        Storage.get_meta_data_apps().insert(App(0, "sq-eval"))
+        app_id = Storage.get_meta_data_apps().get_by_name("sq-eval").id
+        _seed_cycles(app_id)
+        ev = sequence_evaluation(
+            app_name="sq-eval", eval_k=3, eval_num=2, layer_grid=(1,),
+            steps=120, d_model=16, max_len=16,
+        )
+        result = run_evaluation(
+            ev, ev.engine_params_generator, ctx=ComputeContext.create()
+        )
+        # deterministic cycles: the next item is learnable; HitRate@2 on
+        # a 6-item vocab must clear random (2/6)
+        assert result.best_score > 0.34, result.best_score
+
+    def test_leave_last_out_shapes(self):
+        from pio_tpu.templates.sequence import (
+            DataSourceParams, SequenceDataSource,
+        )
+
+        Storage.get_meta_data_apps().insert(App(0, "sq-shape"))
+        app_id = Storage.get_meta_data_apps().get_by_name("sq-shape").id
+        _seed_cycles(app_id, n_users=6, V=4, length=5)
+        ds = SequenceDataSource(DataSourceParams(
+            app_name="sq-shape", eval_k=2, eval_num=1,
+        ))
+        folds = ds.read_eval(ComputeContext.local())
+        assert len(folds) == 2
+        all_queried = 0
+        for td, _info, qa in folds:
+            for q, actual in qa:
+                # the held-out item is the user's true last event...
+                assert isinstance(actual, str)
+                # ...and never appears at the end of any training history
+                # row fed to this fold for that user
+                assert len(q.history) == 4  # length-5 walk minus holdout
+                all_queried += 1
+        assert all_queried == 6  # every user evaluated exactly once
+
+
+class TestECommerceBatchPredict:
+    def test_batch_matches_loop(self):
+        from pio_tpu.templates.ecommerce import Query
+
+        Storage.get_meta_data_apps().insert(App(0, "ec-bp"))
+        app_id = Storage.get_meta_data_apps().get_by_name("ec-bp").id
+        _seed_grouped_views(app_id)
+        # constraint entity: i0 is unavailable
+        Storage.get_levents().insert(
+            Event("$set", "constraint", "unavailableItems",
+                  properties={"items": ["i0"]},
+                  event_time=dt.datetime(2026, 4, 5,
+                                         tzinfo=dt.timezone.utc)),
+            app_id,
+        )
+        variant = variant_from_dict({
+            "id": "ec-bp", "engineFactory": "templates.ecommerce",
+            "datasource": {"params": {"app_name": "ec-bp"}},
+            "algorithms": [{"name": "ecomm", "params": {
+                "app_name": "ec-bp", "rank": 4, "num_iterations": 8,
+                "unseen_only": True,
+            }}],
+        })
+        engine, ep = build_engine(variant)
+        ctx = ComputeContext.create(seed=0)
+        iid = run_train(engine, ep, variant, ctx=ctx)
+        models = load_models_for_instance(iid, engine, ep, ctx)
+        algo, model = engine.algorithms_with_models(ep, models)[0]
+        queries = (
+            [(i, Query(user=f"u{i % 12}", num=3)) for i in range(16)]
+            + [(90, Query(user="u1", num=3, categories=("food",)))]
+            + [(91, Query(user="coldshopper", num=3))]  # unknown user
+        )
+        loop = {i: algo.predict(model, q) for i, q in queries}
+        bat = dict(algo.batch_predict(model, queries))
+        assert set(loop) == set(bat)
+        for i in loop:
+            assert [s.item for s in loop[i].item_scores] == [
+                s.item for s in bat[i].item_scores
+            ], f"query {i}"
+        # the constraint held in both paths
+        for res in bat.values():
+            assert all(s.item != "i0" for s in res.item_scores)
