@@ -13,8 +13,12 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 import typing
 from typing import Any, Mapping, Optional, Type, TypeVar
+
+#: camelCase → snake_case boundary (see params_from_dict wire parity)
+_SNAKE_RE = re.compile(r"(?<=[a-z0-9])([A-Z])")
 
 P = TypeVar("P", bound="Params")
 
@@ -72,6 +76,23 @@ def params_from_dict(cls: Type[P], d: Optional[Mapping[str, Any]]) -> P:
         raise ParamsError(f"{cls.__name__} must be a dataclass")
     hints = typing.get_type_hints(cls)
     fields = {f.name: f for f in dataclasses.fields(cls)}
+    # reference wire parity: queries and engine.json use camelCase keys
+    # ("whiteList", "numIterations"); fields here are snake_case. Accept
+    # both spellings; a key that matches a field exactly wins.
+    for key in list(d):
+        if key in fields:
+            continue
+        snake = _SNAKE_RE.sub(r"_\1", key).lower()
+        if snake not in fields and snake + "_" in fields:
+            # Python-keyword collisions: the reference's "lambda" binds to
+            # a lambda_ field (same for any keyword-named wire param)
+            snake = snake + "_"
+        if snake in fields:
+            if snake in d:
+                raise ParamsError(
+                    f"{cls.__name__}: both {key!r} and {snake!r} given"
+                )
+            d[snake] = d.pop(key)
     unknown = set(d) - set(fields)
     if unknown:
         raise ParamsError(

@@ -88,6 +88,26 @@ def fold_assignments(n: int, k: int, seed: int = 0) -> np.ndarray:
     return rng.permutation(n) % k
 
 
+def seen_exclusion_holdout(train_users, train_items, test_users,
+                           test_items, make_query):
+    """One home for the hitrate holdout protocol (recommendation/two-tower
+    and e-commerce evaluations): per held-out (user, item) pair, build a
+    query black-listing the user's training-fold items — a recommender
+    ranks items it memorized first, so without the exclusion the held-out
+    item is structurally disadvantaged. User-cold and item-cold pairs are
+    unanswerable in that fold and skipped. ``make_query(user, black_list)``
+    returns the template's query object; returns ``[(query, actual)]``."""
+    seen: dict = {}
+    for u, i in zip(train_users, train_items):
+        seen.setdefault(str(u), []).append(str(i))
+    known_items = {str(i) for i in train_items}
+    return [
+        (make_query(str(u), tuple(seen[str(u)])), str(i))
+        for u, i in zip(test_users, test_items)
+        if str(u) in seen and str(i) in known_items
+    ]
+
+
 def eval_app_name(app_name: str) -> str:
     """App for a bundled `pio eval` sweep: the explicit argument, or the
     ``$PIO_TPU_EVAL_APP`` environment fallback for zero-arg CLI use —

@@ -46,6 +46,7 @@ from pio_tpu.templates.common import (
     dedup_pair_indices,
     fold_assignments,
     l2_normalize_rows,
+    seen_exclusion_holdout,
     top_item_scores,
 )
 from pio_tpu.templates.similarproduct import (
@@ -92,24 +93,16 @@ class ECommerceDataSource(SimilarProductDataSource):
                 item_ids=items[train],
                 item_categories=td.item_categories,
             )
-            seen: Dict[str, List[str]] = {}
-            for u, i in zip(users[train], items[train]):
-                seen.setdefault(str(u), []).append(str(i))
-            # the query black-lists the user's training-fold items — the
-            # standard seen-exclusion protocol (a recommender ranks seen
-            # items first, so without it the held-out item can never win),
-            # expressed through the template's own business-rule surface
-            qa = [
-                (
-                    Query(
-                        user=str(u), num=p.eval_num,
-                        black_list=tuple(seen[str(u)]),
-                    ),
-                    str(i),
-                )
-                for u, i in zip(users[~train], items[~train])
-                if str(u) in seen  # cold-in-fold users are unanswerable
-            ]
+            # seen-exclusion protocol, expressed through the template's
+            # own black_list business rule (one home for the protocol:
+            # common.seen_exclusion_holdout)
+            qa = seen_exclusion_holdout(
+                users[train], items[train],
+                users[~train], items[~train],
+                lambda u, bl: Query(
+                    user=u, num=p.eval_num, black_list=bl
+                ),
+            )
             folds.append((td_k, {"fold": k}, qa))
         return folds
 

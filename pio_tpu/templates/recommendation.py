@@ -49,6 +49,7 @@ from pio_tpu.templates.common import (
     PredictedResult,
     dedup_pair_indices,
     fold_assignments,
+    seen_exclusion_holdout,
     resolve_app,
 )
 
@@ -163,31 +164,15 @@ class RecommendationDataSource(DataSource):
                 ratings=td_all.ratings[train],
             )
             if p.eval_mode == "hitrate":
-                # held-out interaction retrieval: top-N query with the
-                # user's training-fold items black-listed (the standard
-                # seen-exclusion protocol — a recommender ranks items it
-                # trained on first, so without the exclusion the held-out
-                # item is structurally disadvantaged); actual = the
-                # held-out item id (scored by HitRateMetric). Users or
-                # items absent from the training fold are unanswerable
-                # and skipped, as in the other templates' protocols.
-                seen: dict = {}
-                for u, i in zip(td.user_ids, td.item_ids):
-                    seen.setdefault(str(u), []).append(str(i))
-                train_items = set(td.item_ids)
-                qa = [
-                    (
-                        Query(
-                            user=str(u), num=p.eval_num,
-                            black_list=tuple(seen[str(u)]),
-                        ),
-                        str(i),
-                    )
-                    for u, i in zip(
-                        td_all.user_ids[test], td_all.item_ids[test]
-                    )
-                    if str(u) in seen and i in train_items
-                ]
+                # held-out interaction retrieval, scored by HitRateMetric
+                # (see common.seen_exclusion_holdout for the protocol)
+                qa = seen_exclusion_holdout(
+                    td.user_ids, td.item_ids,
+                    td_all.user_ids[test], td_all.item_ids[test],
+                    lambda u, bl: Query(
+                        user=u, num=p.eval_num, black_list=bl
+                    ),
+                )
             else:
                 qa = [
                     (
@@ -315,10 +300,17 @@ class ALSAlgorithm(Algorithm):
 
 def _result_from_topn(idx, vals, item_index: BiMap) -> PredictedResult:
     """(top-n indices, scores) → PredictedResult — the only step that
-    touches host Python: mapping integer codes back to string item ids."""
+    touches host Python: mapping integer codes back to string item ids.
+    Non-finite scores are dropped: when a black_list leaves fewer than n
+    items, the excluded slots surface from top-k as -inf and must not be
+    served (nor serialized as non-standard JSON Infinity)."""
     inv = item_index.inverse
     return PredictedResult(
-        tuple(ItemScore(inv[int(i)], float(v)) for i, v in zip(idx, vals))
+        tuple(
+            ItemScore(inv[int(i)], float(v))
+            for i, v in zip(idx, vals)
+            if np.isfinite(v)
+        )
     )
 
 

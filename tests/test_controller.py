@@ -79,6 +79,29 @@ class TestParamsBinding:
     def test_none_uses_defaults(self):
         assert params_from_dict(PTest, None) == PTest()
 
+    def test_camel_case_keys_bind(self):
+        """Reference wire parity: engine.json and queries use camelCase
+        ("numIterations", "whiteList"); fields are snake_case."""
+
+        from typing import Tuple
+
+        @dataclasses.dataclass(frozen=True)
+        class Cam(Params):
+            num_iterations: int = 1
+            white_list: Tuple[str, ...] = ()
+
+        p = params_from_dict(
+            Cam, {"numIterations": 5, "whiteList": ["a"]}
+        )
+        assert p.num_iterations == 5 and tuple(p.white_list) == ("a",)
+        # exact field name still wins; giving both is ambiguous
+        with pytest.raises(ParamsError, match="both"):
+            params_from_dict(
+                Cam, {"numIterations": 5, "num_iterations": 6}
+            )
+        with pytest.raises(ParamsError, match="unknown"):
+            params_from_dict(Cam, {"numIterationsTypo": 5})
+
 
 # ---------------------------------------------------------------- engine
 def variant(algos=None, ds=None):

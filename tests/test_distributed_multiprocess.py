@@ -1,0 +1,118 @@
+"""2-process jax.distributed smoke test (pio_tpu/parallel/distributed.py).
+
+The multi-host story is SPMD: every host runs the same program and
+``maybe_initialize`` forms the group from the PIO_TPU_* env contract.
+This test actually forms a 2-process group on CPU — subprocess pair,
+coordinator handshake, a cross-process psum, and a
+``host_local_to_global`` assembly — the closest a single machine gets to
+the reference's multi-node paths (which its suite never tests at all;
+SURVEY.md §4 "what is NOT tested").
+
+Skips gracefully when the platform refuses to form the group (sandboxed
+CI without localhost sockets, or a jax build without distributed
+support).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+
+# maybe_initialize must run BEFORE any backend touch (its documented
+# contract) — only stdlib + the wrapper first
+from pio_tpu.parallel.distributed import (
+    maybe_initialize, is_coordinator, host_local_to_global,
+)
+
+joined = maybe_initialize()
+assert joined, "PIO_TPU_COORDINATOR was set; group must form"
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+rank = jax.process_index()
+assert jax.process_count() == 2, jax.process_count()
+assert is_coordinator() == (rank == 0)
+
+# one device per process -> a 2-device global mesh spanning both processes
+mesh = jax.sharding.Mesh(np.array(jax.devices()).reshape(2), ("data",))
+
+# each process contributes its own rows; the global array spans both
+local = np.full((3, 4), float(rank + 1), np.float32)
+g = host_local_to_global(mesh, P("data"), local)
+assert g.shape == (6, 4), g.shape
+
+# cross-process collective: psum over the data axis sees BOTH hosts' rows
+def body(x):
+    return jax.lax.psum(x.sum(), "data")
+
+total = jax.jit(
+    jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P())
+)(g)
+expect = 3 * 4 * 1.0 + 3 * 4 * 2.0  # rank0 ones + rank1 twos
+got = float(np.asarray(total))
+assert got == expect, (got, expect)
+print(f"RANK{{rank}}_OK", flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_group_psum(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=REPO))
+
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # one device per process, no simulation
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PIO_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["PIO_TPU_NUM_PROCESSES"] = "2"
+        env["PIO_TPU_PROCESS_ID"] = str(rank)
+        env["PYTHONPATH"] = REPO
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        ))
+
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("distributed group never formed (platform refused)")
+
+    combined = "\n---\n".join(outs)
+    if any(p.returncode != 0 for p in procs):
+        benign = (
+            "DEADLINE_EXCEEDED", "UNAVAILABLE", "failed to connect",
+            "Connection refused", "distributed is not available",
+        )
+        if any(b in combined for b in benign):
+            pytest.skip(f"platform refused the process group: "
+                        f"{combined[-500:]}")
+        raise AssertionError(combined[-4000:])
+    assert "RANK0_OK" in combined and "RANK1_OK" in combined, combined[-2000:]

@@ -168,6 +168,45 @@ class TestTwoTowerEvaluation:
                     i for u, i in train_pairs if u == q.user
                 }
 
+    def test_blacklist_never_serves_excluded_items(self):
+        """When exclusions leave fewer than num finite items, the result
+        shortens — black-listed slots must not surface as -inf scores."""
+        import numpy as np
+
+        from pio_tpu.data.bimap import BiMap
+        from pio_tpu.models.als import ALSFactors
+        from pio_tpu.templates.recommendation import (
+            ALSAlgorithm, ALSModel, Query,
+        )
+
+        rng = np.random.default_rng(2)
+        m = ALSModel(
+            ALSFactors(
+                rng.normal(size=(3, 4)).astype(np.float32),
+                rng.normal(size=(4, 4)).astype(np.float32),
+            ),
+            BiMap.string_int([f"u{i}" for i in range(3)]),
+            BiMap.string_int([f"i{i}" for i in range(4)]),
+        )
+        algo = ALSAlgorithm(None)
+        q = Query(user="u0", num=4, black_list=("i0", "i1", "i2"))
+        got = algo.predict(m, q)
+        assert [s.item for s in got.item_scores] == ["i3"]
+        assert all(np.isfinite(s.score) for s in got.item_scores)
+        bat = dict(algo.batch_predict(m, [(0, q)]))[0]
+        assert [s.item for s in bat.item_scores] == ["i3"]
+
+    def test_reference_lambda_param_binds(self):
+        """Reference engine.json uses the keyword 'lambda'; it must bind
+        to the lambda_ field."""
+        from pio_tpu.controller.params import params_from_dict
+        from pio_tpu.templates.recommendation import ALSAlgorithmParams
+
+        p = params_from_dict(
+            ALSAlgorithmParams, {"rank": 4, "lambda": 0.5}
+        )
+        assert p.lambda_ == 0.5
+
     def test_blacklist_respected_in_serving(self):
         """Query.black_list must mask items on BOTH serving paths."""
         import numpy as np
