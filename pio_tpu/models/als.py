@@ -395,7 +395,7 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
     su, wu, si, wi = packed_shapes
 
     @jax.jit
-    def run_packed(counts_u, i_lo, i_hi, r, seed):
+    def run_packed(counts_u, counts_i, i_lo, i_hi, r, seed):
         # wire decode (all static dtype dispatch):
         #   item ids < 2^16 arrive uint16; < 2^24 as uint16 low plane +
         #   uint8 high plane (i_hi; zero-size when unused)
@@ -413,16 +413,20 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
             jnp.arange(U_pad, dtype=jnp.int32), counts_u,
             total_repeat_length=E,
         )
+        # both degree histograms ride the wire (0.9 MB total) — the
+        # on-device bincount is a 25M-edge scatter-add, the host count is
+        # a pass the native packer already made
         by_user = device_pack(u32, i32, r32, U_pad, wu, su,
-                              assume_sorted=True)
-        by_item = device_pack(i32, u32, r32, I_pad, wi, si)
+                              assume_sorted=True, counts=counts_u)
+        by_item = device_pack(i32, u32, r32, I_pad, wi, si,
+                              counts=counts_i)
         return run_body(by_user, by_item, seed)
 
     return run_packed
 
 
 def device_pack(ent, oth, rat, n_entities: int, width: int, S: int,
-                assume_sorted: bool = False):
+                assume_sorted: bool = False, counts=None):
     """On-device COO→blocked-CSR packing (traceable; jnp throughout).
 
     Layout is bit-identical to the host packers (_pack_blocks /
@@ -431,31 +435,41 @@ def device_pack(ent, oth, rat, n_entities: int, width: int, S: int,
     ``n_entities`` are static. ``assume_sorted`` skips the stable argsort
     when the caller guarantees ``ent`` is already ascending (the
     counts-rebuilt user column is sorted by construction).
+
+    Formulated as pure GATHERS: every [S, W] slot computes which edge (if
+    any) it holds — block's entity via searchsorted over the block prefix
+    sum, position within the entity's adjacency from the block offset —
+    and gathers it, composing through the argsort permutation when the
+    input isn't pre-sorted. The scatter formulation (`.at[flat].set` over
+    the S·W slot space) measured ~3.2 s per 25M edges on v5e where the
+    gathers take ~0.3 s: scatters serialize on TPU, gathers tile.
     """
     import jax.numpy as jnp
 
-    if assume_sorted:
-        e_s, o_s, r_s = ent, oth, rat
+    if counts is None:
+        counts = jnp.bincount(ent, length=n_entities)  # order-free
     else:
-        order = jnp.argsort(ent, stable=True)
-        e_s, o_s, r_s = ent[order], oth[order], rat[order]
-    counts = jnp.bincount(e_s, length=n_entities)
+        counts = counts.astype(jnp.int32)  # caller-supplied (wire input)
     blocks = -(-counts // width)
     zero = jnp.zeros(1, counts.dtype)
-    slot_start = jnp.concatenate([zero, jnp.cumsum(blocks * width)])
-    edge_start = jnp.concatenate([zero, jnp.cumsum(counts)])
-    pos = jnp.arange(e_s.shape[0]) - edge_start[e_s]
-    flat = slot_start[e_s] + pos
-    block_other = jnp.full((S * width,), -1, jnp.int32).at[flat].set(o_s)
-    block_rating = jnp.zeros((S * width,), jnp.float32).at[flat].set(r_s)
     block_start = jnp.concatenate([zero, jnp.cumsum(blocks)])
+    edge_start = jnp.concatenate([zero, jnp.cumsum(counts)])
+
+    # per block: owning entity (padding blocks → last entity, masked out)
     bids = jnp.searchsorted(block_start[1:], jnp.arange(S), side="right")
     block_ent = jnp.minimum(bids, n_entities - 1).astype(jnp.int32)
-    return (
-        block_ent,
-        block_other.reshape(S, width),
-        block_rating.reshape(S, width),
-    )
+
+    # per slot: position within the entity's adjacency, then edge index
+    blk_in_ent = jnp.arange(S) - block_start[block_ent]  # [S]
+    pos = blk_in_ent[:, None] * width + jnp.arange(width)[None, :]
+    valid = pos < counts[block_ent][:, None]  # [S, W]
+    src = jnp.where(valid, edge_start[block_ent][:, None] + pos, 0)
+    if not assume_sorted:
+        # compose through the stable sort permutation: one fused gather
+        src = jnp.argsort(ent, stable=True)[src]
+    block_other = jnp.where(valid, oth[src], jnp.int32(-1))
+    block_rating = jnp.where(valid, rat[src], jnp.float32(0.0))
+    return block_ent, block_other, block_rating
 
 
 def train_als(
@@ -574,7 +588,7 @@ def train_als(
         # hosts where the device link is slow or shares a core with the
         # process (the tunneled-TPU case).
         counts_u, chunk_user, S_u = _counts_layout(user_idx, w_user, U_pad)
-        _, chunk_item, S_i = _counts_layout(item_idx, w_item, I_pad)
+        counts_i, chunk_item, S_i = _counts_layout(item_idx, w_item, I_pad)
         if S_u * w_user >= 2 ** 31 or S_i * w_item >= 2 ** 31:
             raise ValueError(
                 "edge set too large for int32 block addressing; "
@@ -632,7 +646,9 @@ def train_als(
                 r16.astype(np.float32), r_sorted
             ) else r_sorted
         P_f, Q_f = run(
-            counts_u.astype(np.int32), i_ship, i_hi, r_ship, seed
+            counts_u.astype(np.int32),
+            np.ascontiguousarray(counts_i, np.int32),
+            i_ship, i_hi, r_ship, seed,
         )
 
     P_f, Q_f = jax.device_get((P_f, Q_f))
