@@ -80,6 +80,21 @@ class _Stats:
         }
 
 
+def _parse_limit(params) -> Optional[int]:
+    """Shared ``limit`` query-param contract for the read routes:
+    default 20 (the reference default), ``-1`` = explicit no-limit,
+    anything below -1 or non-integer → 400."""
+    if "limit" not in params:
+        return 20
+    try:
+        limit = int(params["limit"])
+    except ValueError:
+        raise HTTPError(400, f"invalid limit {params['limit']!r}")
+    if limit < -1:
+        raise HTTPError(400, "limit must be >= -1")
+    return None if limit == -1 else limit
+
+
 class EventServerService:
     """Route handlers, separable from the HTTP loop for direct testing."""
 
@@ -90,6 +105,7 @@ class EventServerService:
         r.add("GET", "/", self.alive)
         r.add("POST", "/events\\.json", self.create_event)
         r.add("GET", "/events\\.json", self.find_events)
+        r.add("GET", "/events/search\\.json", self.search_events)
         r.add("GET", "/events/([^/]+)\\.json", self.get_event)
         r.add("DELETE", "/events/([^/]+)\\.json", self.delete_event)
         r.add("POST", "/batch/events\\.json", self.batch_events)
@@ -201,18 +217,7 @@ class EventServerService:
             except ValueError:
                 raise HTTPError(400, f"cannot parse {name}={v!r}")
 
-        limit = None
-        if "limit" in p:
-            try:
-                limit = int(p["limit"])
-            except ValueError:
-                raise HTTPError(400, f"invalid limit {p['limit']!r}")
-            if limit < -1:
-                raise HTTPError(400, "limit must be >= -1")
-            if limit == -1:
-                limit = None
-        else:
-            limit = 20  # reference default
+        limit = _parse_limit(p)
         events = Storage.get_levents().find(
             app_id,
             channel_id=channel_id,
@@ -226,6 +231,30 @@ class EventServerService:
             limit=limit,
             reversed_order=p.get("reversed", "true").lower() != "false",
         )
+        return 200, [e.to_api_dict() for e in events]
+
+    def search_events(self, req: Request):
+        """GET /events/search.json?q=<fts query> — BM25-ranked full-text
+        search, available when the event store is the searchable backend
+        (the Elasticsearch-analog capability, surfaced over REST)."""
+        app_id, channel_id, _ = self._auth(req)
+        q = req.params.get("q")
+        if not q:
+            raise HTTPError(400, "missing query param q")
+        le = Storage.get_levents()
+        if not hasattr(le, "search"):
+            raise HTTPError(
+                501,
+                "the configured event store does not support search; "
+                "set the EVENTDATA source TYPE=searchable",
+            )
+        limit = _parse_limit(req.params)
+        from pio_tpu.storage.searchable import SearchError
+
+        try:
+            events = le.search(app_id, q, channel_id=channel_id, limit=limit)
+        except SearchError as e:
+            raise HTTPError(400, str(e))
         return 200, [e.to_api_dict() for e in events]
 
     def list_plugins(self, req: Request):

@@ -68,9 +68,44 @@ def _post(url, body, timeout=30):
         return r.status, json.loads(r.read())
 
 
+#: storage-combo matrix — the reference CI ran its quickstart over
+#: backend combinations (SURVEY.md §4: "matrix over storage combos";
+#: PGSQL-everything; ES-meta + HBase-events + localfs-models). The
+#: analogs here: sqlite-everything (default), searchable-meta +
+#: native-eventlog-events + blob-models, searchable-everything.
+STORAGE_COMBOS = {
+    "default": {},
+    "es-hbase-analog": {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "ES",
+        "PIO_STORAGE_SOURCES_ES_TYPE": "elasticsearch",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "BLOB",
+        "PIO_STORAGE_SOURCES_BLOB_TYPE": "blob",
+    },
+    "searchable-everything": {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "ES",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "ES",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "ES",
+        "PIO_STORAGE_SOURCES_ES_TYPE": "searchable",
+    },
+}
+
+
 @pytest.mark.slow
-def test_full_quickstart_lifecycle(tmp_path):
+@pytest.mark.parametrize("combo", sorted(STORAGE_COMBOS))
+def test_full_quickstart_lifecycle(tmp_path, combo):
     env = _cli_env(tmp_path)
+    env.update(STORAGE_COMBOS[combo])
+    if "eventlog" in STORAGE_COMBOS[combo].values():
+        from pio_tpu.native import NativeUnavailable
+
+        try:
+            from pio_tpu.native import event_log_lib
+
+            event_log_lib()
+        except NativeUnavailable as e:
+            pytest.skip(f"native eventlog unavailable: {e}")
     procs = []
     try:
         # ---- pio app new ------------------------------------------------

@@ -161,6 +161,75 @@ class TestEventServer:
         assert len(out) == 3
         assert http("GET", f"{url}&startTime=nope")[0] == 400
 
+    def test_search_501_on_non_searchable_backend(
+        self, eventserver, app_and_key
+    ):
+        _, key = app_and_key
+        st, body = http(
+            "GET", f"{eventserver}/events/search.json?accessKey={key}&q=x"
+        )
+        assert st == 501
+        assert "searchable" in body["message"]
+
+    def test_search_route_on_searchable_backend(
+        self, tmp_home, monkeypatch, app_and_key
+    ):
+        """The ES-analog capability over REST: BM25 event search."""
+        monkeypatch.setenv(
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "ES"
+        )
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_ES_TYPE", "searchable")
+        monkeypatch.setenv(
+            "PIO_STORAGE_SOURCES_ES_PATH", str(tmp_home / "se.db")
+        )
+        Storage.reset()
+        # metadata still memory: re-mint the app/key there
+        app_id = Storage.get_meta_data_apps().insert(App(0, "search-test"))
+        key = Storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id)
+        )
+        server = create_event_server(host="127.0.0.1", port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            ev = dict(EV, properties={"genre": "dystopian scifi"})
+            st, _ = http(
+                "POST", f"{base}/events.json?accessKey={key}", ev
+            )
+            assert st == 201
+            st, body = http(
+                "GET", f"{base}/events/search.json?accessKey={key}&q=scifi"
+            )
+            assert st == 200 and len(body) == 1, body
+            assert body[0]["properties"]["genre"] == "dystopian scifi"
+            st, body = http(
+                "GET",
+                f"{base}/events/search.json?accessKey={key}&q=romance",
+            )
+            assert st == 200 and body == []
+            # malformed FTS query → 400, not a server error
+            st, body = http(
+                "GET",
+                f"{base}/events/search.json?accessKey={key}&q=AND%20AND%20(",
+            )
+            assert st == 400
+            # missing q → 400; bad key → 401; limit shares find's contract
+            st, _ = http(
+                "GET", f"{base}/events/search.json?accessKey={key}"
+            )
+            assert st == 400
+            st, _ = http(
+                "GET",
+                f"{base}/events/search.json?accessKey={key}&q=x&limit=-5",
+            )
+            assert st == 400
+            st, _ = http(
+                "GET", f"{base}/events/search.json?accessKey=bogus&q=x"
+            )
+            assert st == 401
+        finally:
+            server.stop()
+            Storage.reset()
+
     def test_stats(self, eventserver, app_and_key):
         app_id, key = app_and_key
         http("POST", f"{eventserver}/events.json?accessKey={key}", EV)
