@@ -208,7 +208,8 @@ def _bench_classification(ctx, scale: float) -> float:
     from pio_tpu.models.logreg import LogRegConfig, train_logreg
 
     n, d, c = int(100_000 * scale), 256, 10
-    iters = 30
+    iters = 100  # a realistic full-batch training length; also amortizes
+    # the one-time [N, D] feature upload like the headline's 10 iterations
     rng = np.random.default_rng(1)
     X = rng.normal(size=(n, d)).astype(np.float32)
     w_true = rng.normal(size=(d, c))
@@ -226,7 +227,7 @@ def _bench_similarproduct(ctx, scale: float) -> float:
 
     n_edges = int(5_000_000 * scale)
     n_users, n_items = int(50_000 * scale) + 64, int(20_000 * scale) + 64
-    iters = 3
+    iters = 10  # reference template default depth (see headline note)
     rng = np.random.default_rng(2)
     u = rng.integers(0, n_users, n_edges).astype(np.int32)
     i = (rng.random(n_edges) ** 2 * n_items).astype(np.int32)
@@ -282,7 +283,9 @@ def _bench_twotower(ctx, scale: float) -> float:
 
     n_pairs = int(500_000 * scale)
     n_users, n_items = int(100_000 * scale) + 64, int(50_000 * scale) + 64
-    steps, batch = 30, 4096
+    steps, batch = 200, 4096  # fixed transfer costs dominate short runs
+    # (measured ~3 ms/step vs ~1.8 s fixed); 200 steps is a realistic
+    # retrieval-training depth
     rng = np.random.default_rng(4)
     u = rng.integers(0, n_users, n_pairs).astype(np.int32)
     i = rng.integers(0, n_items, n_pairs).astype(np.int32)

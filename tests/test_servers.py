@@ -172,7 +172,7 @@ class TestEventServer:
         assert "searchable" in body["message"]
 
     def test_search_route_on_searchable_backend(
-        self, tmp_home, monkeypatch, app_and_key
+        self, tmp_home, monkeypatch
     ):
         """The ES-analog capability over REST: BM25 event search."""
         monkeypatch.setenv(
