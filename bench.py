@@ -15,19 +15,36 @@ JSON binding, plugin hooks, serving.serve and the device scorer all
 included. ``p50_inproc_ms`` keeps the round-1 in-process number for
 continuity.
 
-``secondary`` covers the remaining BASELINE.json configs:
+``phases`` decomposes the headline run (one extra profiled train, phases
+serialized): host pack seconds, wire bytes + host→device seconds, pure
+device-compute seconds, the device-only examples/sec that the tunneled
+link hides, and achieved GFLOP/s (normal-equation build term).
+
+``serving`` measures the live query server under load: sequential p50,
+then 16 concurrent clients (qps/p50/p95), then the same with the
+micro-batching aggregator coalescing concurrent queries into batched
+device dispatches (PIO_TPU_SERVE_MICROBATCH_US).
+
+``secondary`` covers the remaining BASELINE.json configs — each as
+{value, cpu_anchor, vs_baseline} with the headline's own-CPU-anchor
+discipline (same program, XLA-CPU device, subsampled workload):
   - classification      LogReg SGD (treeAggregate → psum all-reduce)
   - similarproduct      implicit ALS (MLlib trainImplicit analog)
   - textclassification  Pallas embedding-bag vs plain-XLA lowering
   - twotower            contrastive two-tower retrieval training
+plus ``als_rank_sweep`` (rank 16/64/128 MXU scaling) and
+``eventserver_events_per_sec`` (HTTP ingest into sqlite + native
+eventlog backends).
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-     "p50_predict_ms": N, "p50_inproc_ms": N, "secondary": {...}}
+     "p50_predict_ms": N, "p50_inproc_ms": N, "phases": {...},
+     "serving": {...}, "secondary": {...}}
 
 Env knobs (for smoke runs): PIO_TPU_BENCH_EDGES, PIO_TPU_BENCH_ITERS,
 PIO_TPU_BENCH_RANK, PIO_TPU_BENCH_CPU_EDGES, PIO_TPU_BENCH_QUERIES,
 PIO_TPU_BENCH_SECONDARY=0 (skip the secondary block),
+PIO_TPU_BENCH_RANKSWEEP=0 (skip the rank sweep),
 PIO_TPU_BENCH_SCALE (0<s≤1 scales every secondary workload).
 """
 
@@ -55,6 +72,16 @@ def _synth_ratings(n_edges: int, n_users: int, n_items: int, seed: int = 0):
     item_idx = (rng.random(n_edges) ** 2 * n_items).astype(np.int32)
     rating = (rng.integers(1, 11, size=n_edges) * 0.5).astype(np.float32)
     return user_idx, item_idx, rating
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def _best_of(fn, repeats=3):
@@ -103,10 +130,17 @@ def _predict_p50_inproc_ms(factors, n_users: int, n_queries: int) -> float:
 
 # ------------------------------------------------- through-server serving
 def _bench_server_p50(factors, n_users: int, n_items: int,
-                      n_queries: int) -> float:
+                      n_queries: int) -> dict:
     """Deploy the trained factors behind a real query server (storage
-    round trip included) and report HTTP POST /queries.json p50 in ms."""
-    import socket
+    round trip included) and measure HTTP ``POST /queries.json``:
+
+    - sequential p50 (single client — the round-1/2 continuity metric)
+    - concurrent load: 16 client threads → ``serving_qps`` + p50/p95
+    - the same concurrent load with the micro-batching aggregator on
+      (``PIO_TPU_SERVE_MICROBATCH_US``) — concurrent queries coalesce
+      into one batched device dispatch (``algo.batch_predict``)
+    """
+    import concurrent.futures
     import urllib.request
 
     from pio_tpu.controller import (
@@ -169,15 +203,7 @@ def _bench_server_p50(factors, n_users: int, n_items: int,
     engine, _ = build_engine(variant)
     run_train(engine, engine_params, variant)
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    server, _service = create_query_server(
-        variant, host="127.0.0.1", port=port
-    )
-    server.start()
-    try:
+    def _post_fn(port):
         url = f"http://127.0.0.1:{port}/queries.json"
 
         def post(body):
@@ -188,6 +214,53 @@ def _bench_server_p50(factors, n_users: int, n_items: int,
             with urllib.request.urlopen(req, timeout=30) as resp:
                 return json.loads(resp.read())
 
+        return post
+
+    def _concurrent_stage(post, n_threads=16, per_thread=None):
+        per_thread = per_thread or max(8, n_queries // 8)
+
+        def worker(t):
+            lats = []
+            for q in range(per_thread):
+                body = {
+                    "user": f"u{((t * per_thread + q) * 104729) % n_users}",
+                    "num": 10,
+                }
+                t0 = time.perf_counter()
+                post(body)
+                lats.append(time.perf_counter() - t0)
+            return lats
+
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(n_threads) as ex:
+            lat = [l for ls in ex.map(worker, range(n_threads)) for l in ls]
+        wall = time.perf_counter() - t0
+        ms = np.array(lat) * 1000.0
+        return {
+            "qps": round(len(lat) / wall, 1),
+            "p50_ms": round(float(np.percentile(ms, 50)), 3),
+            "p95_ms": round(float(np.percentile(ms, 95)), 3),
+        }
+
+    def _serve(microbatch_us: int):
+        port = _free_port()
+        prev = os.environ.pop("PIO_TPU_SERVE_MICROBATCH_US", None)
+        if microbatch_us:
+            os.environ["PIO_TPU_SERVE_MICROBATCH_US"] = str(microbatch_us)
+        try:
+            server, service = create_query_server(
+                variant, host="127.0.0.1", port=port
+            )
+        finally:
+            os.environ.pop("PIO_TPU_SERVE_MICROBATCH_US", None)
+            if prev is not None:
+                os.environ["PIO_TPU_SERVE_MICROBATCH_US"] = prev
+        server.start()
+        return server, service, _post_fn(port)
+
+    out = {}
+    server, _service, post = _serve(0)
+    try:
         got = post({"user": "u1", "num": 10})  # warm (compile + route)
         assert got.get("itemScores"), got
         lat = []
@@ -196,9 +269,28 @@ def _bench_server_p50(factors, n_users: int, n_items: int,
             t0 = time.perf_counter()
             post(body)
             lat.append(time.perf_counter() - t0)
-        return float(np.percentile(np.array(lat) * 1000.0, 50))
+        out["p50_ms"] = round(
+            float(np.percentile(np.array(lat) * 1000.0, 50)), 3
+        )
+        out["concurrent"] = _concurrent_stage(post)
     finally:
         server.stop()
+
+    try:
+        server, service, post = _serve(microbatch_us=1500)
+        try:
+            post({"user": "u1", "num": 10})  # warm
+            out["concurrent_microbatch"] = _concurrent_stage(post)
+            mb = service._batcher.to_dict()
+            out["concurrent_microbatch"]["avg_batch"] = round(
+                mb["batchedQueries"] / max(1, mb["batches"]), 2
+            )
+            out["concurrent_microbatch"]["max_batch"] = mb["maxBatch"]
+        finally:
+            server.stop()
+    except Exception as exc:
+        print(f"# microbatch serving stage failed: {exc}", file=sys.stderr)
+    return out
 
 
 # ------------------------------------------------------------- secondary
@@ -301,6 +393,138 @@ def _bench_twotower(ctx, scale: float) -> float:
     return steps * batch / dt
 
 
+def _bench_rank_sweep(ctx, scale: float) -> dict:
+    """ALS rank scaling {16, 64, 128}: the K²-per-edge normal-equation
+    term pushes the MXU where rank 16 is gather/transfer-bound. Reports
+    end-to-end + device-phase rates and achieved GFLOP/s (normal-equation
+    build term only, 4·K·(K+1) FLOPs per edge per iteration — solves and
+    packing excluded, so the figure is conservative)."""
+    from pio_tpu.models.als import ALSConfig, train_als
+
+    E = int(8_000_000 * scale)
+    U, I = int(80_000 * scale) + 64, int(30_000 * scale) + 64
+    iters = 4
+    rng = np.random.default_rng(7)
+    u = rng.integers(0, U, E).astype(np.int32)
+    i = (rng.random(E) ** 2 * I).astype(np.int32)
+    r = (rng.integers(1, 11, E) * 0.5).astype(np.float32)
+    out = {}
+    for rank in (16, 64, 128):
+        cfg = ALSConfig(rank=rank, iterations=iters, reg=0.1)
+        # repeats=1: the sweep is a scaling curve, not the headline — one
+        # warm timed run per rank bounds the sweep's wall-clock
+        dt, _ = _best_of(
+            lambda: train_als(ctx, u, i, r, U, I, cfg), repeats=1
+        )
+        st = {}
+        train_als(ctx, u, i, r, U, I, cfg, stats=st)
+        flops = 4 * rank * (rank + 1) * E * iters
+        out[f"rank{rank}"] = {
+            "examples_per_sec": round(E * iters / dt, 1),
+            "device_examples_per_sec": round(
+                E * iters / st["device_s"], 1
+            ),
+            "achieved_gflops": round(flops / st["device_s"] / 1e9, 1),
+        }
+    return out
+
+
+def _bench_event_ingest(scale: float) -> dict:
+    """Events/sec through a LIVE Event Server (HTTP POST, auth included):
+    single ``/events.json`` posts and ≤50-event ``/batch/events.json``
+    batches, against the sqlite event store (quickstart default) and the
+    native C++ eventlog backend (the HBase-slot store)."""
+    import urllib.request
+
+    from pio_tpu.server.event_server import create_event_server
+    from pio_tpu.storage import Storage
+    from pio_tpu.storage.records import AccessKey, App
+
+    n_single = max(50, int(300 * min(scale, 1.0)))
+    n_batches = max(4, int(20 * min(scale, 1.0)))
+    home = os.environ["PIO_TPU_HOME"]
+
+    def one_backend(backend: str) -> dict:
+        saved = {
+            k: os.environ.get(k)
+            for k in (
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE",
+                "PIO_STORAGE_SOURCES_INGEST_TYPE",
+                "PIO_STORAGE_SOURCES_INGEST_PATH",
+            )
+        }
+        os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "INGEST"
+        os.environ["PIO_STORAGE_SOURCES_INGEST_TYPE"] = backend
+        os.environ["PIO_STORAGE_SOURCES_INGEST_PATH"] = os.path.join(
+            home, f"ingest_{backend}"
+        )
+        Storage.reset()
+        try:
+            app_id = Storage.get_meta_data_apps().insert(
+                App(0, f"bench-ingest-{backend}")
+            )
+            key = Storage.get_meta_data_access_keys().insert(
+                AccessKey("", app_id)
+            )
+            server = create_event_server(
+                host="127.0.0.1", port=_free_port()
+            )
+            server.start()
+            try:
+                def post(path, body):
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}{path}?accessKey={key}",
+                        data=json.dumps(body).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        return json.loads(resp.read())
+
+                def ev(n):
+                    return {
+                        "event": "rate",
+                        "entityType": "user",
+                        "entityId": f"u{n}",
+                        "targetEntityType": "item",
+                        "targetEntityId": f"i{n % 97}",
+                        "properties": {"rating": float(n % 10) / 2.0},
+                    }
+
+                post("/events.json", ev(0))  # warm the route + store
+                t0 = time.perf_counter()
+                for n in range(n_single):
+                    post("/events.json", ev(n))
+                dt_single = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for b in range(n_batches):
+                    post("/batch/events.json",
+                         [ev(b * 50 + j) for j in range(50)])
+                dt_batch = time.perf_counter() - t0
+                return {
+                    "single_events_per_sec": round(n_single / dt_single, 1),
+                    "batch_events_per_sec": round(
+                        n_batches * 50 / dt_batch, 1
+                    ),
+                }
+            finally:
+                server.stop()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            Storage.reset()
+
+    out = {}
+    for backend in ("sqlite", "eventlog"):
+        try:
+            out[backend] = one_backend(backend)
+        except Exception as exc:
+            print(f"# ingest {backend} failed: {exc}", file=sys.stderr)
+    return out
+
+
 def main() -> None:
     # isolate the serving benchmark's storage in a throwaway home (must be
     # set before the first Storage touch; always overridden — bench junk
@@ -331,13 +555,46 @@ def main() -> None:
     ctx = ComputeContext(mesh=default_mesh(("data",), devices=devices))
     dt, factors = _time_train(ctx, u, i, r, n_users, n_items, cfg)
     rate_per_chip = n_edges * iters / dt / n_chips
+
+    # phase decomposition: one PROFILED run (already warm) with blocking
+    # between host-pack / host→device / device-compute — answers "how much
+    # of the headline is TPU and how much is the link"
+    phases = {}
+    try:
+        from pio_tpu.models.als import train_als as _train_als
+
+        st = {}
+        _train_als(ctx, u, i, r, n_users, n_items, cfg, stats=st)
+        # normal-equation build term only (4·K·(K+1) FLOPs/edge/iter);
+        # solves + packing excluded → conservative
+        flops = 4 * cfg.rank * (cfg.rank + 1) * n_edges * iters
+        phases = {
+            "pack_s": round(st["pack_s"], 3),
+            "h2d_s": round(st["h2d_s"], 3),
+            "device_s": round(st["device_s"], 3),
+            "wire_bytes": int(st["wire_bytes"]),
+            "wire_mb_per_s": round(
+                st["wire_bytes"] / st["h2d_s"] / 1e6, 1
+            ),
+            "encoding": st["encoding"],
+            "n_stream": st["n_stream"],
+            "overlapped_total_s": round(dt, 3),
+            "device_examples_per_sec": round(
+                n_edges * iters / st["device_s"], 1
+            ),
+            "achieved_gflops": round(flops / st["device_s"] / 1e9, 1),
+        }
+    except Exception as exc:
+        print(f"# phase profile failed: {exc}", file=sys.stderr)
+
     p50_inproc = _predict_p50_inproc_ms(factors, n_users, n_queries)
     try:
-        p50_server = _bench_server_p50(factors, n_users, n_items, n_queries)
+        serving = _bench_server_p50(factors, n_users, n_items, n_queries)
     except Exception as exc:  # the headline number must survive a serving
         # stack failure; report the hole rather than crash
         print(f"# server p50 failed: {exc}", file=sys.stderr)
-        p50_server = None
+        serving = {}
+    p50_server = serving.get("p50_ms")
 
     # CPU anchor: same XLA program, single host CPU device, subsampled edges.
     cpu_edges = int(os.environ.get("PIO_TPU_BENCH_CPU_EDGES",
@@ -362,21 +619,74 @@ def main() -> None:
     secondary = {}
     if os.environ.get("PIO_TPU_BENCH_SECONDARY", "1") != "0":
         sscale = float(os.environ.get("PIO_TPU_BENCH_SCALE", "1"))
-        for name, fn in (
-            ("classification_examples_per_sec",
-             lambda: _bench_classification(ctx, sscale)),
-            ("similarproduct_examples_per_sec",
-             lambda: _bench_similarproduct(ctx, sscale)),
-            ("textclassification",
-             lambda: _bench_textclass(sscale)),
-            ("twotower_examples_per_sec",
-             lambda: _bench_twotower(ctx, sscale)),
+        cpu_dev = jax.devices("cpu")[0]
+
+        def run_on_cpu(fn, frac):
+            """Own-CPU anchor: SAME program on the XLA-CPU device, with a
+            subsampled workload (rates normalize per example, so the
+            ratio is per-example speedup — the headline's anchor
+            discipline applied to every config)."""
+            with jax.default_device(cpu_dev):
+                cpu_ctx = ComputeContext(
+                    mesh=default_mesh(("data",), devices=[cpu_dev])
+                )
+                return fn(cpu_ctx, sscale * frac)
+
+        for name, fn, cpu_frac in (
+            ("classification_examples_per_sec", _bench_classification,
+             0.25),
+            ("similarproduct_examples_per_sec", _bench_similarproduct,
+             0.1),
+            ("twotower_examples_per_sec", _bench_twotower, 1.0),
         ):
             try:
-                v = fn()
-                secondary[name] = round(v, 1) if isinstance(v, float) else v
+                v = fn(ctx, sscale)
+                entry = {"value": round(v, 1)}
+                try:
+                    cv = run_on_cpu(fn, cpu_frac)
+                    entry["cpu_anchor"] = round(cv, 1)
+                    entry["vs_baseline"] = round(v / cv, 2)
+                except Exception as exc:
+                    print(f"# cpu anchor {name} failed: {exc}",
+                          file=sys.stderr)
+                secondary[name] = entry
             except Exception as exc:
                 print(f"# secondary {name} failed: {exc}", file=sys.stderr)
+
+        try:
+            tc = _bench_textclass(sscale)
+            try:
+                with jax.default_device(cpu_dev):
+                    tc_cpu = _bench_textclass(sscale * 0.25)
+                best = tc.get(
+                    "pallas_tokens_per_sec", tc["xla_tokens_per_sec"]
+                )
+                tc["cpu_anchor"] = tc_cpu["xla_tokens_per_sec"]
+                tc["vs_baseline"] = round(
+                    best / tc_cpu["xla_tokens_per_sec"], 2
+                )
+            except Exception as exc:
+                print(f"# cpu anchor textclassification failed: {exc}",
+                      file=sys.stderr)
+            secondary["textclassification"] = tc
+        except Exception as exc:
+            print(f"# secondary textclassification failed: {exc}",
+                  file=sys.stderr)
+
+        if os.environ.get("PIO_TPU_BENCH_RANKSWEEP", "1") != "0":
+            try:
+                secondary["als_rank_sweep"] = _bench_rank_sweep(
+                    ctx, sscale
+                )
+            except Exception as exc:
+                print(f"# rank sweep failed: {exc}", file=sys.stderr)
+
+        try:
+            secondary["eventserver_events_per_sec"] = _bench_event_ingest(
+                sscale
+            )
+        except Exception as exc:
+            print(f"# event ingest failed: {exc}", file=sys.stderr)
 
     vs_baseline = rate_per_chip / cpu_rate if cpu_rate else 1.0
     out = {
@@ -390,6 +700,12 @@ def main() -> None:
             round(p50_server, 3) if p50_server is not None else None
         ),
         "p50_inproc_ms": round(p50_inproc, 3),
+        # phase decomposition of the headline (pack / link / device) +
+        # the device-only rate the tunnel hides
+        "phases": phases,
+        # serving under concurrent load (16 clients): qps/p50/p95, with
+        # and without the micro-batching aggregator
+        "serving": serving,
         "secondary": secondary,
     }
     print(json.dumps(out))

@@ -42,6 +42,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -187,18 +188,15 @@ def _pack_blocks(
     )
 
 
-@functools.lru_cache(maxsize=32)
-def _build_trainer(mesh, axis: str, iterations: int, reg: float,
-                   implicit: bool, alpha: float,
-                   chunk_user: int, chunk_item: int,
-                   matmul_dtype: str = "bfloat16", solver: str = "cg",
-                   packed_shapes=None, rank: int = 0,
-                   U_pad: int = 0, I_pad: int = 0):
-    """Jitted ALS trainer for one (mesh, static-config) combination.
+def _make_math(reg: float, implicit: bool, alpha: float,
+               matmul_dtype: str, solver: str, rating_wire: str = "f32"):
+    """Shared jittable ALS math: blocked normal-equation accumulation, the
+    batched solvers, and the wire decode. Closed over the static config and
+    used by BOTH the monolithic trainer (:func:`_build_trainer`) and the
+    streamed trainer (:func:`_build_stream_trainer`) so the two paths
+    cannot drift apart numerically."""
+    import types
 
-    The returned function takes the two packed-block layouts + initial
-    factors; shapes specialize inside jax.jit's own cache.
-    """
     import jax
     import jax.numpy as jnp
 
@@ -325,6 +323,63 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
             return jnp.einsum("ik,il->kl", factors, factors)
         return jnp.zeros((factors.shape[1], factors.shape[1]), jnp.float32)
 
+    def half_local(blocks, factors, n_entities, chunk):
+        """One single-device half-step from a blocked layout."""
+        A, b = partial_normal_eq(*blocks, factors, n_entities, chunk)
+        return solve_block(A, b, gram_of(factors))
+
+    def decode_items(i_lo, i_hi):
+        """Wire → int32 item ids (uint16 plane + optional uint8 high)."""
+        i32 = i_lo.astype(jnp.int32)
+        if i_hi.shape[0]:
+            i32 = i32 | (i_hi.astype(jnp.int32) << 16)
+        return i32
+
+    def decode_ratings(r, n_edges):
+        """Wire → float32 ratings per the static ``rating_wire`` kind:
+        ``u4`` nibble-packed half-star codes (2 edges/byte), ``u8``
+        half-star codes, ``f16``/``f32`` raw floats."""
+        if rating_wire == "u4":
+            lo = (r & 0xF).astype(jnp.float32)
+            hi = (r >> 4).astype(jnp.float32)
+            pairs = jnp.stack([lo, hi], axis=1).reshape(-1)
+            return pairs[:n_edges] * jnp.float32(0.5)
+        if rating_wire == "u8":
+            return r.astype(jnp.float32) * jnp.float32(0.5)
+        return r.astype(jnp.float32)
+
+    return types.SimpleNamespace(
+        partial_normal_eq=partial_normal_eq,
+        solve_block=solve_block,
+        gram_of=gram_of,
+        half_local=half_local,
+        decode_items=decode_items,
+        decode_ratings=decode_ratings,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _build_trainer(mesh, axis: str, iterations: int, reg: float,
+                   implicit: bool, alpha: float,
+                   chunk_user: int, chunk_item: int,
+                   matmul_dtype: str = "bfloat16", solver: str = "cg",
+                   packed_shapes=None, rank: int = 0,
+                   U_pad: int = 0, I_pad: int = 0,
+                   rating_wire: str = "f32"):
+    """Jitted ALS trainer for one (mesh, static-config) combination.
+
+    The returned function takes the two packed-block layouts + initial
+    factors; shapes specialize inside jax.jit's own cache.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    math = _make_math(reg, implicit, alpha, matmul_dtype, solver,
+                      rating_wire)
+    partial_normal_eq = math.partial_normal_eq
+    solve_block = math.solve_block
+    gram_of = math.gram_of
+
     if mesh is not None and mesh.shape[axis] > 1:
         from jax.sharding import PartitionSpec as P
 
@@ -396,19 +451,14 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
 
     @jax.jit
     def run_packed(counts_u, counts_i, i_lo, i_hi, r, seed):
-        # wire decode (all static dtype dispatch):
+        # wire decode (all static dispatch on the rating_wire kind):
         #   item ids < 2^16 arrive uint16; < 2^24 as uint16 low plane +
         #   uint8 high plane (i_hi; zero-size when unused)
-        #   ratings: uint8 = half-star code (2× the value), else fp16
-        #   when that cast was lossless, else f32
-        i32 = i_lo.astype(jnp.int32)
-        if i_hi.shape[0]:
-            i32 = i32 | (i_hi.astype(jnp.int32) << 16)
-        if r.dtype == jnp.uint8:
-            r32 = r.astype(jnp.float32) * jnp.float32(0.5)
-        else:
-            r32 = r.astype(jnp.float32)
+        #   ratings: u4 nibble-packed half-star codes (2 edges/byte) when
+        #   every code ≤ 15, u8 codes, else fp16/f32 raw
         E = i_lo.shape[0]
+        i32 = math.decode_items(i_lo, i_hi)
+        r32 = math.decode_ratings(r, E)
         u32 = jnp.repeat(
             jnp.arange(U_pad, dtype=jnp.int32), counts_u,
             total_repeat_length=E,
@@ -425,8 +475,116 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
     return run_packed
 
 
+@functools.lru_cache(maxsize=16)
+def _build_stream_trainer(iterations: int, reg: float, implicit: bool,
+                          alpha: float, matmul_dtype: str, solver: str,
+                          rank: int, U_pad: int, I_pad: int,
+                          w_user: int, w_item: int, S_item: int,
+                          chunk_stream: int, chunk_item: int,
+                          rating_wire: str, chunk_spec: tuple):
+    """Double-buffered single-device trainer: the wire arrays arrive in
+    ``len(chunk_spec)`` slices and each slice's by-user block pack + its
+    contribution to iteration 1's user-side normal equations run WHILE the
+    next slice is still crossing the host↔device link (the queued
+    ``device_put``s ride the transfer stream; each chunk program only waits
+    on its own inputs). ``chunk_spec`` is a tuple of per-chunk
+    ``(S_c, pad_entity, first_user)``: the chunk's static padded block
+    count, the entity its padding blocks alias (the chunk's LAST user,
+    which keeps the concatenated block layout globally ascending for the
+    segment-sum sorted fast path), and the first user present (the sliced
+    local-counts offset).
+
+    The finalize program concatenates the chunk-local block layouts into
+    the full by-user layout (no repack), solves P1 from the streamed
+    normal equations, packs the item side, and runs the remaining
+    iterations. Numerically this differs from the monolithic path only in
+    iteration-1 accumulation grouping (float reduction order)."""
+    import jax
+    import jax.numpy as jnp
+
+    math = _make_math(reg, implicit, alpha, matmul_dtype, solver,
+                      rating_wire)
+
+    @jax.jit
+    def init(seed):
+        # same key split as run_body: ku (P_init) is unused — the first
+        # half-step overwrites P — so only Q0 must match the monolithic
+        # trainer's draw
+        ku, ki = jax.random.split(jax.random.PRNGKey(seed))
+        del ku
+        Q0 = jax.random.normal(ki, (I_pad, rank), jnp.float32) * 0.01
+        A0 = jnp.zeros((U_pad, rank, rank), jnp.float32)
+        b0 = jnp.zeros((U_pad, rank), jnp.float32)
+        return Q0, A0, b0
+
+    def _make_accum(S_c: int, pad_c: int, u0_c: int):
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def accum(A, b, Q0, local_counts, i_lo, i_hi, r):
+            E_c = i_lo.shape[0]
+            i32 = math.decode_items(i_lo, i_hi)
+            r32 = math.decode_ratings(r, E_c)
+            # local_counts arrives sliced to the chunk's present-user span
+            # [u0_c, pad_c] (ships span·4 B instead of U_pad·4 B per
+            # chunk); expand to full length on device
+            lc_full = jax.lax.dynamic_update_slice(
+                jnp.zeros(U_pad, jnp.int32),
+                local_counts.astype(jnp.int32), (u0_c,),
+            )
+            blocks = device_pack(
+                None, i32, r32, U_pad, w_user, S_c,
+                assume_sorted=True, counts=lc_full, pad_entity=pad_c,
+            )
+            dA, db = math.partial_normal_eq(
+                *blocks, Q0, U_pad, chunk_stream
+            )
+            return A + dA, b + db, blocks
+
+        return accum
+
+    accums = tuple(_make_accum(*spec) for spec in chunk_spec)
+
+    @jax.jit
+    def finalize(A, b, Q0, counts_u, counts_i, user_blocks, wire_chunks):
+        # full by-user layout = concat of the chunk-local packs (padding
+        # aliases each chunk's last user, so ids stay ascending)
+        by_user = tuple(
+            jnp.concatenate([blk[k] for blk in user_blocks])
+            for k in range(3)
+        )
+        # item side needs the full COO: re-decode the (device-resident)
+        # wire chunks — elementwise, cheap — and pack by item
+        i32 = jnp.concatenate(
+            [math.decode_items(lo, hi) for lo, hi, _ in wire_chunks]
+        )
+        r32 = jnp.concatenate(
+            [math.decode_ratings(r, lo.shape[0])
+             for lo, hi, r in wire_chunks]
+        )
+        E = i32.shape[0]
+        u32 = jnp.repeat(
+            jnp.arange(U_pad, dtype=jnp.int32), counts_u,
+            total_repeat_length=E,
+        )
+        by_item = device_pack(i32, u32, r32, I_pad, w_item, S_item,
+                              counts=counts_i)
+        # iteration 1: user half is already accumulated (streamed)
+        P = math.solve_block(A, b, math.gram_of(Q0))
+        Q = math.half_local(by_item, P, I_pad, chunk_item)
+
+        def iteration(_, PQ):
+            P, Q = PQ
+            P = math.half_local(by_user, Q, U_pad, chunk_stream)
+            Q = math.half_local(by_item, P, I_pad, chunk_item)
+            return (P, Q)
+
+        return jax.lax.fori_loop(0, iterations - 1, iteration, (P, Q))
+
+    return init, accums, finalize
+
+
 def device_pack(ent, oth, rat, n_entities: int, width: int, S: int,
-                assume_sorted: bool = False, counts=None):
+                assume_sorted: bool = False, counts=None,
+                pad_entity=None):
     """On-device COO→blocked-CSR packing (traceable; jnp throughout).
 
     Layout is bit-identical to the host packers (_pack_blocks /
@@ -443,6 +601,12 @@ def device_pack(ent, oth, rat, n_entities: int, width: int, S: int,
     input isn't pre-sorted. The scatter formulation (`.at[flat].set` over
     the S·W slot space) measured ~3.2 s per 25M edges on v5e where the
     gathers take ~0.3 s: scatters serialize on TPU, gathers tile.
+
+    ``pad_entity`` redirects the padding blocks' (masked) entity id —
+    the streamed trainer points them at a chunk's LAST present entity so
+    concatenated chunk layouts stay globally ascending. Only valid when
+    no real block belongs to an entity beyond it. ``ent`` may be ``None``
+    when ``counts`` is supplied with ``assume_sorted`` (it is unused).
     """
     import jax.numpy as jnp
 
@@ -455,9 +619,10 @@ def device_pack(ent, oth, rat, n_entities: int, width: int, S: int,
     block_start = jnp.concatenate([zero, jnp.cumsum(blocks)])
     edge_start = jnp.concatenate([zero, jnp.cumsum(counts)])
 
-    # per block: owning entity (padding blocks → last entity, masked out)
+    # per block: owning entity (padding blocks → pad_entity, masked out)
+    pad_tgt = (n_entities - 1) if pad_entity is None else pad_entity
     bids = jnp.searchsorted(block_start[1:], jnp.arange(S), side="right")
-    block_ent = jnp.minimum(bids, n_entities - 1).astype(jnp.int32)
+    block_ent = jnp.minimum(bids, pad_tgt).astype(jnp.int32)
 
     # per slot: position within the entity's adjacency, then edge index
     blk_in_ent = jnp.arange(S) - block_start[block_ent]  # [S]
@@ -472,6 +637,121 @@ def device_pack(ent, oth, rat, n_entities: int, width: int, S: int,
     return block_ent, block_other, block_rating
 
 
+def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
+                  w_user: int, w_item: int, S_item: int, chunk_item: int,
+                  counts_u: np.ndarray, counts_i: np.ndarray,
+                  i_ship: np.ndarray, i_hi: np.ndarray,
+                  r_ship: np.ndarray, rating_wire: str,
+                  n_stream: int, seed, stats: Optional[dict]):
+    """Dispatch the double-buffered single-device training run.
+
+    Slices the user-sorted wire arrays into ``n_stream`` edge spans,
+    queues every span's ``device_put`` up front (async — they drain on the
+    transfer stream in order), then chains the per-chunk accumulate
+    programs: chunk k's pack + normal-equation accumulation executes while
+    chunk k+1 is still crossing the link. With ``stats`` the phases are
+    serialized (block between h2d and compute) to measure them — overlap
+    off. Chunk boundaries are even so u4 nibble-packed ratings split on
+    byte boundaries.
+    """
+    import jax
+
+    E = i_ship.shape[0]
+    edge_start = np.zeros(U_pad + 1, np.int64)
+    np.cumsum(counts_u, out=edge_start[1:])
+    bounds = [min(E, (E * c // n_stream) // 2 * 2)
+              for c in range(n_stream)] + [E]
+    spans = [(bounds[c], bounds[c + 1]) for c in range(n_stream)
+             if bounds[c + 1] > bounds[c]]
+
+    local_slices, n_blocks, chunk_spec = [], [], []
+    for e0, e1 in spans:
+        lc = np.diff(np.clip(edge_start, e0, e1))
+        u0 = int(np.searchsorted(edge_start, e0, side="right")) - 1
+        pad_c = int(np.searchsorted(edge_start, e1 - 1, side="right")) - 1
+        local_slices.append(
+            np.ascontiguousarray(lc[u0:pad_c + 1], np.int32)
+        )
+        n_blocks.append(int((-(-lc // w_user)).sum()))
+        chunk_spec.append([0, pad_c, u0])  # S_c filled below
+    chunk_stream = min(
+        config.blocks_per_chunk,
+        _round_up(max(1, -(-sum(n_blocks) // len(spans))), 8),
+    )
+    for spec, nb in zip(chunk_spec, n_blocks):
+        spec[0] = _round_up(max(nb, 1), chunk_stream)
+
+    init, accums, finalize = _build_stream_trainer(
+        config.iterations, float(config.reg), bool(config.implicit),
+        float(config.alpha), str(config.matmul_dtype), str(config.solver),
+        rank, U_pad, I_pad, w_user, w_item, S_item,
+        chunk_stream, chunk_item, rating_wire,
+        tuple(tuple(s) for s in chunk_spec),
+    )
+
+    t0 = time.perf_counter()
+    wire_dev, lc_dev = [], []
+    for (e0, e1), lc in zip(spans, local_slices):
+        r_c = (r_ship[e0 // 2:(e1 + 1) // 2] if rating_wire == "u4"
+               else r_ship[e0:e1])
+        hi_c = i_hi[e0:e1] if i_hi.shape[0] else i_hi
+        wire_dev.append((
+            jax.device_put(i_ship[e0:e1]),
+            jax.device_put(hi_c),
+            jax.device_put(r_c),
+        ))
+        lc_dev.append(jax.device_put(lc))
+    cu_dev = jax.device_put(counts_u.astype(np.int32))
+    ci_dev = jax.device_put(np.ascontiguousarray(counts_i, np.int32))
+    if stats is not None:
+        jax.block_until_ready((wire_dev, lc_dev, cu_dev, ci_dev))
+        stats["h2d_s"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+
+    Q0, A, b = init(seed)
+    user_blocks = []
+    for acc, lc, wire in zip(accums, lc_dev, wire_dev):
+        A, b, blk = acc(A, b, Q0, lc, *wire)
+        user_blocks.append(blk)
+    P_f, Q_f = finalize(A, b, Q0, cu_dev, ci_dev,
+                        tuple(user_blocks), tuple(wire_dev))
+    if stats is not None:
+        jax.block_until_ready((P_f, Q_f))
+        stats["device_s"] = time.perf_counter() - t0
+    return P_f, Q_f
+
+
+def _nibble_pack(codes: np.ndarray) -> np.ndarray:
+    """Pack uint8 codes ≤ 15 two-per-byte: byte k = edge 2k (low nibble)
+    | edge 2k+1 (high nibble). Mirrors ``decode_ratings('u4')``."""
+    n = len(codes)
+    if n % 2:
+        codes = np.concatenate([codes, np.zeros(1, np.uint8)])
+    pair = codes.reshape(-1, 2)
+    return (pair[:, 0] | (pair[:, 1] << 4)).astype(np.uint8)
+
+
+def _encode_ratings(r_sorted: np.ndarray) -> Tuple[np.ndarray, str]:
+    """Choose the densest lossless rating wire format.
+
+    Returns ``(wire array, kind)`` where kind ∈ {u4, u8, f16, f32}:
+    nibble-packed half-star codes (2 edges/byte — MovieLens's 0.5..5.0
+    grid and implicit r=1 both qualify), byte codes to 127.5 stars, fp16
+    when that cast is exact, else raw f32. The decode lives in
+    ``_make_math.decode_ratings``; every kind round-trips exactly.
+    """
+    r2 = r_sorted * np.float32(2.0)
+    if r2.size and np.all(r2 == np.round(r2)) and float(r2.min()) >= 0.0:
+        if float(r2.max()) <= 15.0:
+            return _nibble_pack(r2.astype(np.uint8)), "u4"
+        if float(r2.max()) <= 255.0:
+            return r2.astype(np.uint8), "u8"
+    r16 = r_sorted.astype(np.float16)
+    if np.array_equal(r16.astype(np.float32), r_sorted):
+        return r16, "f16"
+    return r_sorted, "f32"
+
+
 def train_als(
     ctx: ComputeContext,
     user_idx: np.ndarray,
@@ -480,11 +760,18 @@ def train_als(
     n_users: int,
     n_items: int,
     config: ALSConfig = ALSConfig(),
+    stats: Optional[dict] = None,
 ) -> ALSFactors:
     """Train ALS over the context's mesh (or a single device).
 
     Entity counts are padded to mesh multiples; factor rows beyond the true
     counts are dropped on the way out.
+
+    ``stats``, when a dict, is filled with a per-phase breakdown —
+    ``{pack_s, wire_bytes, encoding, n_stream, h2d_s, device_s}`` — by
+    BLOCKING between the host-pack / host→device / device-compute phases.
+    That serialization disables the streamed path's transfer/compute
+    overlap, so pass ``stats`` only on profiling runs, not timed ones.
     """
     import jax
     import jax.numpy as jnp
@@ -558,7 +845,7 @@ def train_als(
 
     seed = np.uint32(config.seed)
 
-    def _trainer(chunk_user, chunk_item, packed_shapes):
+    def _trainer(chunk_user, chunk_item, packed_shapes, rating_wire="f32"):
         # one call site for the long positional signature so the mesh and
         # single-device branches can never drift apart
         return _build_trainer(
@@ -566,10 +853,11 @@ def train_als(
             bool(config.implicit), float(config.alpha),
             chunk_user, chunk_item,
             str(config.matmul_dtype), str(config.solver),
-            packed_shapes, K, U_pad, I_pad,
+            packed_shapes, K, U_pad, I_pad, rating_wire,
         )
 
     if n_shards > 1:
+        t0 = time.perf_counter()
         by_user, chunk_user = _layout(user_idx, item_idx, w_user, U_pad)
         by_item, chunk_item = _layout(item_idx, user_idx, w_item, I_pad)
         run = _trainer(chunk_user, chunk_item, None)
@@ -580,13 +868,32 @@ def train_als(
             jax.device_put(t[1], blk2),
             jax.device_put(t[2], blk2),
         )
-        P_f, Q_f = run(put_blocks(by_user), put_blocks(by_item), seed)
+        if stats is not None:
+            stats["pack_s"] = time.perf_counter() - t0
+            stats["wire_bytes"] = sum(
+                a.nbytes for t in (by_user, by_item) for a in t
+            )
+            stats["encoding"] = "blocked-f32"
+            stats["n_stream"] = 1
+            t0 = time.perf_counter()
+            u_dev, i_dev = put_blocks(by_user), put_blocks(by_item)
+            jax.block_until_ready((u_dev, i_dev))
+            stats["h2d_s"] = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            P_f, Q_f = run(u_dev, i_dev, seed)
+            jax.block_until_ready((P_f, Q_f))
+            stats["device_s"] = time.perf_counter() - t0
+        else:
+            P_f, Q_f = run(put_blocks(by_user), put_blocks(by_item), seed)
     else:
         # Single-device path: ship the COO edges pre-sorted by user (see
         # _build_trainer's COO variant for the wire format) and let the
         # jitted trainer build both blocked layouts on device. Crucial on
         # hosts where the device link is slow or shares a core with the
-        # process (the tunneled-TPU case).
+        # process (the tunneled-TPU case). Above a wire-size threshold the
+        # shipment is STREAMED in chunks overlapped with the chunk packs +
+        # iteration-1 accumulation (_build_stream_trainer).
+        t0 = time.perf_counter()
         counts_u, chunk_user, S_u = _counts_layout(user_idx, w_user, U_pad)
         counts_i, chunk_item, S_i = _counts_layout(item_idx, w_item, I_pad)
         if S_u * w_user >= 2 ** 31 or S_i * w_item >= 2 ** 31:
@@ -594,7 +901,6 @@ def train_als(
                 "edge set too large for int32 block addressing; "
                 "use a multi-device mesh"
             )
-        run = _trainer(chunk_user, chunk_item, (S_u, w_user, S_i, w_item))
 
         # stable sort by user: native counting sort, numpy argsort fallback
         counts_u = np.ascontiguousarray(counts_u, np.int64)
@@ -627,29 +933,51 @@ def train_als(
             return idx, none
 
         i_ship, i_hi = _planes(i_sorted, I_pad)
-        # ratings: uint8 half-star codes when the grid allows (MovieLens's
-        # 0.5..5.0 stars and implicit r=1 both do), else fp16 when
-        # lossless, else f32
-        r2 = r_sorted * np.float32(2.0)
-        if (
-            r2.size == 0
-            or (
-                np.all(r2 == np.round(r2))
-                and r2.min() >= 0.0
-                and r2.max() <= 255.0
+        r_ship, rating_wire = _encode_ratings(r_sorted)
+        edge_bytes = i_ship.nbytes + i_hi.nbytes + r_ship.nbytes
+        if stats is not None:
+            stats["pack_s"] = time.perf_counter() - t0
+            stats["wire_bytes"] = (
+                edge_bytes + 4 * (U_pad + I_pad)  # + the two count arrays
             )
-        ):
-            r_ship = r2.astype(np.uint8)
+            stats["encoding"] = rating_wire
+
+        # stream threshold: chunked double-buffered shipment once the edge
+        # wire exceeds ~one chunk (default 8 MiB); tiny runs keep the
+        # single-dispatch path
+        stream_mb = float(os.environ.get("PIO_TPU_ALS_STREAM_MB", "8"))
+        n_stream = int(min(
+            8, -(-edge_bytes // max(1, int(stream_mb * 2 ** 20)))
+        ))
+        if stats is not None:
+            stats["n_stream"] = max(1, n_stream)
+        if n_stream > 1:
+            P_f, Q_f = _run_streamed(
+                config, K, U_pad, I_pad, w_user, w_item, S_i, chunk_item,
+                counts_u, counts_i, i_ship, i_hi, r_ship, rating_wire,
+                n_stream, seed, stats,
+            )
         else:
-            r16 = r_sorted.astype(np.float16)
-            r_ship = r16 if np.array_equal(
-                r16.astype(np.float32), r_sorted
-            ) else r_sorted
-        P_f, Q_f = run(
-            counts_u.astype(np.int32),
-            np.ascontiguousarray(counts_i, np.int32),
-            i_ship, i_hi, r_ship, seed,
-        )
+            run = _trainer(
+                chunk_user, chunk_item, (S_u, w_user, S_i, w_item),
+                rating_wire,
+            )
+            args = (
+                counts_u.astype(np.int32),
+                np.ascontiguousarray(counts_i, np.int32),
+                i_ship, i_hi, r_ship,
+            )
+            if stats is not None:
+                t0 = time.perf_counter()
+                args = tuple(jax.device_put(a) for a in args)
+                jax.block_until_ready(args)
+                stats["h2d_s"] = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                P_f, Q_f = run(*args, seed)
+                jax.block_until_ready((P_f, Q_f))
+                stats["device_s"] = time.perf_counter() - t0
+            else:
+                P_f, Q_f = run(*args, seed)
 
     P_f, Q_f = jax.device_get((P_f, Q_f))
     return ALSFactors(

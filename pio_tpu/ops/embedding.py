@@ -201,11 +201,26 @@ def _use_pallas(table) -> bool:
     # Mosaic single-row DMA slices must be lane-aligned: D % 128. Smaller
     # tables are cheap XLA gathers anyway (they fit VMEM).
     try:
-        return (
-            jax.default_backend() == "tpu" and table.shape[1] % 128 == 0
-        )
-    except Exception:  # pragma: no cover
-        return False
+        if table.shape[1] % 128 != 0:
+            return False
+        # a committed concrete array knows its platform — a CPU-resident
+        # table under jax.default_device(cpu) must NOT take the Mosaic
+        # path even when the process default backend is TPU (the bench's
+        # own-CPU anchor runs exactly that way)
+        devs = getattr(table, "devices", None)
+        if callable(devs):
+            ds = devs()
+            if ds:
+                return next(iter(ds)).platform == "tpu"
+        return jax.default_backend() == "tpu"
+    except Exception:  # tracers under jit: fall back to the backend
+        try:
+            return (
+                jax.default_backend() == "tpu"
+                and table.shape[1] % 128 == 0
+            )
+        except Exception:  # pragma: no cover
+            return False
 
 
 # ------------------------------------------------------------------- public

@@ -163,6 +163,16 @@ class DeviceTopNScorer:
         self.n_cols = cols.shape[0]
         self._rows_np = rows
         self._cols_np = cols
+        self._rows_dev = self._cols_dev = None
+
+        if self.n_rows == 0 or self.n_cols == 0:
+            # degenerate factor tables cannot be probed (the host-row
+            # probe would index row 0) and have nothing to score on the
+            # accelerator; every call takes the host path, whose public
+            # methods handle the empty dimensions explicitly
+            self.min_device_batch = float("inf")
+            self.min_pair_batch = float("inf")
+            return
 
         if prefer_device is True:
             mode = "device"
@@ -170,7 +180,6 @@ class DeviceTopNScorer:
             mode = "host"
         else:
             mode = _env_mode()
-        self._rows_dev = self._cols_dev = None
         if mode == "host":
             self.min_device_batch = float("inf")
             self.min_pair_batch = float("inf")
@@ -293,8 +302,10 @@ class DeviceTopNScorer:
             exclude = np.asarray(exclude, np.int32)
             if exclude.ndim != 2 or exclude.shape[0] != codes.shape[0]:
                 raise ValueError("exclude must be [B, E]")
-        if codes.shape[0] == 0:
-            return (np.empty((0, n), np.int64), np.empty((0, n), np.float32))
+        if codes.shape[0] == 0 or self.n_cols == 0:
+            b = codes.shape[0]
+            n = 0 if self.n_cols == 0 else n
+            return (np.empty((b, n), np.int64), np.empty((b, n), np.float32))
         if self._route_to_device(codes.shape[0]):
             return self._top_n_device(codes, n, exclude)
         return self._top_n_host(codes, n, exclude)

@@ -1,0 +1,111 @@
+"""Blob daemon — the remote Models endpoint filling the HDFS/S3 slot.
+
+The reference's HDFS/S3 model stores (``storage/hdfs/.../HDFSModels.scala``,
+``storage/s3/.../S3Models.scala`` — UNVERIFIED paths; SURVEY.md §2.3) put
+model artifacts behind a NETWORK service. This daemon is that service for
+the TPU rebuild: a flat key → bytes store served over HTTP from a local
+root, consumed by the ``http://`` scheme registered in
+``pio_tpu.storage.blobstore`` — so a training host can persist models to a
+storage host and a serving host can load them, with nothing shared but a
+socket. Content addressing, digest verification, dedupe, and ref-count GC
+all live in the client (:class:`~pio_tpu.storage.blobstore.BlobModels`);
+the daemon stays a dumb byte store, exactly like S3/HDFS under the
+reference's stores.
+
+Routes (keys are percent-encoded path remainders; bodies are raw bytes):
+
+    GET    /blobs/<key>      blob bytes | 404
+    HEAD   /blobs/<key>      existence probe
+    PUT    /blobs/<key>      store body bytes (201)
+    DELETE /blobs/<key>      200 | 404
+    GET    /keys?prefix=p    JSON list of keys under a prefix
+    GET    /                 health/info
+
+Auth: optional shared key — ``create_blob_server(..., access_key=...)``
+requires ``Authorization: Bearer <key>`` (or ``?accessKey=``) on every
+route. TLS via the shared ``PIO_TPU_SSL_*`` env (server/http.py).
+
+Start one with the CLI: ``python -m pio_tpu blobserver --root /var/blobs``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+from urllib.parse import unquote
+
+from pio_tpu.server.http import (
+    HTTPError, JsonHTTPServer, RawResponse, Request, Router,
+)
+from pio_tpu.storage.blobstore import FileBlobBackend
+
+
+class BlobServerService:
+    """Route handlers over a :class:`FileBlobBackend` root."""
+
+    def __init__(self, root: str, access_key: Optional[str] = None):
+        self.backend = FileBlobBackend(root)
+        self.access_key = access_key
+        self.router = Router()
+        r = self.router
+        r.add("GET", "/", self.info)
+        r.add("GET", "/blobs/(.+)", self.get_blob)
+        r.add("HEAD", "/blobs/(.+)", self.head_blob)
+        r.add("PUT", "/blobs/(.+)", self.put_blob)
+        r.add("DELETE", "/blobs/(.+)", self.delete_blob)
+        r.add("GET", "/keys", self.list_keys)
+
+    def _auth(self, req: Request) -> None:
+        if self.access_key is not None and req.bearer_key() != self.access_key:
+            raise HTTPError(401, "invalid accessKey")
+
+    @staticmethod
+    def _key(req: Request) -> str:
+        key = unquote(req.path_args[0])
+        if not key or key.startswith("/") or ".." in key.split("/"):
+            raise HTTPError(400, f"invalid blob key {key!r}")
+        return key
+
+    def info(self, req: Request):
+        self._auth(req)
+        return 200, {"status": "alive", "service": "pio-tpu-blobserver"}
+
+    def get_blob(self, req: Request):
+        self._auth(req)
+        data = self.backend.get(self._key(req))
+        if data is None:
+            raise HTTPError(404, "no such blob")
+        return 200, RawResponse(data, "application/octet-stream")
+
+    def head_blob(self, req: Request):
+        self._auth(req)
+        if not self.backend.exists(self._key(req)):
+            raise HTTPError(404, "no such blob")
+        return 200, None
+
+    def put_blob(self, req: Request):
+        self._auth(req)
+        self.backend.put(self._key(req), req.raw_body)
+        return 201, {"stored": len(req.raw_body)}
+
+    def delete_blob(self, req: Request):
+        self._auth(req)
+        if not self.backend.delete(self._key(req)):
+            raise HTTPError(404, "no such blob")
+        return 200, {"deleted": True}
+
+    def list_keys(self, req: Request):
+        self._auth(req)
+        return 200, {"keys": self.backend.list(req.params.get("prefix", ""))}
+
+
+def create_blob_server(
+    root: str,
+    host: str = "0.0.0.0",
+    port: int = 7088,
+    access_key: Optional[str] = None,
+) -> JsonHTTPServer:
+    """Build an (unstarted) blob daemon serving ``root`` over HTTP."""
+    service = BlobServerService(root, access_key=access_key)
+    return JsonHTTPServer(
+        service.router, host, port, name="pio-tpu-blobserver"
+    )

@@ -51,9 +51,10 @@ class Request:
 
 @dataclass
 class RawResponse:
-    """Non-JSON handler output (HTML pages, plain text, extra headers)."""
+    """Non-JSON handler output (HTML pages, plain text, raw bytes — the
+    blob daemon serves binary model artifacts — plus extra headers)."""
 
-    body: str
+    body: Any  # str or bytes
     content_type: str = "text/html; charset=UTF-8"
     headers: Dict[str, str] = field(default_factory=dict)
 
@@ -97,15 +98,22 @@ def _make_handler_class(router: Router, server_name: str):
             log.debug("%s %s", self.address_string(), fmt % args)
 
         def _respond(self, status: int, body: Any):
+            # HEAD must carry Content-Length but NO body bytes — writing
+            # them would desync keep-alive clients (RFC 9110 §9.3.2)
+            head = self.command == "HEAD"
             if isinstance(body, RawResponse):
-                payload = body.body.encode()
+                payload = (
+                    body.body if isinstance(body.body, bytes)
+                    else body.body.encode()
+                )
                 self.send_response(status)
                 self.send_header("Content-Type", body.content_type)
                 self.send_header("Content-Length", str(len(payload)))
                 for k, v in body.headers.items():
                     self.send_header(k, v)
                 self.end_headers()
-                self.wfile.write(payload)
+                if not head:
+                    self.wfile.write(payload)
                 return
             try:
                 payload = json.dumps(body).encode() if body is not None else b""
@@ -119,7 +127,7 @@ def _make_handler_class(router: Router, server_name: str):
             self.send_header("Content-Type", "application/json; charset=UTF-8")
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
-            if payload:
+            if payload and not head:
                 self.wfile.write(payload)
 
         def _handle(self, method: str):
@@ -136,7 +144,10 @@ def _make_handler_class(router: Router, server_name: str):
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length) if length else b""
             body = None
-            if raw:
+            ctype = (self.headers.get("Content-Type") or "").lower()
+            if raw and ctype.startswith("application/octet-stream"):
+                pass  # binary upload (blob daemon): no decode attempt
+            elif raw:
                 # Try JSON regardless of Content-Type — real clients (curl
                 # -d without -H) post JSON bodies under the default form
                 # type. Non-JSON bodies stay raw strings; handlers that
@@ -175,6 +186,12 @@ def _make_handler_class(router: Router, server_name: str):
 
         def do_POST(self):
             self._handle("POST")
+
+        def do_PUT(self):
+            self._handle("PUT")
+
+        def do_HEAD(self):
+            self._handle("HEAD")
 
         def do_DELETE(self):
             self._handle("DELETE")
@@ -218,6 +235,10 @@ class _TLSThreadingHTTPServer(ThreadingHTTPServer):
 
     ssl_ctx: Optional[ssl.SSLContext] = None
     handshake_timeout = 30.0
+    #: socketserver's default listen backlog is 5 — a 16-client burst
+    #: overflows it and the dropped SYNs retransmit after ~1 s, which
+    #: shows up directly as a serving p95 spike under concurrent load
+    request_queue_size = 128
 
     def finish_request(self, request, client_address):
         if self.ssl_ctx is None:

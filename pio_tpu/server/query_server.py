@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import datetime as _dt
 import logging
+import os
 import threading
 import time
 import uuid
@@ -82,6 +83,109 @@ class _LatencyStats:
             }
 
 
+class _MicroBatcher:
+    """Coalesces concurrent ``/queries.json`` requests into one
+    ``algo.batch_predict`` dispatch.
+
+    The reference serves strictly per-request (one ``predictBase`` per
+    HTTP call on the driver JVM). On an accelerator the per-dispatch
+    round trip dominates single-query cost, so under concurrent load it
+    pays to aggregate: request threads enqueue their (already parsed +
+    supplemented) query and block; a worker drains the queue after a
+    short collection window and pushes the whole batch through each
+    algorithm's ``batch_predict`` — for factor-serving templates that is
+    ONE ``[B, K] @ [K, N]`` device matmul + top-k instead of B separate
+    dispatches — then serves each query individually.
+
+    Enabled via ``PIO_TPU_SERVE_MICROBATCH_US`` (collection window in
+    microseconds; unset/0 = off, classic per-request path). If a batch
+    dispatch fails, every member falls back to the per-query path so one
+    poisoned query cannot fail its batch-mates.
+    """
+
+    MAX_BATCH = 512
+
+    def __init__(self, service: "QueryServerService", window_s: float):
+        self._service = service
+        self._window_s = window_s
+        self._cv = threading.Condition()
+        self._queue: List[list] = []
+        self._stopped = False
+        self.batches = 0
+        self.batched_queries = 0
+        self.max_batch = 0
+        self._thread = threading.Thread(
+            target=self._run, name="pio-tpu-microbatch", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, query):
+        """Enqueue one query; blocks until its batch is served."""
+        pend = [query, None, None, threading.Event()]  # q, result, exc, done
+        with self._cv:
+            if self._stopped:
+                raise HTTPError(503, "undeployed")
+            self._queue.append(pend)
+            self._cv.notify()
+        pend[3].wait()
+        if pend[2] is not None:
+            raise pend[2]
+        return pend[1]
+
+    def stop(self):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+
+    def to_dict(self) -> dict:
+        return {
+            "batches": self.batches,
+            "batchedQueries": self.batched_queries,
+            "maxBatch": self.max_batch,
+            "windowUs": round(self._window_s * 1e6),
+        }
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait()
+                if self._stopped and not self._queue:
+                    return
+            # collection window: let concurrent request threads pile on —
+            # but don't idle when a full batch is already waiting
+            if self._window_s > 0:
+                with self._cv:
+                    full = len(self._queue) >= self.MAX_BATCH
+                if not full:
+                    time.sleep(self._window_s)
+            with self._cv:
+                batch = self._queue[: self.MAX_BATCH]
+                del self._queue[: len(batch)]
+            if not batch:
+                continue
+            self.batches += 1
+            self.batched_queries += len(batch)
+            self.max_batch = max(self.max_batch, len(batch))
+            try:
+                results = self._service._predict_batch(
+                    [p[0] for p in batch]
+                )
+                for p, r in zip(batch, results):
+                    p[1] = r
+            except Exception:
+                log.exception(
+                    "micro-batch dispatch failed; per-query fallback"
+                )
+                for p in batch:
+                    try:
+                        p[1] = self._service._predict_one(p[0])
+                    except Exception as e:  # propagate to that caller only
+                        p[2] = e
+            for p in batch:
+                p[3].set()
+
+
 class QueryServerService:
     """The ServerActor analog; MasterActor duties (reload/undeploy) included."""
 
@@ -110,6 +214,10 @@ class QueryServerService:
         #: undeploy` terminates the server process, not just the flag)
         self._server = None
         self._load(instance_id)
+        window_us = float(os.environ.get("PIO_TPU_SERVE_MICROBATCH_US", "0"))
+        self._batcher = (
+            _MicroBatcher(self, window_us / 1e6) if window_us > 0 else None
+        )
 
         self.router = Router()
         r = self.router
@@ -174,13 +282,18 @@ class QueryServerService:
         error = True
         try:
             # one consistent snapshot — a concurrent /reload must not mix
-            # the old engine's query class with the new engine's models
+            # the old engine's query class with the new engine's models.
+            # (The micro-batch path re-snapshots in the worker; the batch
+            # is served from the worker-time snapshot.)
             with self._swap_lock:
                 pairs, serving, qc = self.pairs, self.serving, self.query_class
             query = self._parse_query(req.body, qc)
             query = serving.supplement(query)
-            predictions = [algo.predict(m, query) for algo, m in pairs]
-            result = serving.serve(query, predictions)
+            if self._batcher is not None:
+                result = self._batcher.submit(query)
+            else:
+                predictions = [algo.predict(m, query) for algo, m in pairs]
+                result = serving.serve(query, predictions)
             out = _to_jsonable(result)
             for blocker in QUERY_BLOCKERS:
                 try:
@@ -224,8 +337,32 @@ class QueryServerService:
         except Exception:
             log.exception("feedback logging failed")
 
+    def _predict_one(self, query):
+        """Per-query predict + serve from one consistent snapshot."""
+        with self._swap_lock:
+            pairs, serving = self.pairs, self.serving
+        predictions = [algo.predict(m, query) for algo, m in pairs]
+        return serving.serve(query, predictions)
+
+    def _predict_batch(self, queries: list):
+        """One ``batch_predict`` dispatch per algorithm over the whole
+        micro-batch, then per-query serving combine (micro-batcher path)."""
+        with self._swap_lock:
+            pairs, serving = self.pairs, self.serving
+        per_algo = []
+        for algo, m in pairs:
+            got = dict(algo.batch_predict(m, list(enumerate(queries))))
+            per_algo.append([got[i] for i in range(len(queries))])
+        return [
+            serving.serve(q, [pa[i] for pa in per_algo])
+            for i, q in enumerate(queries)
+        ]
+
     def get_stats(self, req: Request):
-        return 200, self.stats.to_dict()
+        out = self.stats.to_dict()
+        if self._batcher is not None:
+            out["microbatch"] = self._batcher.to_dict()
+        return 200, out
 
     def _check_admin(self, req: Request):
         if self.admin_key is not None:
@@ -245,6 +382,8 @@ class QueryServerService:
     def undeploy(self, req: Request):
         self._check_admin(req)
         self._deployed = False
+        if self._batcher is not None:
+            self._batcher.stop()
         if self._server is not None:
             # after_response fires once the reply is flushed to the
             # socket, so shutdown can never race the client's read (a

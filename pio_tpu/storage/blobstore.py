@@ -7,10 +7,13 @@ cluster filesystem. This rebuild generalizes the slot instead of binding
 to one vendor client:
 
 - A tiny **BlobBackend SPI** (put/get/delete/exists on flat keys) keyed by
-  URI scheme. ``file://`` ships today; ``gs://``/``s3://``/``hdfs://``
-  plug in by registering a backend for their scheme
-  (:func:`register_blob_scheme`) — the Models trait above them does not
-  change.
+  URI scheme. ``file://`` AND a real network scheme ship in-tree:
+  ``http(s)://`` talks to the blob daemon
+  (:mod:`pio_tpu.server.blob_server`, ``python -m pio_tpu blobserver``),
+  so model bytes genuinely cross a socket — the remoteness that defines
+  the HDFS/S3 rows. ``gs://``/``s3://``/``hdfs://`` plug in by
+  registering a backend for their scheme (:func:`register_blob_scheme`)
+  — the Models trait above them does not change.
 - **Content addressing**: blobs live at ``objects/<aa>/<sha256>`` and a
   mutable ``refs/<model-id>`` pointer names the current blob. Identical
   models dedupe, every read is digest-verified end-to-end (a corrupt or
@@ -108,6 +111,83 @@ class FileBlobBackend(BlobBackend):
         return out
 
 
+class HTTPBlobBackend(BlobBackend):
+    """``http(s)://`` — client of the blob daemon
+    (:mod:`pio_tpu.server.blob_server`), i.e. the in-tree REMOTE Models
+    backend: model bytes cross a socket, nothing is shared with the
+    server but the URL. stdlib urllib only; keys percent-encode into the
+    URL path; an optional access key rides the Authorization header
+    (``PIO_TPU_BLOB_ACCESS_KEY`` or ``http://host:port/prefix?accessKey=…``).
+    """
+
+    def __init__(self, base_url: str, access_key: Optional[str] = None):
+        from urllib.parse import parse_qs, urlsplit, urlunsplit
+
+        parts = urlsplit(base_url)
+        if access_key is None:
+            qs = parse_qs(parts.query)
+            access_key = (qs.get("accessKey") or [None])[0]
+            if access_key is None:
+                access_key = os.environ.get("PIO_TPU_BLOB_ACCESS_KEY")
+        self._key_hdr = access_key
+        self.base = urlunsplit(
+            (parts.scheme, parts.netloc, parts.path.rstrip("/"), "", "")
+        )
+
+    def _request(self, method: str, url: str, data: Optional[bytes] = None):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(url, data=data, method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/octet-stream")
+        if self._key_hdr:
+            req.add_header("Authorization", f"Bearer {self._key_hdr}")
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return 404, b""
+            raise base.StorageError(
+                f"blob server {method} {url}: HTTP {e.code} "
+                f"{e.read()[:200]!r}"
+            )
+        except urllib.error.URLError as e:
+            raise base.StorageError(f"blob server unreachable: {e}")
+
+    def _url(self, key: str) -> str:
+        return f"{self.base}/blobs/{quote(key, safe='/')}"
+
+    def put(self, key: str, data: bytes) -> None:
+        status, _ = self._request("PUT", self._url(key), data)
+        if status not in (200, 201):
+            raise base.StorageError(f"blob put failed: HTTP {status}")
+
+    def get(self, key: str) -> Optional[bytes]:
+        status, data = self._request("GET", self._url(key))
+        return None if status == 404 else data
+
+    def delete(self, key: str) -> bool:
+        status, _ = self._request("DELETE", self._url(key))
+        return status != 404
+
+    def exists(self, key: str) -> bool:
+        status, _ = self._request("HEAD", self._url(key))
+        return status != 404
+
+    def list(self, prefix: str) -> List[str]:
+        import json as _json
+        from urllib.parse import quote as _q
+
+        status, data = self._request(
+            "GET", f"{self.base}/keys?prefix={_q(prefix, safe='')}"
+        )
+        if status == 404:
+            return []
+        return _json.loads(data.decode("utf-8"))["keys"]
+
+
 #: scheme → factory(netloc_and_path) (the gs://, s3://, hdfs:// plug point)
 _SCHEMES: Dict[str, Callable[[str], BlobBackend]] = {}
 
@@ -119,6 +199,8 @@ def register_blob_scheme(
 
 
 register_blob_scheme("file", FileBlobBackend)
+register_blob_scheme("http", lambda loc: HTTPBlobBackend(f"http://{loc}"))
+register_blob_scheme("https", lambda loc: HTTPBlobBackend(f"https://{loc}"))
 
 
 def open_blob_backend(uri: str) -> BlobBackend:
@@ -137,8 +219,10 @@ def open_blob_backend(uri: str) -> BlobBackend:
     if scheme == "file":
         # file://HOST/path has no meaning here; accept file:///abs and bare
         location = parsed.path or uri
-    else:  # pragma: no cover - exercised by third-party backends
+    else:
         location = (parsed.netloc + parsed.path).rstrip("/")
+        if parsed.query:  # e.g. http://host:port/prefix?accessKey=…
+            location += f"?{parsed.query}"
     return factory(location)
 
 

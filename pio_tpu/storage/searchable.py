@@ -137,6 +137,28 @@ class SearchableClient(SQLiteClient):
             c.execute("PRAGMA recursive_triggers=ON")
         return c
 
+    def rebuild_index(self) -> None:
+        """Drop and refill every FTS table from its base table.
+
+        The index is keyed on sqlite's implicit rowid for tables without
+        an INTEGER PRIMARY KEY (events has a composite PK; the instance
+        tables have TEXT PKs), and ``VACUUM`` may renumber implicit
+        rowids — silently desyncing the index in a way the count-based
+        adoption guard in ``__init__`` cannot detect (counts still
+        match). Any out-of-band ``VACUUM`` of the database file must be
+        followed by this call. Nothing in-tree vacuums; this is the
+        recovery hook for operators who do.
+        """
+        conn = self.conn()
+        for table in _BODY:
+            conn.execute(f"DELETE FROM {table}_fts")
+            conn.execute(
+                f"INSERT INTO {table}_fts(rowid, body) "
+                f"SELECT t.rowid, {_BODY[table].format(p='t')} "
+                f"FROM {table} t"
+            )
+        conn.commit()
+
 
 class SearchError(base.StorageError):
     """Malformed FTS query string (surfaced with the sqlite detail)."""

@@ -8,6 +8,7 @@ SURVEY.md §2.5); here it is one helper shared by every bundled template.
 from __future__ import annotations
 
 import dataclasses
+import threading as _threading
 from typing import FrozenSet, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -45,20 +46,26 @@ class DeviceScorerModel:
     def scorer(self, warmup: bool = False):
         """Device-resident factor scorer, built once per deploy lifetime
         (factors upload on first use / at prepare_for_serving and stay on
-        the accelerator; queries ship only integer codes)."""
+        the accelerator; queries ship only integer codes). Lock-guarded:
+        concurrent first requests in the threaded query server must not
+        each upload the factor tables and re-run the link probes."""
         s = self.__dict__.get("_scorer")
         if s is None:
-            from pio_tpu.ops.topn import DeviceTopNScorer
+            with self.__dict__.setdefault("_scorer_lock", _threading.Lock()):
+                s = self.__dict__.get("_scorer")
+                if s is None:
+                    from pio_tpu.ops.topn import DeviceTopNScorer
 
-            rows, cols = self._scorer_factors()
-            s = DeviceTopNScorer(rows, cols, warmup=warmup)
-            self.__dict__["_scorer"] = s
+                    rows, cols = self._scorer_factors()
+                    s = DeviceTopNScorer(rows, cols, warmup=warmup)
+                    self.__dict__["_scorer"] = s
         return s
 
     def __getstate__(self):
         # device handles and jitted closures never serialize
         d = dict(self.__dict__)
         d.pop("_scorer", None)
+        d.pop("_scorer_lock", None)
         return d
 
 

@@ -294,7 +294,7 @@ class ALSAlgorithm(Algorithm):
         and single-item queries take the per-query path."""
         return batched_user_topn(
             self, model, queries, model.user_index, model.item_index,
-            model.scorer(),
+            model.scorer,
         )
 
 
@@ -363,7 +363,10 @@ def batched_user_topn(algo, model, queries, user_index, item_index, scorer):
     """Shared batch_predict routing for user→top-N recommenders (ALS,
     two-tower): known-user top-N queries batch through the device scorer
     (one matmul + top-k dispatch per chunk); unknown users and single-item
-    queries fall back to ``algo.predict``."""
+    queries fall back to ``algo.predict``. ``scorer`` may be a zero-arg
+    callable (``model.scorer``) — it is then resolved only when a
+    batchable query actually exists, so an all-fallback query file never
+    pays the factor upload."""
     out = []
     bidx, bcodes, bq = [], [], []
     for i, q in queries:
@@ -378,6 +381,8 @@ def batched_user_topn(algo, model, queries, user_index, item_index, scorer):
             bcodes.append(code)
             bq.append(q)
     if bcodes:
+        if callable(scorer):
+            scorer = scorer()
         kmax = max(q.num for q in bq)
         idx, vals = scorer.top_n_batch(
             np.asarray(bcodes, np.int32), kmax,

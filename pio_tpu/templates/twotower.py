@@ -138,7 +138,7 @@ class TwoTowerAlgorithm(Algorithm):
         known-user top-N queries (shared routing with the ALS template)."""
         return batched_user_topn(
             self, model, queries, model.user_index, model.item_index,
-            model.scorer(),
+            model.scorer,
         )
 
 

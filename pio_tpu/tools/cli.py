@@ -281,6 +281,23 @@ def cmd_eventserver(args) -> int:
     return 0
 
 
+def cmd_blobserver(args) -> int:
+    """Run the blob daemon — the remote Models endpoint (HDFS/S3 slot).
+    Point MODELDATA at it: PIO_STORAGE_SOURCES_<N>_TYPE=blob,
+    PIO_STORAGE_SOURCES_<N>_PATH=http://host:port[?accessKey=…]."""
+    from pio_tpu.server.blob_server import create_blob_server
+
+    server = create_blob_server(
+        args.root, host=args.ip, port=args.port, access_key=args.access_key
+    )
+    _out(f"Blob server serving {args.root} on {args.ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("shutting down")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from pio_tpu.server import create_dashboard
 
@@ -668,6 +685,17 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--ip", default="0.0.0.0")
     a.add_argument("--port", type=int, default=7070)
     a.set_defaults(fn=cmd_eventserver)
+
+    a = sub.add_parser(
+        "blobserver", help="run the blob daemon (remote Models endpoint)"
+    )
+    a.add_argument("--root", required=True,
+                   help="directory the daemon serves blobs from")
+    a.add_argument("--ip", default="0.0.0.0")
+    a.add_argument("--port", type=int, default=7088)
+    a.add_argument("--access-key", default=None,
+                   help="require this bearer key on every request")
+    a.set_defaults(fn=cmd_blobserver)
 
     a = sub.add_parser("dashboard", help="run the evaluation dashboard")
     a.add_argument("--ip", default="0.0.0.0")

@@ -110,6 +110,104 @@ def test_uri_scheme_registry(tmp_path):
     assert m3.get("z").models == b"3"
 
 
+@pytest.fixture()
+def blob_daemon(tmp_path):
+    """In-process blob daemon on a loopback port."""
+    from pio_tpu.server.blob_server import create_blob_server
+
+    server = create_blob_server(
+        str(tmp_path / "served"), host="127.0.0.1", port=0
+    )
+    server.start()
+    yield f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+class TestHTTPBlobScheme:
+    """The in-tree REMOTE backend: model bytes cross a real socket."""
+
+    def test_backend_roundtrip_over_socket(self, blob_daemon):
+        b = open_blob_backend(blob_daemon)
+        assert b.get("objects/aa/deadbeef") is None
+        assert not b.exists("objects/aa/deadbeef")
+        payload = bytes(range(256)) * 17  # binary, non-UTF8
+        b.put("objects/aa/deadbeef", payload)
+        assert b.exists("objects/aa/deadbeef")
+        assert b.get("objects/aa/deadbeef") == payload
+        b.put("refs/m%2Fslash", b"deadbeef")  # %-escaped key survives
+        assert b.get("refs/m%2Fslash") == b"deadbeef"
+        assert sorted(b.list("")) == [
+            "objects/aa/deadbeef", "refs/m%2Fslash"
+        ]
+        assert b.list("refs") == ["refs/m%2Fslash"]
+        assert b.delete("objects/aa/deadbeef")
+        assert not b.delete("objects/aa/deadbeef")
+        assert b.get("objects/aa/deadbeef") is None
+
+    def test_models_trait_over_http(self, blob_daemon):
+        """Full BlobModels semantics (dedupe, digest verify, gc) with the
+        object store behind a socket."""
+        m = BlobModels(open_blob_backend(blob_daemon))
+        m.insert(Model("inst/1", b"weights-v1"))
+        m.insert(Model("other", b"weights-v1"))  # dedupe across the wire
+        assert m.get("inst/1").models == b"weights-v1"
+        backend = m._b
+        assert len(backend.list("objects")) == 1
+        m.insert(Model("inst/1", b"weights-v2"))  # overwrite + gc check
+        assert m.get("inst/1").models == b"weights-v2"
+        assert len(backend.list("objects")) == 2  # v1 still ref'd by other
+        assert m.delete("other")
+        assert len(backend.list("objects")) == 1  # v1 gc'd
+        assert m.get("other") is None
+
+    def test_access_key_required(self, tmp_path):
+        from pio_tpu.server.blob_server import create_blob_server
+
+        server = create_blob_server(
+            str(tmp_path / "s"), host="127.0.0.1", port=0,
+            access_key="sekrit",
+        )
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            with pytest.raises(StorageError, match="HTTP 401"):
+                open_blob_backend(url).put("k", b"x")
+            b = open_blob_backend(f"{url}?accessKey=sekrit")
+            b.put("k", b"x")
+            assert b.get("k") == b"x"
+        finally:
+            server.stop()
+
+    def test_daemon_rejects_escaping_keys(self, blob_daemon):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{blob_daemon}/blobs/..%2Foutside", data=b"x", method="PUT",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+
+    def test_registry_env_wiring_http(self, tmp_home, monkeypatch,
+                                      blob_daemon):
+        from pio_tpu.storage.registry import Storage
+
+        monkeypatch.setenv(
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "RB"
+        )
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_RB_TYPE", "blob")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_RB_PATH", blob_daemon)
+        Storage.reset()
+        try:
+            models = Storage.get_model_data_models()
+            models.insert(Model("inst1", b"remote-weights"))
+            assert models.get("inst1").models == b"remote-weights"
+        finally:
+            Storage.reset()
+
+
 def test_registry_env_wiring(tmp_home, monkeypatch):
     from pio_tpu.storage.registry import Storage
 

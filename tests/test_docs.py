@@ -1,0 +1,66 @@
+"""Doc-rot guards for the quickstart walkthroughs (docs/quickstart.md).
+
+The full lifecycle itself is executed by tests/test_quickstart_scenario.py;
+here we pin the doc's inline payloads: every ```json block must parse, and
+every event payload in it must pass the Event Server's own validation
+(`Event.from_api_dict`) — so the walkthrough can't drift from the wire
+contract it documents.
+"""
+
+import json
+import os
+import re
+
+DOC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "quickstart.md",
+)
+
+
+def _json_blocks():
+    text = open(DOC).read()
+    return re.findall(r"```json\n(.*?)```", text, re.DOTALL)
+
+
+def test_all_json_blocks_parse():
+    blocks = _json_blocks()
+    assert len(blocks) >= 15, "walkthrough lost its examples?"
+    for b in blocks:
+        json.loads(b)
+
+
+def test_event_payloads_pass_server_validation():
+    from pio_tpu.data.event import Event
+
+    events = [
+        json.loads(b) for b in _json_blocks()
+        if '"event"' in b and '"entityType"' in b
+    ]
+    assert len(events) >= 5  # one per event-ingesting template section
+    for d in events:
+        ev = Event.from_api_dict(d)
+        assert ev.entity_id
+        # reserved-event rules enforced ($set needs properties, etc.)
+        if ev.event.startswith("$"):
+            assert ev.properties
+
+
+def test_query_shapes_bind_to_template_query_classes():
+    """The documented queries must bind to the templates' query dataclasses
+    exactly as the query server would bind them."""
+    from pio_tpu.controller.params import params_from_dict
+    from pio_tpu.templates import (
+        classification, recommendation, sequence, similarproduct,
+        textclassification,
+    )
+
+    cases = [
+        (recommendation.Query, {"user": "u1", "num": 4}),
+        (similarproduct.Query, {"items": ["i1", "i4"], "num": 4}),
+        (classification.Query, {"attrs": [2.0, 0.0, 1.0]}),
+        (textclassification.Query, {"text": "great product"}),
+        (sequence.Query, {"history": ["i1", "i5"], "num": 4}),
+    ]
+    for qc, payload in cases:
+        q = params_from_dict(qc, payload)
+        assert q is not None

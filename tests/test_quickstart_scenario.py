@@ -89,6 +89,14 @@ STORAGE_COMBOS = {
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "ES",
         "PIO_STORAGE_SOURCES_ES_TYPE": "searchable",
     },
+    # models behind a SOCKET: blob daemon + http:// scheme (the HDFS/S3
+    # remoteness made real — train persists and deploy loads over HTTP).
+    # __BLOB_DAEMON__ is replaced with the live daemon URL by the test.
+    "remote-blob-models": {
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "RB",
+        "PIO_STORAGE_SOURCES_RB_TYPE": "blob",
+        "PIO_STORAGE_SOURCES_RB_PATH": "__BLOB_DAEMON__",
+    },
 }
 
 
@@ -108,6 +116,23 @@ def test_full_quickstart_lifecycle(tmp_path, combo):
             pytest.skip(f"native eventlog unavailable: {e}")
     procs = []
     try:
+        if "__BLOB_DAEMON__" in env.values():
+            # ---- pio blobserver (remote Models endpoint) ----------------
+            bs_port = _free_port()
+            bs = subprocess.Popen(
+                [sys.executable, "-m", "pio_tpu", "blobserver",
+                 "--root", str(tmp_path / "blobroot"),
+                 "--ip", "127.0.0.1", "--port", str(bs_port)],
+                env=env, cwd=REPO,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            procs.append(bs)
+            url = f"http://127.0.0.1:{bs_port}"
+            assert _wait_http(f"{url}/")["status"] == "alive"
+            for k, v in list(env.items()):
+                if v == "__BLOB_DAEMON__":
+                    env[k] = url
+
         # ---- pio app new ------------------------------------------------
         out = _run(["app", "new", "quickstart"], env)
         assert out.returncode == 0, out.stderr[-1000:]
@@ -201,6 +226,11 @@ def test_full_quickstart_lifecycle(tmp_path, combo):
         out = _run(["status"], env)
         assert out.returncode == 0, out.stderr[-500:]
         assert "sanity check passed" in out.stdout
+
+        if combo == "remote-blob-models":
+            # the trained model actually lives behind the daemon's socket
+            blob_objects = tmp_path / "blobroot" / "objects"
+            assert blob_objects.is_dir() and any(blob_objects.rglob("*"))
     finally:
         for p in procs:
             if p.poll() is None:

@@ -117,6 +117,23 @@ class TestEventSearch:
         with pytest.raises(SearchError):
             events.search(1, "status:FAILED")
 
+    def test_rebuild_index_recovers_from_vacuum(self, tmp_path, client):
+        """VACUUM may renumber the implicit rowids the FTS index is keyed
+        on (counts still match, so the adoption guard can't see it);
+        rebuild_index() is the documented recovery."""
+        events = SearchableEvents(client)
+        events.insert(ev("rate", T(1), props={"genre": "scifi"}), 7)
+        events.insert(ev("buy", T(2), props={"genre": "romance"}), 7)
+        eid = events.insert(ev("view", T(3), props={"genre": "western"}), 7)
+        events.delete(eid, 7)  # leave a rowid hole for VACUUM to compact
+        client.conn().commit()
+        client.conn().execute("VACUUM")
+        client.rebuild_index()
+        got = events.search(7, "romance")
+        assert len(got) == 1 and got[0].event == "buy"
+        assert len(events.search(7, "western")) == 0
+        assert len(events.search(7, "scifi")) == 1
+
     def test_sidechannel_writes_resync_on_open(self, tmp_path):
         """Rows deleted through a PLAIN sqlite client (no triggers) are
         purged from the index at the next searchable open — the two-way

@@ -373,6 +373,69 @@ class TestQueryServer:
         assert http("POST", f"{url}/undeploy", {})[0] == 200
         assert http("POST", f"{url}/queries.json", {"user": "u1"})[0] == 503
 
+    def test_concurrent_queries(self, queryserver):
+        """16 threads × 8 posts: every response correct, stats coherent
+        (the serving path under contention — swap-lock, scorer, storage)."""
+        import concurrent.futures
+
+        url, service, _ = queryserver
+
+        def worker(t):
+            got = []
+            for q in range(8):
+                u = f"u{(t + q) % 8}"
+                status, body = http(
+                    "POST", f"{url}/queries.json", {"user": u, "num": 2}
+                )
+                got.append((status, len(body.get("itemScores", []))))
+            return got
+
+        with concurrent.futures.ThreadPoolExecutor(16) as ex:
+            results = [r for rs in ex.map(worker, range(16)) for r in rs]
+        assert all(status == 200 for status, _ in results)
+        assert all(n == 2 for _, n in results)
+        assert service.stats.count >= 128
+
+    def test_microbatch_coalesces(self, app_and_key, monkeypatch):
+        """With PIO_TPU_SERVE_MICROBATCH_US set, concurrent queries ride
+        one batch_predict dispatch and answers stay per-query correct."""
+        import concurrent.futures
+
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_US", "2000")
+        app_id, _ = app_and_key
+        variant, ctx, iid = _train(app_id)
+        server, service = create_query_server(
+            variant, host="127.0.0.1", port=0, ctx=ctx
+        )
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+
+            def one(t):
+                u = f"u{t % 8}"
+                status, body = http(
+                    "POST", f"{url}/queries.json", {"user": u, "num": 3}
+                )
+                items = {s["item"] for s in body["itemScores"]}
+                expect = (
+                    {"i0", "i1", "i2"} if t % 8 < 4 else {"i3", "i4", "i5"}
+                )
+                return status, items <= expect, len(items)
+
+            with concurrent.futures.ThreadPoolExecutor(12) as ex:
+                results = list(ex.map(one, range(48)))
+            assert all(s == 200 for s, _, _ in results)
+            assert all(ok for _, ok, _ in results)
+            assert all(n == 3 for _, _, n in results)
+            mb = service._batcher.to_dict()
+            assert mb["batchedQueries"] == 48
+            # coalescing actually happened (not 48 batches of 1)
+            assert mb["batches"] < 48 and mb["maxBatch"] > 1, mb
+            status, stats = http("GET", f"{url}/stats.json")
+            assert stats["microbatch"]["batches"] == mb["batches"]
+        finally:
+            server.stop()
+
     def test_no_trained_instance_errors(self, app_and_key):
         variant = variant_from_dict({**VARIANT, "id": "never-trained"})
         with pytest.raises(RuntimeError, match="no COMPLETED engine instance"):

@@ -213,6 +213,24 @@ def test_rank_mismatch_rejected():
         DeviceTopNScorer(rows, cols[:, :4])
 
 
+def test_empty_factor_tables():
+    """Zero-row/zero-col tables must construct (no host-probe indexing)
+    and score to empty results instead of raising."""
+    rows, cols = _factors()
+    for r, c in [
+        (np.empty((0, rows.shape[1]), np.float32), cols),
+        (rows, np.empty((0, rows.shape[1]), np.float32)),
+    ]:
+        s = DeviceTopNScorer(r, c)  # auto mode: would probe if unguarded
+        assert not s.on_device
+        if s.n_cols == 0:
+            idx, vals = s.top_n_batch(np.empty(0, np.int32), 5)
+            assert idx.shape == (0, 0) and vals.shape == (0, 0)
+        assert s.score_pairs(
+            np.empty(0, np.int32), np.empty(0, np.int32)
+        ).shape == (0,)
+
+
 def test_model_pickle_drops_scorer():
     """Deployed models lazily cache a scorer; serialization must drop the
     device handles (they rebuild on the next host)."""
