@@ -29,6 +29,12 @@ class LogRegConfig:
     learning_rate: float = 0.1
     reg: float = 0.0  # L2 on weights (not bias)
     seed: int = 0
+    #: feature wire + matmul dtype. "bfloat16" (default) halves the
+    #: host→device feature shipment — the dominant cost of a full-batch
+    #: train on a slow link — and runs the logits matmul at the MXU's
+    #: native rate; gradients, optimizer state, and the loss stay
+    #: float32. "float32" for exact-arithmetic needs.
+    input_dtype: str = "bfloat16"
 
 
 @dataclasses.dataclass
@@ -73,6 +79,11 @@ def train_logreg(
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if config.input_dtype not in ("bfloat16", "float32"):
+        raise ValueError(
+            f"input_dtype must be bfloat16/float32, "
+            f"got {config.input_dtype!r}"
+        )
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.int32)
     n, d = X.shape
@@ -100,7 +111,8 @@ def train_logreg(
 
     def loss_fn(params, Xs, ys, ms):
         logits = (
-            jnp.dot(Xs, params["w"], preferred_element_type=jnp.float32)
+            jnp.dot(Xs, params["w"].astype(Xs.dtype),
+                    preferred_element_type=jnp.float32)
             + params["b"]
         )
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, ys)
@@ -122,6 +134,13 @@ def train_logreg(
             step, (params, opt_state), None, length=config.iterations
         )
         return params
+
+    if config.input_dtype == "bfloat16":
+        # cast on the HOST (ml_dtypes ships with jax) so only 2 B/feature
+        # cross the link; a device-side cast would ship f32 first
+        import ml_dtypes
+
+        X = X.astype(ml_dtypes.bfloat16)
 
     if mesh is not None:
         shard = NamedSharding(mesh, P(axis))

@@ -234,6 +234,9 @@ class LogRegParams(Params):
     learning_rate: float = 0.1
     reg: float = 0.0
     seed: int = 0
+    #: feature wire/matmul dtype — "bfloat16" (default, MXU-native,
+    #: half the host→device bytes) or "float32" for exact arithmetic
+    input_dtype: str = "bfloat16"
 
 
 @dataclasses.dataclass
@@ -263,6 +266,7 @@ class LogisticRegressionAlgorithm(Algorithm):
                 learning_rate=p.learning_rate,
                 reg=p.reg,
                 seed=p.seed,
+                input_dtype=p.input_dtype,
             ),
         )
         return LogRegClassifierModel(lr, pd.label_index, pd.features.shape[1])

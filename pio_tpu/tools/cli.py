@@ -462,7 +462,10 @@ def cmd_upgrade(args) -> int:
     """Migrate configured SQLite storage to this build's schema
     (reference ``pio upgrade``). Opening a database applies pending
     migrations, so this verb just touches every configured store and
-    reports the stamped schema version."""
+    reports the stamped schema version. ``--rebuild-search-index``
+    additionally drops and refills every searchable store's FTS index —
+    required after an out-of-band VACUUM (which may renumber the implicit
+    rowids the index is keyed on)."""
     import sqlite3
 
     from pio_tpu.storage import StorageError
@@ -478,6 +481,7 @@ def cmd_upgrade(args) -> int:
         _out("no SQLite stores configured; nothing to migrate")
         return 0
     seen_paths = set()
+    rebuilt_paths = set()
     for label, client in clients.items():
         v = SQLiteClient.schema_version(client.conn())
         note = " (same file as above)" if client.path in seen_paths else ""
@@ -486,6 +490,15 @@ def cmd_upgrade(args) -> int:
             f"  {label}: {client.path} at schema v{v} "
             f"(current v{SCHEMA_VERSION}){note}"
         )
+        rebuild = getattr(client, "rebuild_index", None)
+        if (
+            getattr(args, "rebuild_search_index", False)
+            and callable(rebuild)
+            and client.path not in rebuilt_paths
+        ):
+            rebuild()
+            rebuilt_paths.add(client.path)
+            _out(f"  {label}: FTS index rebuilt")
     _out("storage schema up to date")
     return 0
 
@@ -736,9 +749,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_parser("list").set_defaults(fn=cmd_template_list)
 
-    sub.add_parser(
+    a = sub.add_parser(
         "upgrade", help="migrate storage to this build's schema"
-    ).set_defaults(fn=cmd_upgrade)
+    )
+    a.add_argument(
+        "--rebuild-search-index", action="store_true",
+        help="drop + refill searchable stores' FTS indexes "
+             "(run after an out-of-band VACUUM)",
+    )
+    a.set_defaults(fn=cmd_upgrade)
 
     a = sub.add_parser(
         "run", help="run a module:function entry point with the framework"

@@ -178,6 +178,36 @@ class TestHTTPBlobScheme:
         finally:
             server.stop()
 
+    def test_keepalive_connection_framing(self, blob_daemon):
+        """Many requests on ONE persistent HTTP/1.1 connection — a HEAD
+        response that wrote body bytes (or unflushed buffered output)
+        would desync every subsequent response on the socket."""
+        import http.client
+        from urllib.parse import urlsplit
+
+        host, port = urlsplit(blob_daemon).netloc.split(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        try:
+            payload = b"\x00\xffkeepalive"
+            conn.request("PUT", "/blobs/objects/k1", body=payload,
+                         headers={"Content-Type":
+                                  "application/octet-stream"})
+            r = conn.getresponse()
+            r.read()
+            assert r.status == 201
+            for _ in range(3):  # HEAD hit + miss, then a real GET
+                conn.request("HEAD", "/blobs/objects/k1")
+                r = conn.getresponse()
+                assert r.read() == b"" and r.status == 200
+                conn.request("HEAD", "/blobs/objects/absent")
+                r = conn.getresponse()
+                assert r.read() == b"" and r.status == 404
+                conn.request("GET", "/blobs/objects/k1")
+                r = conn.getresponse()
+                assert r.status == 200 and r.read() == payload
+        finally:
+            conn.close()
+
     def test_daemon_rejects_escaping_keys(self, blob_daemon):
         import urllib.error
         import urllib.request

@@ -64,6 +64,30 @@ class TestLogReg:
         acc = (model.predict(X) == y).mean()
         assert acc > 0.95
 
+    def test_input_dtype_wire_parity(self):
+        """bf16 feature wire (default — halves the dominant transfer)
+        must learn the same boundary as the exact f32 wire."""
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(512, 8)).astype(np.float32)
+        w = rng.normal(size=(8, 3))
+        y = np.argmax(X @ w, axis=1).astype(np.int32)
+        ctx = ComputeContext.create(seed=0)
+        accs = {}
+        for dt in ("bfloat16", "float32"):
+            m = train_logreg(
+                ctx, X, y, n_classes=3,
+                config=LogRegConfig(iterations=200, learning_rate=0.3,
+                                    input_dtype=dt),
+            )
+            accs[dt] = (m.predict(X) == y).mean()
+        assert accs["float32"] > 0.9
+        assert abs(accs["bfloat16"] - accs["float32"]) < 0.05, accs
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="input_dtype"):
+            train_logreg(None, X, y, 3,
+                         LogRegConfig(input_dtype="fp8"))
+
     def test_single_device_path(self):
         X = np.array([[0.0], [1.0], [2.0], [3.0]], np.float32)
         y = np.array([0, 0, 1, 1], np.int32)

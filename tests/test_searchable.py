@@ -225,6 +225,28 @@ class TestAdoptionAndRegistry:
         finally:
             Storage.reset()
 
+    def test_upgrade_verb_rebuilds_search_index(self, tmp_home,
+                                                monkeypatch, capsys):
+        """`pio upgrade --rebuild-search-index` is the CLI recovery path
+        after an out-of-band VACUUM."""
+        from pio_tpu.tools.cli import main as cli_main
+
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "ES")
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "ES")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_ES_TYPE", "searchable")
+        Storage.reset()
+        try:
+            events = Storage.get_levents()
+            events.insert(ev("rate", T(1), props={"k": "needle"}), 3)
+            rc = cli_main(["upgrade", "--rebuild-search-index"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "FTS index rebuilt" in out
+            # rebuilt index still serves correct search results
+            assert len(events.search(3, "needle")) == 1
+        finally:
+            Storage.reset()
+
     def test_concurrent_adoption_race_is_safe(self, tmp_path):
         """Two clients adopting the same plain file must not collide on
         duplicate FTS rowids (INSERT OR IGNORE backfill)."""
