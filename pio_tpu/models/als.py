@@ -189,7 +189,8 @@ def _pack_blocks(
 
 
 def _make_math(reg: float, implicit: bool, alpha: float,
-               matmul_dtype: str, solver: str, rating_wire: str = "f32"):
+               matmul_dtype: str, solver: str, rating_wire: str = "f32",
+               item_wire: str = "planes"):
     """Shared jittable ALS math: blocked normal-equation accumulation, the
     batched solvers, and the wire decode. Closed over the static config and
     used by BOTH the monolithic trainer (:func:`_build_trainer`) and the
@@ -328,8 +329,36 @@ def _make_math(reg: float, implicit: bool, alpha: float,
         A, b = partial_normal_eq(*blocks, factors, n_entities, chunk)
         return solve_block(A, b, gram_of(factors))
 
-    def decode_items(i_lo, i_hi):
-        """Wire → int32 item ids (uint16 plane + optional uint8 high)."""
+    def decode_items(i_lo, i_hi, ovf_idx=None, ovf_val=None, counts=None):
+        """Wire → int32 item ids.
+
+        ``planes``: uint16 low plane + optional uint8 high plane.
+        ``delta12``: 12-bit gaps over the (user, item)-sorted adjacency —
+        ``i_lo`` u8 low byte, ``i_hi`` nibble-packed high 4 bits (2
+        edges/byte), plus a sparse overflow list (``delta >> 12`` in
+        ``ovf_val``). Ids reconstruct as a segmented cumsum: global
+        uint32 cumsum of deltas minus each user's prefix (gathered at
+        segment starts from ``counts``) — wraparound-exact because every
+        true id < 2^16.
+        """
+        if item_wire == "delta12":
+            E = i_lo.shape[0]
+            lo = i_lo.astype(jnp.uint32)
+            hi = jnp.stack(
+                [i_hi & 0xF, i_hi >> 4], axis=1
+            ).reshape(-1)[:E].astype(jnp.uint32)
+            delta = lo | (hi << 8)
+            delta = delta.at[ovf_idx].add(
+                ovf_val.astype(jnp.uint32) << 12
+            )
+            G = jnp.cumsum(delta, dtype=jnp.uint32)
+            cnt = counts.astype(jnp.int32)
+            es = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(cnt)]
+            )[:-1]
+            g_prev = jnp.where(es > 0, G[jnp.maximum(es - 1, 0)], 0)
+            offs = jnp.repeat(g_prev, cnt, total_repeat_length=E)
+            return (G - offs).astype(jnp.int32)
         i32 = i_lo.astype(jnp.int32)
         if i_hi.shape[0]:
             i32 = i32 | (i_hi.astype(jnp.int32) << 16)
@@ -365,7 +394,7 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
                    matmul_dtype: str = "bfloat16", solver: str = "cg",
                    packed_shapes=None, rank: int = 0,
                    U_pad: int = 0, I_pad: int = 0,
-                   rating_wire: str = "f32"):
+                   rating_wire: str = "f32", item_wire: str = "planes"):
     """Jitted ALS trainer for one (mesh, static-config) combination.
 
     The returned function takes the two packed-block layouts + initial
@@ -375,7 +404,7 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
     import jax.numpy as jnp
 
     math = _make_math(reg, implicit, alpha, matmul_dtype, solver,
-                      rating_wire)
+                      rating_wire, item_wire)
     partial_normal_eq = math.partial_normal_eq
     solve_block = math.solve_block
     gram_of = math.gram_of
@@ -438,26 +467,27 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
         return jax.jit(run_body)
 
     # COO variant (single-device): ship the edge list ONCE, pre-sorted by
-    # user on the host (native counting sort), and build BOTH blocked
-    # layouts on device inside the same jit dispatch. Sorting host-side
-    # means the per-edge USER ids never cross the wire at all — one
-    # per-user counts array replaces them and the device rebuilds the id
-    # column with a single repeat. With uint16 item planes and uint8
-    # half-star rating codes the wire cost is ~3 B/edge (vs 12 B raw COO);
-    # on a tunneled/slow host↔device link the transfer is the training
-    # bottleneck, so wire bytes are throughput (measured: 175 MB → 66 MB
-    # at MovieLens-25M).
+    # (user, item) on the host (native two-pass sort), and build BOTH
+    # blocked layouts on device inside the same jit dispatch. Sorting
+    # host-side means the per-edge USER ids never cross the wire at all —
+    # one per-user counts array replaces them and the device rebuilds the
+    # id column with a single repeat. Items ship as 12-bit adjacency gaps
+    # (delta12) or uint16 planes, ratings as 4-bit half-star codes —
+    # ~2 B/edge total vs 12 B raw COO (measured 175 MB → ~50 MB at
+    # MovieLens-25M); on a tunneled/slow host↔device link the transfer is
+    # the training bottleneck, so wire bytes are throughput.
     su, wu, si, wi = packed_shapes
 
     @jax.jit
-    def run_packed(counts_u, counts_i, i_lo, i_hi, r, seed):
-        # wire decode (all static dispatch on the rating_wire kind):
-        #   item ids < 2^16 arrive uint16; < 2^24 as uint16 low plane +
-        #   uint8 high plane (i_hi; zero-size when unused)
+    def run_packed(counts_u, counts_i, i_lo, i_hi, ovf_idx, ovf_val, r,
+                   seed):
+        # wire decode (all static dispatch on the wire kinds):
+        #   items: uint16 plane (+uint8 high plane < 2^24), or 12-bit
+        #   deltas over the item-sorted adjacency + sparse overflow
         #   ratings: u4 nibble-packed half-star codes (2 edges/byte) when
         #   every code ≤ 15, u8 codes, else fp16/f32 raw
         E = i_lo.shape[0]
-        i32 = math.decode_items(i_lo, i_hi)
+        i32 = math.decode_items(i_lo, i_hi, ovf_idx, ovf_val, counts_u)
         r32 = math.decode_ratings(r, E)
         u32 = jnp.repeat(
             jnp.arange(U_pad, dtype=jnp.int32), counts_u,
@@ -481,7 +511,8 @@ def _build_stream_trainer(iterations: int, reg: float, implicit: bool,
                           rank: int, U_pad: int, I_pad: int,
                           w_user: int, w_item: int, S_item: int,
                           chunk_stream: int, chunk_item: int,
-                          rating_wire: str, chunk_spec: tuple):
+                          rating_wire: str, item_wire: str,
+                          chunk_spec: tuple):
     """Double-buffered single-device trainer: the wire arrays arrive in
     ``len(chunk_spec)`` slices and each slice's by-user block pack + its
     contribution to iteration 1's user-side normal equations run WHILE the
@@ -503,7 +534,14 @@ def _build_stream_trainer(iterations: int, reg: float, implicit: bool,
     import jax.numpy as jnp
 
     math = _make_math(reg, implicit, alpha, matmul_dtype, solver,
-                      rating_wire)
+                      rating_wire, item_wire)
+
+    def _lc_full(local_counts, u0_c):
+        """Expand a chunk's sliced local-counts span to full U_pad."""
+        return jax.lax.dynamic_update_slice(
+            jnp.zeros(U_pad, jnp.int32),
+            local_counts.astype(jnp.int32), (u0_c,),
+        )
 
     @jax.jit
     def init(seed):
@@ -519,20 +557,17 @@ def _build_stream_trainer(iterations: int, reg: float, implicit: bool,
 
     def _make_accum(S_c: int, pad_c: int, u0_c: int):
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def accum(A, b, Q0, local_counts, i_lo, i_hi, r):
+        def accum(A, b, Q0, local_counts, i_lo, i_hi, ovf_idx, ovf_val, r):
             E_c = i_lo.shape[0]
-            i32 = math.decode_items(i_lo, i_hi)
-            r32 = math.decode_ratings(r, E_c)
             # local_counts arrives sliced to the chunk's present-user span
             # [u0_c, pad_c] (ships span·4 B instead of U_pad·4 B per
             # chunk); expand to full length on device
-            lc_full = jax.lax.dynamic_update_slice(
-                jnp.zeros(U_pad, jnp.int32),
-                local_counts.astype(jnp.int32), (u0_c,),
-            )
+            lc = _lc_full(local_counts, u0_c)
+            i32 = math.decode_items(i_lo, i_hi, ovf_idx, ovf_val, lc)
+            r32 = math.decode_ratings(r, E_c)
             blocks = device_pack(
                 None, i32, r32, U_pad, w_user, S_c,
-                assume_sorted=True, counts=lc_full, pad_entity=pad_c,
+                assume_sorted=True, counts=lc, pad_entity=pad_c,
             )
             dA, db = math.partial_normal_eq(
                 *blocks, Q0, U_pad, chunk_stream
@@ -544,7 +579,8 @@ def _build_stream_trainer(iterations: int, reg: float, implicit: bool,
     accums = tuple(_make_accum(*spec) for spec in chunk_spec)
 
     @jax.jit
-    def finalize(A, b, Q0, counts_u, counts_i, user_blocks, wire_chunks):
+    def finalize(A, b, Q0, counts_u, counts_i, user_blocks, wire_chunks,
+                 lc_slices):
         # full by-user layout = concat of the chunk-local packs (padding
         # aliases each chunk's last user, so ids stay ascending)
         by_user = tuple(
@@ -552,13 +588,19 @@ def _build_stream_trainer(iterations: int, reg: float, implicit: bool,
             for k in range(3)
         )
         # item side needs the full COO: re-decode the (device-resident)
-        # wire chunks — elementwise, cheap — and pack by item
-        i32 = jnp.concatenate(
-            [math.decode_items(lo, hi) for lo, hi, _ in wire_chunks]
-        )
+        # wire chunks — elementwise, cheap; the delta item wire is
+        # chunk-segmented, so each chunk decodes against its own
+        # local-counts span
+        i32 = jnp.concatenate([
+            math.decode_items(
+                lo, hi, ovf_i, ovf_v, _lc_full(lc, chunk_spec[c][2])
+            )
+            for c, ((lo, hi, ovf_i, ovf_v, _r), lc)
+            in enumerate(zip(wire_chunks, lc_slices))
+        ])
         r32 = jnp.concatenate(
             [math.decode_ratings(r, lo.shape[0])
-             for lo, hi, r in wire_chunks]
+             for lo, hi, ovf_i, ovf_v, r in wire_chunks]
         )
         E = i32.shape[0]
         u32 = jnp.repeat(
@@ -640,23 +682,26 @@ def device_pack(ent, oth, rat, n_entities: int, width: int, S: int,
 def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
                   w_user: int, w_item: int, S_item: int, chunk_item: int,
                   counts_u: np.ndarray, counts_i: np.ndarray,
-                  i_ship: np.ndarray, i_hi: np.ndarray,
-                  r_ship: np.ndarray, rating_wire: str,
+                  i_sorted: np.ndarray, r_ship: np.ndarray,
+                  rating_wire: str, item_wire: str,
                   n_stream: int, seed, stats: Optional[dict]):
     """Dispatch the double-buffered single-device training run.
 
-    Slices the user-sorted wire arrays into ``n_stream`` edge spans,
-    queues every span's ``device_put`` up front (async — they drain on the
-    transfer stream in order), then chains the per-chunk accumulate
-    programs: chunk k's pack + normal-equation accumulation executes while
-    chunk k+1 is still crossing the link. With ``stats`` the phases are
-    serialized (block between h2d and compute) to measure them — overlap
-    off. Chunk boundaries are even so u4 nibble-packed ratings split on
-    byte boundaries.
+    Slices the (user, item)-sorted edges into ``n_stream`` spans, encodes
+    each span's item wire CHUNK-LOCALLY (the delta wire restarts each
+    user's gap chain at the chunk boundary — a straddling user's first
+    in-chunk edge ships its absolute id, so chunks decode independently
+    against their local counts), queues every span's ``device_put`` up
+    front (async — they drain on the transfer stream in order), then
+    chains the per-chunk accumulate programs: chunk k's pack +
+    normal-equation accumulation executes while chunk k+1 is still
+    crossing the link. With ``stats`` the phases are serialized (block
+    between h2d and compute) to measure them — overlap off. Chunk
+    boundaries are even so nibble-packed planes split on byte boundaries.
     """
     import jax
 
-    E = i_ship.shape[0]
+    E = i_sorted.shape[0]
     edge_start = np.zeros(U_pad + 1, np.int64)
     np.cumsum(counts_u, out=edge_start[1:])
     bounds = [min(E, (E * c // n_stream) // 2 * 2)
@@ -685,20 +730,26 @@ def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
         config.iterations, float(config.reg), bool(config.implicit),
         float(config.alpha), str(config.matmul_dtype), str(config.solver),
         rank, U_pad, I_pad, w_user, w_item, S_item,
-        chunk_stream, chunk_item, rating_wire,
+        chunk_stream, chunk_item, rating_wire, item_wire,
         tuple(tuple(s) for s in chunk_spec),
     )
 
     t0 = time.perf_counter()
     wire_dev, lc_dev = [], []
     for (e0, e1), lc in zip(spans, local_slices):
+        if item_wire == "delta12":
+            d_lo, d_hi, ovf_idx, ovf_val, _ = _encode_items_delta(
+                i_sorted[e0:e1], lc
+            )
+        else:
+            d_lo, d_hi = _planes(i_sorted[e0:e1], I_pad)
+            ovf_idx = np.zeros(0, np.int32)
+            ovf_val = np.zeros(0, np.uint8)
         r_c = (r_ship[e0 // 2:(e1 + 1) // 2] if rating_wire == "u4"
                else r_ship[e0:e1])
-        hi_c = i_hi[e0:e1] if i_hi.shape[0] else i_hi
-        wire_dev.append((
-            jax.device_put(i_ship[e0:e1]),
-            jax.device_put(hi_c),
-            jax.device_put(r_c),
+        wire_dev.append(tuple(
+            jax.device_put(a)
+            for a in (d_lo, d_hi, ovf_idx, ovf_val, r_c)
         ))
         lc_dev.append(jax.device_put(lc))
     cu_dev = jax.device_put(counts_u.astype(np.int32))
@@ -714,7 +765,8 @@ def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
         A, b, blk = acc(A, b, Q0, lc, *wire)
         user_blocks.append(blk)
     P_f, Q_f = finalize(A, b, Q0, cu_dev, ci_dev,
-                        tuple(user_blocks), tuple(wire_dev))
+                        tuple(user_blocks), tuple(wire_dev),
+                        tuple(lc_dev))
     if stats is not None:
         jax.block_until_ready((P_f, Q_f))
         stats["device_s"] = time.perf_counter() - t0
@@ -729,6 +781,115 @@ def _nibble_pack(codes: np.ndarray) -> np.ndarray:
         codes = np.concatenate([codes, np.zeros(1, np.uint8)])
     pair = codes.reshape(-1, 2)
     return (pair[:, 0] | (pair[:, 1] << 4)).astype(np.uint8)
+
+
+def _planes(idx: np.ndarray, n_pad: int):
+    """(low, high) item wire planes: uint16 alone below 2^16, uint16 +
+    uint8 high plane below 2^24 (3 B/id instead of 4), raw int32 beyond.
+    The empty high plane means "unused"."""
+    none = np.zeros(0, np.uint8)
+    if n_pad < 65536:
+        return idx.astype(np.uint16), none
+    if n_pad < (1 << 24):
+        return (
+            (idx & 0xFFFF).astype(np.uint16),
+            (idx >> 16).astype(np.uint8),
+        )
+    return idx, none
+
+
+def _u8p(a: np.ndarray):
+    import ctypes
+
+    return _ptr(a, np.uint8, ctypes.c_uint8)
+
+
+def _np_deltas(ids: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Per-edge gap to the previous same-segment id (first edge of each
+    segment gaps from 0). Numpy reference for the native delta encoder."""
+    E = len(ids)
+    cnt = counts[counts > 0].astype(np.int64)
+    starts = np.zeros(len(cnt), np.int64)
+    np.cumsum(cnt[:-1], out=starts[1:])
+    prev = np.empty(E, np.int32)
+    prev[0] = 0
+    prev[1:] = ids[:-1]
+    prev[starts] = 0
+    return ids.astype(np.int32) - prev
+
+
+def _delta_wire_size(
+    ids: np.ndarray, counts: np.ndarray
+) -> Optional[Tuple[int, int]]:
+    """``(wire_bytes, n_ovf)`` for the delta12 encoding WITHOUT
+    materializing it (one count pass), or None when the encoding is
+    inapplicable (ids not segment-sorted, or a gap ≥ 2^16)."""
+    E = len(ids)
+    if E == 0:
+        return 0, 0
+    native = _native_packer()
+    if native is not None:
+        cnt64 = np.ascontiguousarray(counts, np.int64)
+        n_ovf = int(native.als_delta_count(
+            _i32p(ids), _i64p(cnt64), len(cnt64)
+        ))
+        if n_ovf < 0:
+            return None
+    else:
+        delta = _np_deltas(ids, counts)
+        if len(delta) and (
+            int(delta.min()) < 0 or int(delta.max()) >= 65536
+        ):
+            return None
+        n_ovf = int((delta > 0xFFF).sum())
+    return E + (E + 1) // 2 + 5 * n_ovf, n_ovf
+
+
+def _encode_items_delta(ids: np.ndarray, counts: np.ndarray,
+                        n_ovf: Optional[int] = None):
+    """12-bit delta item wire over a (user, item)-sorted edge slice.
+
+    ``counts`` segments ``ids`` into per-user runs (zero entries allowed;
+    nonzero entries must sum to ``len(ids)``). Each edge ships the gap to
+    the previous item of the same user (the first edge of a run ships its
+    absolute id) as u8 low byte + nibble-packed high 4 bits — 1.5 B/edge
+    — plus a sparse overflow list carrying ``delta >> 12`` for the rare
+    gaps ≥ 4096. Exact for any id space < 2^16 (see
+    ``_make_math.decode_items``). Native single-pass encoder when the
+    toolchain is available; the numpy path is the format's reference.
+    Returns ``(d_lo, d_hi, ovf_idx i32, ovf_val u8, wire_bytes)``.
+    """
+    E = len(ids)
+    if E == 0:
+        z8 = np.zeros(0, np.uint8)
+        return z8, z8, np.zeros(0, np.int32), z8, 0
+    native = _native_packer()
+    if native is not None:
+        cnt64 = np.ascontiguousarray(counts, np.int64)
+        if n_ovf is None:  # caller may pass _delta_wire_size's count
+            n_ovf = int(native.als_delta_count(
+                _i32p(ids), _i64p(cnt64), len(cnt64)
+            ))
+        if n_ovf >= 0:
+            d_lo = np.empty(E, np.uint8)
+            d_hi = np.zeros((E + 1) // 2, np.uint8)
+            ovf_idx = np.empty(n_ovf, np.int32)
+            ovf_val = np.empty(n_ovf, np.uint8)
+            native.als_delta_fill(
+                _i32p(ids), _i64p(cnt64), len(cnt64), E,
+                _u8p(d_lo), _u8p(d_hi), _i32p(ovf_idx), _u8p(ovf_val),
+            )
+            bytes_ = (d_lo.nbytes + d_hi.nbytes + ovf_idx.nbytes
+                      + ovf_val.nbytes)
+            return d_lo, d_hi, ovf_idx, ovf_val, bytes_
+    delta = _np_deltas(ids, counts)
+    ovf = np.nonzero(delta > 0xFFF)[0]
+    d_lo = (delta & 0xFF).astype(np.uint8)
+    d_hi = _nibble_pack(((delta >> 8) & 0xF).astype(np.uint8))
+    ovf_idx = ovf.astype(np.int32)
+    ovf_val = (delta[ovf] >> 12).astype(np.uint8)
+    bytes_ = d_lo.nbytes + d_hi.nbytes + ovf_idx.nbytes + ovf_val.nbytes
+    return d_lo, d_hi, ovf_idx, ovf_val, bytes_
 
 
 def _encode_ratings(r_sorted: np.ndarray) -> Tuple[np.ndarray, str]:
@@ -845,7 +1006,8 @@ def train_als(
 
     seed = np.uint32(config.seed)
 
-    def _trainer(chunk_user, chunk_item, packed_shapes, rating_wire="f32"):
+    def _trainer(chunk_user, chunk_item, packed_shapes, rating_wire="f32",
+                 item_wire="planes"):
         # one call site for the long positional signature so the mesh and
         # single-device branches can never drift apart
         return _build_trainer(
@@ -853,7 +1015,7 @@ def train_als(
             bool(config.implicit), float(config.alpha),
             chunk_user, chunk_item,
             str(config.matmul_dtype), str(config.solver),
-            packed_shapes, K, U_pad, I_pad, rating_wire,
+            packed_shapes, K, U_pad, I_pad, rating_wire, item_wire,
         )
 
     if n_shards > 1:
@@ -902,7 +1064,11 @@ def train_als(
                 "use a multi-device mesh"
             )
 
-        # stable sort by user: native counting sort, numpy argsort fallback
+        # sort by (user, item): native two-pass (counting sort by user +
+        # per-adjacency stable sort), numpy lexsort fallback. Item-sorted
+        # adjacencies are what make the delta item wire dense AND improve
+        # factor-gather locality on device; ALS itself is order-invariant
+        # within a user.
         counts_u = np.ascontiguousarray(counts_u, np.int64)
         native = _native_packer()
         if native is not None:
@@ -913,34 +1079,40 @@ def train_als(
                 n_edges, U_pad, _i64p(counts_u),
                 _i32p(i_sorted), _f32p(r_sorted),
             )
+            native.als_sort_within_entity(
+                _i32p(i_sorted), _f32p(r_sorted), U_pad, _i64p(counts_u)
+            )
         else:
-            order = np.argsort(user_idx, kind="stable")
-            i_sorted = item_idx[order]
-            r_sorted = rating[order]
+            order = np.lexsort((item_idx, user_idx))
+            i_sorted = np.ascontiguousarray(item_idx[order])
+            r_sorted = np.ascontiguousarray(rating[order])
 
-        def _planes(idx, n_pad):
-            """(low, high) wire encoding: uint16 alone below 2^16, uint16
-            + uint8 high plane below 2^24 (3 B/id instead of 4), raw int32
-            beyond. The empty high plane means "unused"."""
-            none = np.zeros(0, np.uint8)
-            if n_pad < 65536:
-                return idx.astype(np.uint16), none
-            if n_pad < (1 << 24):
-                return (
-                    (idx & 0xFFFF).astype(np.uint16),
-                    (idx >> 16).astype(np.uint8),
-                )
-            return idx, none
-
-        i_ship, i_hi = _planes(i_sorted, I_pad)
         r_ship, rating_wire = _encode_ratings(r_sorted)
-        edge_bytes = i_ship.nbytes + i_hi.nbytes + r_ship.nbytes
+        # item wire: u16/planes vs 12-bit deltas over the item-sorted
+        # adjacency — whichever is smaller, sized by a count-only pass so
+        # nothing is materialized before the stream/monolithic split
+        # (PIO_TPU_ALS_ITEM_WIRE overrides for tests: auto/delta12/planes)
+        item_env = os.environ.get("PIO_TPU_ALS_ITEM_WIRE", "auto")
+        plane_width = 2 if I_pad < 65536 else (3 if I_pad < 2 ** 24 else 4)
+        use_delta = False
+        n_ovf = None
+        if I_pad < 65536 and item_env in ("auto", "delta12"):
+            sized = _delta_wire_size(i_sorted, counts_u)
+            if sized is not None:
+                delta_bytes, n_ovf = sized
+                use_delta = (
+                    item_env == "delta12" or delta_bytes < 2 * n_edges
+                )
+        item_wire = "delta12" if use_delta else "planes"
+        edge_bytes = (
+            delta_bytes if use_delta else plane_width * n_edges
+        ) + r_ship.nbytes
         if stats is not None:
             stats["pack_s"] = time.perf_counter() - t0
             stats["wire_bytes"] = (
                 edge_bytes + 4 * (U_pad + I_pad)  # + the two count arrays
             )
-            stats["encoding"] = rating_wire
+            stats["encoding"] = f"{rating_wire}+{item_wire}"
 
         # stream threshold: chunked double-buffered shipment once the edge
         # wire exceeds ~one chunk (default 8 MiB); tiny runs keep the
@@ -954,18 +1126,26 @@ def train_als(
         if n_stream > 1:
             P_f, Q_f = _run_streamed(
                 config, K, U_pad, I_pad, w_user, w_item, S_i, chunk_item,
-                counts_u, counts_i, i_ship, i_hi, r_ship, rating_wire,
-                n_stream, seed, stats,
+                counts_u, counts_i, i_sorted, r_ship, rating_wire,
+                item_wire, n_stream, seed, stats,
             )
         else:
+            if use_delta:
+                i_ship, i_hi, ovf_idx, ovf_val, _ = _encode_items_delta(
+                    i_sorted, counts_u, n_ovf=n_ovf
+                )
+            else:
+                i_ship, i_hi = _planes(i_sorted, I_pad)
+                ovf_idx = np.zeros(0, np.int32)
+                ovf_val = np.zeros(0, np.uint8)
             run = _trainer(
                 chunk_user, chunk_item, (S_u, w_user, S_i, w_item),
-                rating_wire,
+                rating_wire, item_wire,
             )
             args = (
                 counts_u.astype(np.int32),
                 np.ascontiguousarray(counts_i, np.int32),
-                i_ship, i_hi, r_ship,
+                i_ship, i_hi, ovf_idx, ovf_val, r_ship,
             )
             if stats is not None:
                 t0 = time.perf_counter()

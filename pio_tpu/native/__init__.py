@@ -141,5 +141,17 @@ def als_pack_lib():
             i32p, f32p,
         ]
         lib.als_sort_by_entity.restype = ctypes.c_int
+        lib.als_sort_within_entity.argtypes = [
+            i32p, f32p, ctypes.c_int32, i64p,
+        ]
+        lib.als_sort_within_entity.restype = ctypes.c_int
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.als_delta_count.argtypes = [i32p, i64p, ctypes.c_int32]
+        lib.als_delta_count.restype = ctypes.c_int64
+        lib.als_delta_fill.argtypes = [
+            i32p, i64p, ctypes.c_int32, ctypes.c_int64,
+            u8p, u8p, i32p, u8p,
+        ]
+        lib.als_delta_fill.restype = ctypes.c_int
         _cache["als_pack"] = lib
         return lib

@@ -214,4 +214,99 @@ int als_sort_by_entity(const int32_t* ent, const int32_t* other,
   return 0;
 }
 
+// In-place stable sort of each entity's adjacency segment by the OTHER id
+// (items ascending within a user). ALS is invariant to within-entity edge
+// order, and the sorted adjacency is what makes the delta item wire
+// (pio_tpu/models/als.py _encode_items_delta) dense: gaps between
+// consecutive items fit 12 bits almost everywhere. Matches numpy's
+// np.lexsort((other, ent)) order exactly (stable on duplicates —
+// achieved by packing (id << 24 | position) into one u64 sort key, which
+// also avoids per-segment allocations and comparator indirection).
+// counts is als_pack_count's output. Returns 0, or -1 if a segment
+// exceeds 2^24 edges (key positions would collide).
+int als_sort_within_entity(int32_t* other_sorted, float* rating_sorted,
+                           int32_t n_entities, const int64_t* counts) {
+  int64_t n_edges = 0, max_seg = 0;
+  for (int32_t e = 0; e < n_entities; ++e) {
+    n_edges += counts[e];
+    max_seg = std::max(max_seg, counts[e]);
+  }
+  if (max_seg >= (1LL << 24)) return -1;
+  const int T = n_threads(n_edges, n_entities);
+
+  std::vector<int64_t> edge_start(n_entities + 1);
+  edge_start[0] = 0;
+  for (int32_t e = 0; e < n_entities; ++e)
+    edge_start[e + 1] = edge_start[e] + counts[e];
+
+  parallel_ranges(n_entities, T, [&](int, int64_t lo, int64_t hi) {
+    std::vector<uint64_t> keys;
+    std::vector<float> tmp_r;
+    for (int64_t e = lo; e < hi; ++e) {
+      int64_t s = edge_start[e], n = counts[e];
+      if (n < 2) continue;
+      int32_t* o = other_sorted + s;
+      float* r = rating_sorted + s;
+      keys.resize(n);
+      for (int64_t k = 0; k < n; ++k)
+        keys[k] = (static_cast<uint64_t>(static_cast<uint32_t>(o[k]))
+                   << 24) |
+                  static_cast<uint64_t>(k);
+      std::sort(keys.begin(), keys.end());
+      tmp_r.assign(r, r + n);
+      for (int64_t k = 0; k < n; ++k) {
+        o[k] = static_cast<int32_t>(keys[k] >> 24);
+        r[k] = tmp_r[keys[k] & 0xFFFFFF];
+      }
+    }
+  });
+  return 0;
+}
+
+// 12-bit delta item wire over a (user, item)-sorted edge array — the
+// native fast path for pio_tpu/models/als.py _encode_items_delta (the
+// numpy fallback there defines the format). Pass 1 counts gaps ≥ 4096;
+// pass 2 fills d_lo (u8 low byte), d_hi (high 4 bits nibble-packed, two
+// edges per byte) and the sparse overflow (edge index, delta >> 12).
+// counts segments the edges (zero entries allowed). Returns n_ovf, or
+// -1 on a negative gap (input not item-sorted) or a gap ≥ 2^16.
+int64_t als_delta_count(const int32_t* ids, const int64_t* counts,
+                        int32_t n_segments) {
+  int64_t n_ovf = 0, p = 0;
+  for (int32_t s = 0; s < n_segments; ++s) {
+    int32_t prev = 0;
+    for (int64_t k = 0; k < counts[s]; ++k, ++p) {
+      int64_t d = static_cast<int64_t>(ids[p]) - prev;
+      if (d < 0 || d >= (1LL << 16)) return -1;
+      if (d > 0xFFF) ++n_ovf;
+      prev = ids[p];
+    }
+  }
+  return n_ovf;
+}
+
+int als_delta_fill(const int32_t* ids, const int64_t* counts,
+                   int32_t n_segments, int64_t n_edges,
+                   uint8_t* d_lo, uint8_t* d_hi,
+                   int32_t* ovf_idx, uint8_t* ovf_val) {
+  std::memset(d_hi, 0, static_cast<size_t>((n_edges + 1) / 2));
+  int64_t n_ovf = 0, p = 0;
+  for (int32_t s = 0; s < n_segments; ++s) {
+    int32_t prev = 0;
+    for (int64_t k = 0; k < counts[s]; ++k, ++p) {
+      int32_t d = ids[p] - prev;
+      d_lo[p] = static_cast<uint8_t>(d & 0xFF);
+      d_hi[p / 2] |= static_cast<uint8_t>(((d >> 8) & 0xF)
+                                          << ((p % 2) ? 4 : 0));
+      if (d > 0xFFF) {
+        ovf_idx[n_ovf] = static_cast<int32_t>(p);
+        ovf_val[n_ovf] = static_cast<uint8_t>(d >> 12);
+        ++n_ovf;
+      }
+      prev = ids[p];
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -143,7 +143,8 @@ class EventServerService:
     def alive(self, req: Request):
         return 200, {"status": "alive"}
 
-    def _ingest_one(self, d: Any, app_id: int, channel_id, whitelist) -> str:
+    def _validate_one(self, d: Any, app_id: int, channel_id, whitelist):
+        """JSON → validated Event (whitelist + input blockers applied)."""
         if not isinstance(d, dict):
             raise EventValidationError("event must be a JSON object")
         event = Event.from_api_dict(d)
@@ -154,13 +155,20 @@ class EventServerService:
             except ValueError as e:
                 # input blockers veto with ValueError → client 400
                 raise EventValidationError(str(e))
-        event_id = Storage.get_levents().insert(event, app_id, channel_id)
+        return event
+
+    def _post_ingest(self, d: Any, event: Event, app_id: int, channel_id):
         for sniffer in INPUT_SNIFFERS:
             try:
                 sniffer(app_id, channel_id, d)
             except Exception:
                 log.exception("input sniffer failed")
         self.stats.tick(app_id, event.event, event.entity_type, 201)
+
+    def _ingest_one(self, d: Any, app_id: int, channel_id, whitelist) -> str:
+        event = self._validate_one(d, app_id, channel_id, whitelist)
+        event_id = Storage.get_levents().insert(event, app_id, channel_id)
+        self._post_ingest(d, event, app_id, channel_id)
         return event_id
 
     def create_event(self, req: Request):
@@ -180,14 +188,25 @@ class EventServerService:
             return 400, {
                 "message": f"batch size {len(req.body)} exceeds {MAX_BATCH}"
             }
-        results = []
-        for d in req.body:
+        # validate every item first (per-item status contract), then land
+        # the valid ones in ONE bulk storage write (insert_batch — a
+        # single transaction/commit on backends that support it)
+        results: list = [None] * len(req.body)
+        valid = []
+        for k, d in enumerate(req.body):
             try:
-                event_id = self._ingest_one(d, app_id, channel_id, whitelist)
-                results.append({"status": 201, "eventId": event_id})
+                event = self._validate_one(d, app_id, channel_id, whitelist)
+                valid.append((k, d, event))
             except (EventValidationError, HTTPError) as e:
                 status = e.status if isinstance(e, HTTPError) else 400
-                results.append({"status": status, "message": str(e)})
+                results[k] = {"status": status, "message": str(e)}
+        if valid:
+            ids = Storage.get_levents().insert_batch(
+                [e for _, _, e in valid], app_id, channel_id
+            )
+            for (k, d, event), eid in zip(valid, ids):
+                self._post_ingest(d, event, app_id, channel_id)
+                results[k] = {"status": 201, "eventId": eid}
         return 200, results
 
     def get_event(self, req: Request):

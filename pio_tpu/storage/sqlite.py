@@ -230,29 +230,49 @@ class SQLiteEvents(base.LEvents, base.PEvents):
     def init_channel(self, app_id, channel_id=None) -> bool:
         return True  # single-table design; nothing to create
 
+    @staticmethod
+    def _row(eid: str, event: Event, app_id, channel_id):
+        return (
+            eid,
+            app_id,
+            _chan(channel_id),
+            event.event,
+            event.entity_type,
+            event.entity_id,
+            event.target_entity_type,
+            event.target_entity_id,
+            json.dumps(event.properties.to_dict()),
+            _to_us(event.event_time),
+            json.dumps(list(event.tags)),
+            event.pr_id,
+            _to_us(event.creation_time),
+        )
+
     def insert(self, event: Event, app_id, channel_id=None) -> str:
         eid = event.event_id or Event.new_event_id()
         conn = self._c.conn()
         conn.execute(
             "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-            (
-                eid,
-                app_id,
-                _chan(channel_id),
-                event.event,
-                event.entity_type,
-                event.entity_id,
-                event.target_entity_type,
-                event.target_entity_id,
-                json.dumps(event.properties.to_dict()),
-                _to_us(event.event_time),
-                json.dumps(list(event.tags)),
-                event.pr_id,
-                _to_us(event.creation_time),
-            ),
+            self._row(eid, event, app_id, channel_id),
         )
         conn.commit()
         return eid
+
+    def insert_batch(self, events, app_id, channel_id=None):
+        """One executemany + one commit for the whole batch (the WAL
+        fsync per commit dominates per-event cost; amortizing it across
+        ≤50 events is the batch route's whole point)."""
+        ids = [e.event_id or Event.new_event_id() for e in events]
+        conn = self._c.conn()
+        conn.executemany(
+            "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            [
+                self._row(eid, e, app_id, channel_id)
+                for eid, e in zip(ids, events)
+            ],
+        )
+        conn.commit()
+        return ids
 
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
         cur = self._c.conn().execute(

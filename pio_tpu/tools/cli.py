@@ -496,7 +496,10 @@ def cmd_upgrade(args) -> int:
             and callable(rebuild)
             and client.path not in rebuilt_paths
         ):
-            rebuild()
+            try:
+                rebuild()
+            except sqlite3.Error as e:  # locked/corrupt db: clean error,
+                return _err(f"index rebuild failed for {label}: {e}")
             rebuilt_paths.add(client.path)
             _out(f"  {label}: FTS index rebuilt")
     _out("storage schema up to date")

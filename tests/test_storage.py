@@ -4,6 +4,7 @@ Mirrors the reference's LEventsSpec / PEventsSpec pattern parameterized over
 backends, plus meta-store CRUD, model store, EventFrame, and registry tests.
 """
 
+import dataclasses
 import datetime as dt
 
 import numpy as np
@@ -94,6 +95,23 @@ def levents(request, tmp_path):
 
 
 class TestLEventsConformance:
+    def test_insert_batch(self, levents):
+        """Bulk insert (the /batch/events.json storage path): ids in
+        order, every event readable, channel + explicit ids honored."""
+        events = [
+            ev("rate", T(i), target=f"i{i}", props={"rating": float(i)})
+            for i in range(1, 7)
+        ]
+        events[2] = dataclasses.replace(events[2], event_id="pinned-id")
+        ids = levents.insert_batch(events, app_id=1)
+        assert len(ids) == 6 and ids[2] == "pinned-id"
+        for i, eid in enumerate(ids):
+            got = levents.get(eid, 1)
+            assert got is not None and got.target_entity_id == f"i{i + 1}"
+        # other apps/channels don't see them
+        assert levents.get(ids[0], 2) is None
+        assert levents.insert_batch([], 1) == []
+
     def test_insert_get_delete(self, levents):
         e = ev("rate", T(1), target="i1", props={"rating": 4.0})
         eid = levents.insert(e, app_id=1)
