@@ -734,9 +734,7 @@ def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
         tuple(tuple(s) for s in chunk_spec),
     )
 
-    t0 = time.perf_counter()
-    wire_dev, lc_dev = [], []
-    for (e0, e1), lc in zip(spans, local_slices):
+    def _encode_chunk(e0, e1, lc):
         if item_wire == "delta12":
             d_lo, d_hi, ovf_idx, ovf_val, _ = _encode_items_delta(
                 i_sorted[e0:e1], lc
@@ -747,10 +745,26 @@ def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
             ovf_val = np.zeros(0, np.uint8)
         r_c = (r_ship[e0 // 2:(e1 + 1) // 2] if rating_wire == "u4"
                else r_ship[e0:e1])
-        wire_dev.append(tuple(
-            jax.device_put(a)
-            for a in (d_lo, d_hi, ovf_idx, ovf_val, r_c)
-        ))
+        return d_lo, d_hi, ovf_idx, ovf_val, r_c
+
+    encoded: list = []
+    if stats is not None:
+        # profiling: pre-encode every chunk so host CPU time lands in
+        # pack_s, not in the transfer phase it would otherwise pollute
+        t0 = time.perf_counter()
+        encoded = [
+            _encode_chunk(e0, e1, lc)
+            for (e0, e1), lc in zip(spans, local_slices)
+        ]
+        stats["pack_s"] = stats.get("pack_s", 0.0) + (
+            time.perf_counter() - t0
+        )
+
+    t0 = time.perf_counter()
+    wire_dev, lc_dev = [], []
+    for c, ((e0, e1), lc) in enumerate(zip(spans, local_slices)):
+        wire = encoded[c] if encoded else _encode_chunk(e0, e1, lc)
+        wire_dev.append(tuple(jax.device_put(a) for a in wire))
         lc_dev.append(jax.device_put(lc))
     cu_dev = jax.device_put(counts_u.astype(np.int32))
     ci_dev = jax.device_put(np.ascontiguousarray(counts_i, np.int32))
@@ -1079,9 +1093,20 @@ def train_als(
                 n_edges, U_pad, _i64p(counts_u),
                 _i32p(i_sorted), _f32p(r_sorted),
             )
-            native.als_sort_within_entity(
+            rc = native.als_sort_within_entity(
                 _i32p(i_sorted), _f32p(r_sorted), U_pad, _i64p(counts_u)
             )
+            if rc != 0:  # a single user with ≥2^24 edges: sorter refuses
+                # wholesale. Training is order-invariant so this is safe,
+                # but the delta wire then won't apply (negative gaps →
+                # planes fallback) — say so instead of silently diverging
+                # from the numpy lexsort path.
+                import logging
+
+                logging.getLogger("pio_tpu.als").warning(
+                    "within-user item sort skipped (an entity exceeds "
+                    "2^24 edges); item wire falls back to planes"
+                )
         else:
             order = np.lexsort((item_idx, user_idx))
             i_sorted = np.ascontiguousarray(item_idx[order])
@@ -1121,6 +1146,11 @@ def train_als(
         n_stream = int(min(
             8, -(-edge_bytes // max(1, int(stream_mb * 2 ** 20)))
         ))
+        if config.iterations < 1:
+            # the streamed trainer fuses iteration 1's user half-step into
+            # the chunk accumulation, so it can't express "0 iterations";
+            # route those runs through the monolithic path
+            n_stream = 1
         if stats is not None:
             stats["n_stream"] = max(1, n_stream)
         if n_stream > 1:

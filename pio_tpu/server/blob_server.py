@@ -34,7 +34,7 @@ from typing import Optional
 from urllib.parse import unquote
 
 from pio_tpu.server.http import (
-    HTTPError, JsonHTTPServer, RawResponse, Request, Router,
+    FileResponse, HTTPError, JsonHTTPServer, Request, Router,
 )
 from pio_tpu.storage.blobstore import FileBlobBackend
 
@@ -71,10 +71,12 @@ class BlobServerService:
 
     def get_blob(self, req: Request):
         self._auth(req)
-        data = self.backend.get(self._key(req))
-        if data is None:
+        path = self.backend.local_path(self._key(req))
+        if path is None:
             raise HTTPError(404, "no such blob")
-        return 200, RawResponse(data, "application/octet-stream")
+        # streamed in constant memory — concurrent GETs of a multi-GB
+        # model must not each buffer the whole artifact
+        return 200, FileResponse(path)
 
     def head_blob(self, req: Request):
         self._auth(req)

@@ -204,6 +204,18 @@ class EventServerService:
             ids = Storage.get_levents().insert_batch(
                 [e for _, _, e in valid], app_id, channel_id
             )
+            if len(ids) != len(valid):  # a broken backend override must
+                # surface as per-item errors, not nulls in the response
+                log.error(
+                    "insert_batch returned %d ids for %d events",
+                    len(ids), len(valid),
+                )
+                for k, _, _ in valid[len(ids):]:
+                    results[k] = {
+                        "status": 500,
+                        "message": "storage returned no id for this event",
+                    }
+                valid = valid[: len(ids)]
             for (k, d, event), eid in zip(valid, ids):
                 self._post_ingest(d, event, app_id, channel_id)
                 results[k] = {"status": 201, "eventId": eid}

@@ -59,6 +59,18 @@ class RawResponse:
     headers: Dict[str, str] = field(default_factory=dict)
 
 
+@dataclass
+class FileResponse:
+    """Stream a file to the client in constant memory (the blob daemon's
+    GET path — a multi-GB model artifact must not be buffered per
+    request). The file is opened at response time; a vanished file
+    becomes a 404."""
+
+    path: str
+    content_type: str = "application/octet-stream"
+    chunk_size: int = 1 << 20
+
+
 Handler = Callable[[Request], Tuple[int, Any]]
 
 
@@ -108,6 +120,22 @@ def _make_handler_class(router: Router, server_name: str):
             # HEAD must carry Content-Length but NO body bytes — writing
             # them would desync keep-alive clients (RFC 9110 §9.3.2)
             head = self.command == "HEAD"
+            if isinstance(body, FileResponse):
+                try:
+                    f = open(body.path, "rb")
+                except OSError:
+                    self._respond(404, {"message": "no such blob"})
+                    return
+                with f:
+                    size = os.fstat(f.fileno()).st_size
+                    self.send_response(status)
+                    self.send_header("Content-Type", body.content_type)
+                    self.send_header("Content-Length", str(size))
+                    self.end_headers()
+                    if not head:
+                        while chunk := f.read(body.chunk_size):
+                            self.wfile.write(chunk)
+                return
             if isinstance(body, RawResponse):
                 payload = (
                     body.body if isinstance(body.body, bytes)

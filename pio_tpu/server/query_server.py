@@ -47,6 +47,10 @@ log = logging.getLogger("pio_tpu.queryserver")
 QUERY_BLOCKERS: List = []
 QUERY_SNIFFERS: List = []
 
+#: sentinel: the micro-batch dispatch failed; the waiting request thread
+#: runs the per-query fallback itself (see _MicroBatcher.submit)
+_BATCH_FAILED = object()
+
 
 
 
@@ -120,7 +124,11 @@ class _MicroBatcher:
         self._thread.start()
 
     def submit(self, query):
-        """Enqueue one query; blocks until its batch is served."""
+        """Enqueue one query; blocks until its batch is served. If the
+        batch dispatch failed, the fallback per-query predict runs HERE —
+        in the request's own thread — so one poisoned query degrades its
+        batch-mates to ordinary concurrent serving, not to a serial queue
+        behind the single worker."""
         pend = [query, None, None, threading.Event()]  # q, result, exc, done
         with self._cv:
             if self._stopped:
@@ -128,6 +136,8 @@ class _MicroBatcher:
             self._queue.append(pend)
             self._cv.notify()
         pend[3].wait()
+        if pend[2] is _BATCH_FAILED:
+            return self._service._predict_one(pend[0])
         if pend[2] is not None:
             raise pend[2]
         return pend[1]
@@ -175,13 +185,11 @@ class _MicroBatcher:
                     p[1] = r
             except Exception:
                 log.exception(
-                    "micro-batch dispatch failed; per-query fallback"
+                    "micro-batch dispatch failed; per-query fallback "
+                    "(runs in each request's own thread)"
                 )
                 for p in batch:
-                    try:
-                        p[1] = self._service._predict_one(p[0])
-                    except Exception as e:  # propagate to that caller only
-                        p[2] = e
+                    p[2] = _BATCH_FAILED
             for p in batch:
                 p[3].set()
 

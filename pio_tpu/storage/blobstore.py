@@ -99,6 +99,12 @@ class FileBlobBackend(BlobBackend):
     def exists(self, key: str) -> bool:
         return os.path.exists(self._path(key))
 
+    def local_path(self, key: str) -> Optional[str]:
+        """Filesystem path of a stored blob (None if absent) — lets the
+        blob daemon stream GETs instead of buffering whole artifacts."""
+        p = self._path(key)
+        return p if os.path.exists(p) else None
+
     def list(self, prefix: str) -> List[str]:
         base_dir = self._path(prefix) if prefix else self.root
         out = []

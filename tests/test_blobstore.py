@@ -208,6 +208,30 @@ class TestHTTPBlobScheme:
         finally:
             conn.close()
 
+    def test_large_blob_streams_exact_bytes(self, blob_daemon):
+        """Multi-MB GET rides the FileResponse streaming path (constant
+        memory); framing must stay exact on a keep-alive connection."""
+        import hashlib
+        import http.client
+        from urllib.parse import urlsplit
+
+        payload = bytes(range(256)) * 32768  # 8 MiB, binary
+        b = open_blob_backend(blob_daemon)
+        b.put("objects/big", payload)
+        host, port = urlsplit(blob_daemon).netloc.split(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=30)
+        try:
+            for _ in range(2):  # twice on one connection: framing holds
+                conn.request("GET", "/blobs/objects/big")
+                r = conn.getresponse()
+                got = r.read()
+                assert r.status == 200
+                assert int(r.headers["Content-Length"]) == len(payload)
+                assert hashlib.sha256(got).hexdigest() == \
+                    hashlib.sha256(payload).hexdigest()
+        finally:
+            conn.close()
+
     def test_daemon_rejects_escaping_keys(self, blob_daemon):
         import urllib.error
         import urllib.request

@@ -436,6 +436,40 @@ class TestQueryServer:
         finally:
             server.stop()
 
+    def test_microbatch_poisoned_query_falls_back_concurrently(self):
+        """One query whose batch dispatch fails must not serialize its
+        batch-mates behind the worker thread: the fallback per-query
+        predict runs in each request's own thread, and only the poisoned
+        query's caller sees the error."""
+        import concurrent.futures
+
+        from pio_tpu.server.query_server import _MicroBatcher
+
+        class StubService:
+            def _predict_batch(self, queries):
+                raise RuntimeError("poisoned batch")
+
+            def _predict_one(self, query):
+                if query == "bad":
+                    raise ValueError("bad query")
+                return f"ok:{query}"
+
+        mb = _MicroBatcher(StubService(), window_s=0.005)
+        try:
+            def one(q):
+                try:
+                    return mb.submit(q)
+                except ValueError as e:
+                    return f"err:{e}"
+
+            qs = [f"q{i}" for i in range(8)] + ["bad"]
+            with concurrent.futures.ThreadPoolExecutor(9) as ex:
+                got = list(ex.map(one, qs))
+            assert got[:8] == [f"ok:q{i}" for i in range(8)]
+            assert got[8] == "err:bad query"
+        finally:
+            mb.stop()
+
     def test_no_trained_instance_errors(self, app_and_key):
         variant = variant_from_dict({**VARIANT, "id": "never-trained"})
         with pytest.raises(RuntimeError, match="no COMPLETED engine instance"):
