@@ -79,6 +79,26 @@ class _Stats:
             ],
         }
 
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition of the ingest counters (scrapeable
+        observability — an upgrade over the reference's JSON-only stats)."""
+        from pio_tpu.server.metrics import escape_label
+
+        lines = [
+            "# HELP pio_events_ingested_total Events by app/event/status",
+            "# TYPE pio_events_ingested_total counter",
+        ]
+        with self._lock:
+            items = sorted(self.counts.items())
+        for (app_id, event, etype, status), n in items:
+            lines.append(
+                "pio_events_ingested_total{"
+                f'app_id="{app_id}",event="{escape_label(event)}",'
+                f'entity_type="{escape_label(etype)}",status="{status}"'
+                f"}} {n}"
+            )
+        return "\n".join(lines) + "\n"
+
 
 def _parse_limit(params) -> Optional[int]:
     """Shared ``limit`` query-param contract for the read routes:
@@ -110,6 +130,7 @@ class EventServerService:
         r.add("DELETE", "/events/([^/]+)\\.json", self.delete_event)
         r.add("POST", "/batch/events\\.json", self.batch_events)
         r.add("GET", "/stats\\.json", self.get_stats)
+        r.add("GET", "/metrics", self.get_metrics)
         r.add("POST", "/webhooks/([^/]+)\\.json", self.webhook_json)
         r.add("POST", "/webhooks/([^/]+)\\.form", self.webhook_form)
         r.add("GET", "/plugins\\.json", self.list_plugins)
@@ -295,6 +316,11 @@ class EventServerService:
 
     def get_stats(self, req: Request):
         return 200, self.stats.to_dict()
+
+    def get_metrics(self, req: Request):
+        from pio_tpu.server.metrics import render
+
+        return 200, render(self.stats.to_prometheus())
 
     def webhook_json(self, req: Request):
         app_id, channel_id, whitelist = self._auth(req)

@@ -1,0 +1,32 @@
+"""Prometheus text-exposition helpers for the HTTP servers.
+
+The reference exposes operational state as JSON only (`/stats.json` on the
+Event and Query servers — `data/api/Stats.scala`, `CreateServer.scala`,
+UNVERIFIED paths; SURVEY.md §5 observability row). This module adds the
+de-facto standard scrape format on top — ``GET /metrics`` on both servers —
+so the rebuild drops into Prometheus/Grafana stacks without an exporter
+sidecar. Counters only (no client library dependency); the text format is
+simple enough to emit directly.
+"""
+
+from __future__ import annotations
+
+
+def escape_label(value: str) -> str:
+    """Escape a label value per the Prometheus text format."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def render(lines) -> "object":
+    """Wrap exposition lines in the proper content type."""
+    from pio_tpu.server.http import RawResponse
+
+    body = lines if isinstance(lines, str) else "\n".join(lines) + "\n"
+    return RawResponse(
+        body, content_type="text/plain; version=0.0.4; charset=utf-8"
+    )

@@ -232,6 +232,7 @@ class QueryServerService:
         r.add("GET", "/", self.status)
         r.add("POST", "/queries\\.json", self.query)
         r.add("GET", "/stats\\.json", self.get_stats)
+        r.add("GET", "/metrics", self.get_metrics)
         r.add("POST", "/reload", self.reload)
         r.add("POST", "/undeploy", self.undeploy)
         r.add("GET", "/plugins\\.json", self.list_plugins)
@@ -371,6 +372,47 @@ class QueryServerService:
         if self._batcher is not None:
             out["microbatch"] = self._batcher.to_dict()
         return 200, out
+
+    def get_metrics(self, req: Request):
+        """Prometheus text exposition: request/error counters, latency
+        quantiles from the reservoir, micro-batch counters when on."""
+        from pio_tpu.server.metrics import escape_label, render
+
+        s = self.stats.to_dict()
+        eng = escape_label(self.variant.engine_id)
+        lab = f'engine_id="{eng}"'
+        lines = [
+            "# TYPE pio_queries_total counter",
+            f"pio_queries_total{{{lab}}} {s['requestCount']}",
+            "# TYPE pio_query_errors_total counter",
+            f"pio_query_errors_total{{{lab}}} {s['errorCount']}",
+        ]
+        if s["avgMs"] is not None:
+            lines += [
+                "# TYPE pio_query_latency_ms summary",
+                f'pio_query_latency_ms{{{lab},quantile="0.5"}} '
+                f"{s['p50Ms']}",
+                f'pio_query_latency_ms{{{lab},quantile="0.95"}} '
+                f"{s['p95Ms']}",
+                f'pio_query_latency_ms{{{lab},quantile="0.99"}} '
+                f"{s['p99Ms']}",
+                # _sum/_count complete the summary convention so
+                # rate(_sum)/rate(_count) windowed averages work
+                f"pio_query_latency_ms_sum{{{lab}}} "
+                f"{s['avgMs'] * s['requestCount']}",
+                f"pio_query_latency_ms_count{{{lab}}} "
+                f"{s['requestCount']}",
+            ]
+        if self._batcher is not None:
+            mb = self._batcher.to_dict()
+            lines += [
+                "# TYPE pio_microbatch_batches_total counter",
+                f"pio_microbatch_batches_total{{{lab}}} {mb['batches']}",
+                "# TYPE pio_microbatch_queries_total counter",
+                f"pio_microbatch_queries_total{{{lab}}} "
+                f"{mb['batchedQueries']}",
+            ]
+        return 200, render(lines)
 
     def _check_admin(self, req: Request):
         if self.admin_key is not None:

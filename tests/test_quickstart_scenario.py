@@ -82,6 +82,9 @@ STORAGE_COMBOS = {
         "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "BLOB",
         "PIO_STORAGE_SOURCES_BLOB_TYPE": "blob",
+        # also exercise the serving micro-batch aggregator through the
+        # CLI-deployed server in this combo
+        "PIO_TPU_SERVE_MICROBATCH_US": "1000",
     },
     "searchable-everything": {
         "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "ES",

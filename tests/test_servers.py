@@ -239,6 +239,21 @@ class TestEventServer:
         assert {"event": "rate", "entityType": "user", "status": 201, "count": 1} in counts
         assert any(c["status"] == 400 for c in counts)
 
+    def test_prometheus_metrics(self, eventserver, app_and_key):
+        """GET /metrics: Prometheus text exposition of ingest counters."""
+        import urllib.request
+
+        _, key = app_and_key
+        http("POST", f"{eventserver}/events.json?accessKey={key}", EV)
+        with urllib.request.urlopen(
+            f"{eventserver}/metrics", timeout=10
+        ) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "# TYPE pio_events_ingested_total counter" in text
+        assert 'event="rate"' in text and 'status="201"' in text
+
     def test_webhook_json(self, eventserver, app_and_key):
         app_id, key = app_and_key
         payload = {
@@ -435,6 +450,17 @@ class TestQueryServer:
             assert stats["microbatch"]["batches"] == mb["batches"]
         finally:
             server.stop()
+
+    def test_query_server_prometheus_metrics(self, queryserver):
+        import urllib.request
+
+        url, _, _ = queryserver
+        http("POST", f"{url}/queries.json", {"user": "u1", "num": 2})
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+            assert r.status == 200
+            text = r.read().decode()
+        assert "pio_queries_total{" in text
+        assert 'quantile="0.95"' in text
 
     def test_microbatch_poisoned_query_falls_back_concurrently(self):
         """One query whose batch dispatch fails must not serialize its
