@@ -82,6 +82,25 @@ class BiMap(Generic[K, V]):
         distinct = sorted(set(keys))
         return BiMap({k: i for i, k in enumerate(distinct)})
 
+    @staticmethod
+    def string_int_by_frequency(keys: Iterable[str]) -> "BiMap[str, int]":
+        """Index distinct keys 0..n-1 by DESCENDING occurrence count
+        (ties lexicographic, so the assignment stays deterministic).
+
+        The TPU-aware index for interaction data: popular entities get
+        low codes, which (a) clusters the hot rows of factor/embedding
+        tables — better cache behavior for the training gathers and the
+        serving scorer — and (b) makes the ALS delta item wire denser
+        (most within-user gaps land among the small ids). Semantically
+        interchangeable with :meth:`string_int`; only the code
+        assignment differs.
+        """
+        from collections import Counter
+
+        counts = Counter(keys)
+        ordered = sorted(counts, key=lambda k: (-counts[k], k))
+        return BiMap({k: i for i, k in enumerate(ordered)})
+
     # The reference distinguishes Int vs Long indices (JVM); in Python both
     # are `int`, so stringLong is an alias kept for API parity.
     string_long = string_int

@@ -79,8 +79,8 @@ class _Stats:
             ],
         }
 
-    def to_prometheus(self) -> str:
-        """Prometheus text exposition of the ingest counters (scrapeable
+    def to_prometheus(self) -> list:
+        """Prometheus exposition lines for the ingest counters (scrapeable
         observability — an upgrade over the reference's JSON-only stats)."""
         from pio_tpu.server.metrics import escape_label
 
@@ -97,7 +97,7 @@ class _Stats:
                 f'entity_type="{escape_label(etype)}",status="{status}"'
                 f"}} {n}"
             )
-        return "\n".join(lines) + "\n"
+        return lines
 
 
 def _parse_limit(params) -> Optional[int]:

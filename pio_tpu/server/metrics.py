@@ -22,11 +22,12 @@ def escape_label(value: str) -> str:
     )
 
 
-def render(lines) -> "object":
-    """Wrap exposition lines in the proper content type."""
+def render(lines: list) -> "object":
+    """Wrap exposition lines (a list — the one shape every metric surface
+    uses) in the proper content type."""
     from pio_tpu.server.http import RawResponse
 
-    body = lines if isinstance(lines, str) else "\n".join(lines) + "\n"
     return RawResponse(
-        body, content_type="text/plain; version=0.0.4; charset=utf-8"
+        "\n".join(lines) + "\n",
+        content_type="text/plain; version=0.0.4; charset=utf-8",
     )

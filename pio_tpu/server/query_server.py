@@ -382,8 +382,10 @@ class QueryServerService:
         eng = escape_label(self.variant.engine_id)
         lab = f'engine_id="{eng}"'
         lines = [
+            "# HELP pio_queries_total Queries served",
             "# TYPE pio_queries_total counter",
             f"pio_queries_total{{{lab}}} {s['requestCount']}",
+            "# HELP pio_query_errors_total Queries that errored",
             "# TYPE pio_query_errors_total counter",
             f"pio_query_errors_total{{{lab}}} {s['errorCount']}",
         ]

@@ -158,8 +158,10 @@ class SequencePreparator(Preparator):
         all_items: List[str] = []
         for h in td.histories.values():
             all_items.extend(h)
-        # BiMap codes start at 0; shift by +1 so 0 stays the pad id
-        item_index = BiMap.string_int(all_items)
+        # BiMap codes start at 0; shift by +1 so 0 stays the pad id.
+        # Popularity ordering clusters hot embedding rows (the
+        # vocab-sharded gather's locality) — codes stay deterministic.
+        item_index = BiMap.string_int_by_frequency(all_items)
         fwd = item_index.to_dict()
         users = sorted(td.histories)
         t = max(len(td.histories[u]) for u in users)

@@ -181,9 +181,11 @@ class SimilarProductPreparator(Preparator):
     def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
         user_index = BiMap.string_int(td.user_ids.tolist())
         # include items that only appear as $set entities so category-only
-        # items still get factor rows (cold but filterable)
+        # items still get factor rows (cold but filterable); popularity
+        # ordering clusters hot factor rows (gather locality + denser
+        # delta wire)
         all_items = td.item_ids.tolist() + sorted(td.item_categories)
-        item_index = BiMap.string_int(all_items)
+        item_index = BiMap.string_int_by_frequency(all_items)
         ufwd, ifwd = user_index.to_dict(), item_index.to_dict()
         user_codes = np.fromiter(
             (ufwd[u] for u in td.user_ids.tolist()), np.int32, len(td)

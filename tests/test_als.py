@@ -364,6 +364,42 @@ class TestALS:
         assert (outs["planes"].item_factors
                 == outs["delta12"].item_factors).all()
 
+    def test_streamed_delta_overflow_and_chunk_carry(self, monkeypatch):
+        """Sparse adjacencies over a wide item space: deltas overflow the
+        12-bit field (sparse overflow list) AND chunk boundaries split
+        users mid-adjacency (the first in-chunk edge ships its ABSOLUTE
+        id, itself often an overflow). Streamed delta12 must still match
+        planes bitwise."""
+        from pio_tpu.models.als import _delta_wire_size
+
+        rng = np.random.default_rng(17)
+        U, I, E = 25, 50_000, 1_200
+        u = np.sort(rng.integers(0, U, E)).astype(np.int32)
+        i = rng.integers(0, I, E).astype(np.int32)  # mean gap ~2k, tail >4095
+        r = (rng.integers(1, 11, E) * 0.5).astype(np.float32)
+        # sanity: this workload really produces overflow entries
+        order = np.lexsort((i, u))
+        counts = np.bincount(u, minlength=U).astype(np.int64)
+        _, n_ovf = _delta_wire_size(
+            np.ascontiguousarray(i[order]), counts
+        )
+        assert n_ovf > 0, "fixture must exercise the overflow list"
+
+        cfg = ALSConfig(rank=4, iterations=5, reg=0.1, blocks_per_chunk=16)
+        monkeypatch.setenv("PIO_TPU_ALS_STREAM_MB", "0.0002")  # many chunks
+        outs = {}
+        for wire in ("planes", "delta12"):
+            monkeypatch.setenv("PIO_TPU_ALS_ITEM_WIRE", wire)
+            st = {}
+            outs[wire] = train_als(
+                ComputeContext.local(), u, i, r, U, I, cfg, stats=st
+            )
+            assert st["n_stream"] > 1, st
+        assert (outs["planes"].user_factors
+                == outs["delta12"].user_factors).all()
+        assert (outs["planes"].item_factors
+                == outs["delta12"].item_factors).all()
+
     def test_native_delta_encoder_matches_numpy(self, monkeypatch):
         """The C++ delta encoder must be bit-identical to the numpy
         reference (wire format parity, overflow entries included)."""

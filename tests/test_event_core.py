@@ -82,6 +82,17 @@ class TestBiMap:
         assert m.to_dict() == {"u1": 0, "u2": 1, "u3": 2}
         assert BiMap.string_long(["u3", "u1", "u2"]) == m
 
+    def test_string_int_by_frequency(self):
+        """Popularity ordering: descending count, lexicographic ties —
+        deterministic, bijective, same key set as string_int."""
+        keys = ["i2", "i9", "i2", "i2", "i9", "i5"]
+        m = BiMap.string_int_by_frequency(keys)
+        assert m.to_dict() == {"i2": 0, "i9": 1, "i5": 2}
+        # tie-break is lexicographic, not insertion order
+        t = BiMap.string_int_by_frequency(["b", "a"])
+        assert t.to_dict() == {"a": 0, "b": 1}
+        assert set(m.to_dict()) == set(BiMap.string_int(keys).to_dict())
+
     def test_get_and_contains(self):
         m = BiMap.string_int(["x"])
         assert "x" in m and m.get("y") is None and len(m) == 1
