@@ -554,6 +554,7 @@ def main() -> None:
     # set before the first Storage touch; always overridden — bench junk
     # must never land in a real deployment home)
     os.environ["PIO_TPU_HOME"] = tempfile.mkdtemp(prefix="pio_tpu_bench_")
+    t_main = time.perf_counter()
     import jax
 
     from pio_tpu.models.als import ALSConfig
@@ -644,6 +645,19 @@ def main() -> None:
     if os.environ.get("PIO_TPU_BENCH_SECONDARY", "1") != "0":
         sscale = float(os.environ.get("PIO_TPU_BENCH_SCALE", "1"))
         cpu_dev = jax.devices("cpu")[0]
+        # the one JSON line must always print: past the deadline the
+        # remaining secondary stages are skipped (with a stderr note)
+        # rather than risking the whole run being cut off
+        deadline_s = float(
+            os.environ.get("PIO_TPU_BENCH_DEADLINE_S", "3000")
+        )
+
+        def over_deadline(stage: str) -> bool:
+            if time.perf_counter() - t_main > deadline_s:
+                print(f"# deadline reached; skipping {stage}",
+                      file=sys.stderr)
+                return True
+            return False
 
         def run_on_cpu(fn, frac):
             """Own-CPU anchor: SAME program on the XLA-CPU device, with a
@@ -663,6 +677,8 @@ def main() -> None:
              0.1),
             ("twotower_examples_per_sec", _bench_twotower, 1.0),
         ):
+            if over_deadline(name):
+                continue  # note every skipped stage, not just the first
             try:
                 v = fn(ctx, sscale)
                 entry = {"value": round(v, 1)}
@@ -677,27 +693,29 @@ def main() -> None:
             except Exception as exc:
                 print(f"# secondary {name} failed: {exc}", file=sys.stderr)
 
-        try:
-            tc = _bench_textclass(sscale)
+        if not over_deadline("textclassification"):
             try:
-                with jax.default_device(cpu_dev):
-                    tc_cpu = _bench_textclass(sscale * 0.25)
-                best = tc.get(
-                    "pallas_tokens_per_sec", tc["xla_tokens_per_sec"]
-                )
-                tc["cpu_anchor"] = tc_cpu["xla_tokens_per_sec"]
-                tc["vs_baseline"] = round(
-                    best / tc_cpu["xla_tokens_per_sec"], 2
-                )
+                tc = _bench_textclass(sscale)
+                try:
+                    with jax.default_device(cpu_dev):
+                        tc_cpu = _bench_textclass(sscale * 0.25)
+                    best = tc.get(
+                        "pallas_tokens_per_sec", tc["xla_tokens_per_sec"]
+                    )
+                    tc["cpu_anchor"] = tc_cpu["xla_tokens_per_sec"]
+                    tc["vs_baseline"] = round(
+                        best / tc_cpu["xla_tokens_per_sec"], 2
+                    )
+                except Exception as exc:
+                    print(f"# cpu anchor textclassification failed: {exc}",
+                          file=sys.stderr)
+                secondary["textclassification"] = tc
             except Exception as exc:
-                print(f"# cpu anchor textclassification failed: {exc}",
+                print(f"# secondary textclassification failed: {exc}",
                       file=sys.stderr)
-            secondary["textclassification"] = tc
-        except Exception as exc:
-            print(f"# secondary textclassification failed: {exc}",
-                  file=sys.stderr)
 
-        if os.environ.get("PIO_TPU_BENCH_RANKSWEEP", "1") != "0":
+        if os.environ.get("PIO_TPU_BENCH_RANKSWEEP", "1") != "0" \
+                and not over_deadline("als_rank_sweep"):
             try:
                 secondary["als_rank_sweep"] = _bench_rank_sweep(
                     ctx, sscale
@@ -705,12 +723,13 @@ def main() -> None:
             except Exception as exc:
                 print(f"# rank sweep failed: {exc}", file=sys.stderr)
 
-        try:
-            secondary["eventserver_events_per_sec"] = _bench_event_ingest(
-                sscale
-            )
-        except Exception as exc:
-            print(f"# event ingest failed: {exc}", file=sys.stderr)
+        if not over_deadline("eventserver_events_per_sec"):
+            try:
+                secondary["eventserver_events_per_sec"] = (
+                    _bench_event_ingest(sscale)
+                )
+            except Exception as exc:
+                print(f"# event ingest failed: {exc}", file=sys.stderr)
 
     vs_baseline = rate_per_chip / cpu_rate if cpu_rate else 1.0
     out = {
