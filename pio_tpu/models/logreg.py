@@ -29,12 +29,13 @@ class LogRegConfig:
     learning_rate: float = 0.1
     reg: float = 0.0  # L2 on weights (not bias)
     seed: int = 0
-    #: feature wire + matmul dtype. "bfloat16" (default) halves the
-    #: host→device feature shipment — the dominant cost of a full-batch
-    #: train on a slow link — and runs the logits matmul at the MXU's
-    #: native rate; gradients, optimizer state, and the loss stay
-    #: float32. "float32" for exact-arithmetic needs.
-    input_dtype: str = "bfloat16"
+    #: feature wire + matmul dtype. "float32" (default) keeps exact
+    #: full-precision numerics, matching the reference's MLlib path.
+    #: Opt into "bfloat16" to halve the host→device feature shipment —
+    #: the dominant cost of a full-batch train on a slow link — and run
+    #: the logits matmul at the MXU's native rate; gradients, optimizer
+    #: state, and the loss stay float32 either way.
+    input_dtype: str = "float32"
 
 
 @dataclasses.dataclass

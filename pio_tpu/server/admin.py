@@ -17,7 +17,9 @@ from __future__ import annotations
 
 from typing import Any, Tuple
 
-from pio_tpu.server.http import HTTPError, JsonHTTPServer, Request, Router
+from pio_tpu.server.http import (
+    HTTPError, JsonHTTPServer, Request, Router, keys_equal,
+)
 from pio_tpu.storage import AccessKey, App, Storage
 
 
@@ -42,7 +44,7 @@ class AdminService:
 
     def _check_admin(self, req: Request):
         if self.admin_key is not None:
-            if req.bearer_key() != self.admin_key:
+            if not keys_equal(req.bearer_key(), self.admin_key):
                 raise HTTPError(401, "invalid admin accessKey")
         elif req.client_addr not in ("127.0.0.1", "::1"):
             raise HTTPError(

@@ -34,7 +34,7 @@ from typing import Optional
 from urllib.parse import unquote
 
 from pio_tpu.server.http import (
-    FileResponse, HTTPError, JsonHTTPServer, Request, Router,
+    FileResponse, HTTPError, JsonHTTPServer, Request, Router, keys_equal,
 )
 from pio_tpu.storage.blobstore import FileBlobBackend
 
@@ -55,7 +55,9 @@ class BlobServerService:
         r.add("GET", "/keys", self.list_keys)
 
     def _auth(self, req: Request) -> None:
-        if self.access_key is not None and req.bearer_key() != self.access_key:
+        if self.access_key is not None and not keys_equal(
+            req.bearer_key(), self.access_key
+        ):
             raise HTTPError(401, "invalid accessKey")
 
     @staticmethod
@@ -86,8 +88,13 @@ class BlobServerService:
 
     def put_blob(self, req: Request):
         self._auth(req)
-        self.backend.put(self._key(req), req.raw_body)
-        return 201, {"stored": len(req.raw_body)}
+        if req.body_file is not None:
+            # large uploads arrive spooled — stream to disk, never buffer
+            n = self.backend.put_file(self._key(req), req.body_file)
+        else:
+            n = len(req.raw_body)
+            self.backend.put(self._key(req), req.raw_body)
+        return 201, {"stored": n}
 
     def delete_blob(self, req: Request):
         self._auth(req)
@@ -109,5 +116,8 @@ def create_blob_server(
     """Build an (unstarted) blob daemon serving ``root`` over HTTP."""
     service = BlobServerService(root, access_key=access_key)
     return JsonHTTPServer(
-        service.router, host, port, name="pio-tpu-blobserver"
+        service.router, host, port, name="pio-tpu-blobserver",
+        # reject bad keys BEFORE the body is spooled off the socket —
+        # an unauthenticated PUT must not burn disk up to the body limit
+        pre_body=service._auth,
     )

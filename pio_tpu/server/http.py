@@ -8,18 +8,46 @@ external dependencies. Handlers receive a :class:`Request` and return
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import os
 import re
+import socket
+import socketserver
 import ssl
+import tempfile
 import threading
+import time
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, List, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from http.server import ThreadingHTTPServer
+from typing import Any, BinaryIO, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs
 
 log = logging.getLogger("pio_tpu.server")
+
+#: Reject request bodies above this many MiB with 413 (configurable —
+#: model artifacts PUT to the blob daemon can be large, but an unbounded
+#: body is a trivial memory/disk DoS on any network-facing server).
+MAX_BODY_MB = float(os.environ.get("PIO_TPU_MAX_BODY_MB", "4096"))
+
+#: Octet-stream bodies above this spill from memory to a temp file while
+#: being read off the socket (the blob daemon's PUT path — a multi-GB
+#: artifact must not be buffered per request).
+_SPOOL_BYTES = 8 << 20
+
+#: Structured (JSON/form) bodies are parsed in memory, so they get a much
+#: tighter cap than raw octet-stream uploads — without it, a request with
+#: a non-binary Content-Type and a huge Content-Length would be buffered
+#: whole in RAM before any handler (or auth) ran.
+MAX_JSON_BODY_MB = float(os.environ.get("PIO_TPU_MAX_JSON_BODY_MB", "64"))
+
+
+def keys_equal(provided: str, expected: str) -> bool:
+    """Constant-time access-key comparison (no prefix-length timing leak)."""
+    return hmac.compare_digest(
+        provided.encode("utf-8", "replace"), expected.encode("utf-8", "replace")
+    )
 
 
 @dataclass
@@ -29,6 +57,9 @@ class Request:
     params: Dict[str, str]
     body: Optional[Any]  # parsed JSON (or raw str for form posts)
     raw_body: bytes = b""
+    #: large octet-stream bodies arrive here (spooled, seeked to 0)
+    #: instead of raw_body — closed by the server after the handler runs
+    body_file: Optional[BinaryIO] = None
     #: header names lowercased (HTTP/2-origin clients send lowercase)
     headers: Dict[str, str] = field(default_factory=dict)
     path_args: Tuple[str, ...] = ()
@@ -101,20 +132,82 @@ class Router:
         return 404, {"message": f"no route for {req.method} {req.path}"}
 
 
-def _make_handler_class(router: Router, server_name: str):
-    class JsonHandler(BaseHTTPRequestHandler):
-        server_version = server_name
-        protocol_version = "HTTP/1.1"
-        # Keep-alive clients stall ~40 ms/request without these: headers
-        # and body leave in separate small writes, and Nagle holds the
-        # second segment until the client's delayed ACK. Buffer the
-        # response into one write (handle_one_request flushes) and turn
-        # Nagle off for whatever remains split.
+_REASONS = {
+    200: "OK", 201: "Created", 204: "No Content", 301: "Moved Permanently",
+    302: "Found", 304: "Not Modified", 400: "Bad Request",
+    401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
+    405: "Method Not Allowed", 411: "Length Required",
+    413: "Content Too Large", 431: "Request Header Fields Too Large",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+
+_ALLOWED_METHODS = frozenset(
+    {"GET", "POST", "PUT", "DELETE", "HEAD", "OPTIONS", "PATCH"}
+)
+
+_date_cache: Tuple[int, str] = (0, "")
+
+
+def _http_date() -> str:
+    """RFC 9110 Date header value, recomputed at most once per second —
+    ``email.utils.formatdate`` costs more than the rest of a response."""
+    global _date_cache
+    now = int(time.time())
+    if _date_cache[0] != now:
+        import email.utils
+
+        _date_cache = (now, email.utils.formatdate(now, usegmt=True))
+    return _date_cache[1]
+
+
+def _make_handler_class(
+    router: Router,
+    server_name: str,
+    pre_body: Optional[Callable[[Request], None]] = None,
+):
+    """Per-connection handler with a hand-rolled HTTP/1.1 parser.
+
+    ``http.server``'s ``BaseHTTPRequestHandler`` parses headers through
+    ``email.parser`` — measured ~200 µs per request, about half the total
+    server-side cost on this stack's single-core serving path. This
+    handler reads the request line and headers with plain ``readline`` +
+    ``partition`` and writes each response as one buffered payload, which
+    also keeps the round-3 Nagle/keep-alive discipline (single write per
+    response, TCP_NODELAY on).
+    """
+
+    class JsonHandler(socketserver.StreamRequestHandler):
+        rbufsize = 64 * 1024
         wbufsize = 64 * 1024
         disable_nagle_algorithm = True
 
-        def log_message(self, fmt, *args):  # route to logging, not stderr
-            log.debug("%s %s", self.address_string(), fmt % args)
+        command = ""  # current request method (HEAD gates body writes)
+
+        def handle(self):
+            self.close_connection = False
+            try:
+                while not self.close_connection:
+                    if not self._handle_one():
+                        break
+            except (ConnectionError, TimeoutError):
+                pass
+            except OSError:
+                pass
+
+        # -- response writing ------------------------------------------
+        def _head_bytes(self, status, ctype, length, extra=()) -> bytes:
+            head = (
+                f"HTTP/1.1 {status} {_REASONS.get(status, '')}\r\n"
+                f"Server: {server_name}\r\n"
+                f"Date: {_http_date()}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {length}\r\n"
+            )
+            for k, v in extra:
+                head += f"{k}: {v}\r\n"
+            if self.close_connection:
+                head += "Connection: close\r\n"
+            return (head + "\r\n").encode("latin-1")
 
         def _respond(self, status: int, body: Any):
             # HEAD must carry Content-Length but NO body bytes — writing
@@ -128,27 +221,27 @@ def _make_handler_class(router: Router, server_name: str):
                     return
                 with f:
                     size = os.fstat(f.fileno()).st_size
-                    self.send_response(status)
-                    self.send_header("Content-Type", body.content_type)
-                    self.send_header("Content-Length", str(size))
-                    self.end_headers()
+                    self.wfile.write(
+                        self._head_bytes(status, body.content_type, size)
+                    )
                     if not head:
                         while chunk := f.read(body.chunk_size):
                             self.wfile.write(chunk)
+                self.wfile.flush()
                 return
             if isinstance(body, RawResponse):
                 payload = (
                     body.body if isinstance(body.body, bytes)
                     else body.body.encode()
                 )
-                self.send_response(status)
-                self.send_header("Content-Type", body.content_type)
-                self.send_header("Content-Length", str(len(payload)))
-                for k, v in body.headers.items():
-                    self.send_header(k, v)
-                self.end_headers()
+                out = self._head_bytes(
+                    status, body.content_type, len(payload),
+                    body.headers.items(),
+                )
                 if not head:
-                    self.wfile.write(payload)
+                    out += payload
+                self.wfile.write(out)
+                self.wfile.flush()
                 return
             try:
                 payload = json.dumps(body).encode() if body is not None else b""
@@ -158,31 +251,175 @@ def _make_handler_class(router: Router, server_name: str):
                 log.exception("response not JSON-serializable")
                 status = 500
                 payload = b'{"message": "response not JSON-serializable"}'
-            self.send_response(status)
-            self.send_header("Content-Type", "application/json; charset=UTF-8")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
+            out = self._head_bytes(
+                status, "application/json; charset=UTF-8", len(payload)
+            )
             if payload and not head:
-                self.wfile.write(payload)
+                out += payload
+            self.wfile.write(out)
+            self.wfile.flush()
 
-        def _handle(self, method: str):
-            parsed = urlparse(self.path)
-            params = {
-                k: v[0] for k, v in parse_qs(parsed.query).items()
-            }
-            if self.headers.get("Transfer-Encoding"):
-                # Chunked bodies aren't framed by Content-Length; reading them
-                # naively corrupts keep-alive framing. Reject and close.
-                self.close_connection = True
-                self._respond(411, {"message": "Content-Length required"})
+        def _reject(self, status: int, message: str) -> bool:
+            """Terminal error response: close the connection after it."""
+            self.close_connection = True
+            self._respond(status, {"message": message})
+            return False
+
+        # -- request parsing -------------------------------------------
+        def _handle_one(self) -> bool:
+            self.command = ""
+            line = self.rfile.readline(65537)
+            if not line:
+                return False  # client closed the keep-alive connection
+            if len(line) > 65536:
+                return self._reject(400, "request line too long")
+            line = line.strip()
+            if not line:
+                return True  # stray CRLF between requests — tolerated
+            parts = line.split()
+            if len(parts) != 3:
+                return self._reject(400, "malformed request line")
+            try:
+                method = parts[0].decode("ascii")
+                target = parts[1].decode("latin-1")
+            except UnicodeDecodeError:
+                return self._reject(400, "malformed request line")
+            version = parts[2]
+            if not version.startswith(b"HTTP/1."):
+                return self._reject(400, "unsupported HTTP version")
+            if method not in _ALLOWED_METHODS:
+                return self._reject(405, f"method {method!r} not allowed")
+
+            headers: Dict[str, str] = {}
+            last = None
+            for _ in range(200):
+                hline = self.rfile.readline(65537)
+                if not hline:
+                    return False  # peer vanished mid-headers
+                if len(hline) > 65536:
+                    return self._reject(431, "header line too long")
+                if hline in (b"\r\n", b"\n"):
+                    break
+                if hline[:1] in (b" ", b"\t"):
+                    # RFC 9112 obs-fold continuation line
+                    if last is not None:
+                        headers[last] += (
+                            " " + hline.strip().decode("latin-1")
+                        )
+                    continue
+                name, sep, value = hline.partition(b":")
+                if not sep:
+                    return self._reject(400, "malformed header")
+                last = name.strip().decode("latin-1").lower()
+                val = value.strip().decode("latin-1")
+                if last in ("content-length", "transfer-encoding") \
+                        and headers.get(last, val) != val:
+                    # differing duplicate framing headers are a request-
+                    # smuggling primitive behind a proxy (RFC 9112 §6.3)
+                    return self._reject(400, f"duplicate {last}")
+                headers[last] = val
+            else:
+                return self._reject(431, "too many headers")
+
+            self.command = method
+            conn_tok = headers.get("connection", "").lower()
+            if version == b"HTTP/1.0":
+                self.close_connection = "keep-alive" not in conn_tok
+            else:
+                self.close_connection = "close" in conn_tok
+            self._dispatch(method, target, headers)
+            return not self.close_connection
+
+        def _dispatch(self, method: str, target: str, headers: Dict[str, str]):
+            path, _, query = target.partition("?")
+            params = (
+                {k: v[0] for k, v in parse_qs(query).items()}
+                if query else {}
+            )
+            if headers.get("transfer-encoding"):
+                # Chunked bodies aren't framed by Content-Length; reading
+                # them naively corrupts keep-alive framing. Reject + close.
+                self._reject(411, "Content-Length required")
                 return
-            length = int(self.headers.get("Content-Length") or 0)
-            raw = self.rfile.read(length) if length else b""
+            try:
+                length = int(headers.get("content-length") or 0)
+            except ValueError:
+                self._reject(400, "bad Content-Length")
+                return
+            if length < 0:
+                # read(-1) would mean read-to-EOF: a held-open connection
+                # pins this thread and the eventual body is garbage
+                self._reject(400, "bad Content-Length")
+                return
+            if length > MAX_BODY_MB * 2 ** 20:
+                # can't cheaply drain an over-limit body; close instead
+                self._reject(
+                    413, f"body exceeds {MAX_BODY_MB:g} MiB limit"
+                )
+                return
+            ctype = headers.get("content-type", "").lower()
+            octet = ctype.startswith("application/octet-stream")
+            if length and not octet \
+                    and length > MAX_JSON_BODY_MB * 2 ** 20:
+                # structured bodies are parsed in RAM — cap them far
+                # below the raw-upload limit (a big Content-Length with
+                # a JSON Content-Type must not buffer gigabytes)
+                self._reject(
+                    413,
+                    f"body exceeds {MAX_JSON_BODY_MB:g} MiB limit "
+                    f"for {ctype or 'structured'} content",
+                )
+                return
+            body_file = None
+            if length and pre_body is not None:
+                # auth runs BEFORE consuming ANY body, or an
+                # unauthenticated client could burn disk/bandwidth/RAM
+                # up to the body limit per request
+                try:
+                    pre_body(Request(
+                        method=method, path=path, params=params,
+                        body=None, headers=headers,
+                        client_addr=self.client_address[0],
+                    ))
+                except HTTPError as e:
+                    self._reject(e.status, e.message)  # body unread
+                    return
+            if length and headers.get(
+                "expect", ""
+            ).lower().startswith("100-continue"):
+                # invite the body only AFTER the size caps and pre-body
+                # auth all passed — an early 100 Continue would ask a
+                # soon-to-be-rejected client to stream its whole upload
+                self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+                self.wfile.flush()
+            if length and octet:
+                # binary upload (blob daemon): spool off the socket in
+                # chunks — never hold a multi-GB artifact in memory
+                body_file = tempfile.SpooledTemporaryFile(
+                    max_size=_SPOOL_BYTES
+                )
+                remaining = length
+                while remaining:
+                    chunk = self.rfile.read(min(remaining, 1 << 20))
+                    if not chunk:
+                        break
+                    body_file.write(chunk)
+                    remaining -= len(chunk)
+                if remaining:
+                    # client died mid-upload: dispatching the truncated
+                    # body would store a short artifact with a 201
+                    body_file.close()
+                    self._reject(400, "incomplete body")
+                    return
+                body_file.seek(0)
+                raw = b""
+            else:
+                raw = self.rfile.read(length) if length else b""
+                if len(raw) < length:
+                    self._reject(400, "incomplete body")
+                    return
             body = None
-            ctype = (self.headers.get("Content-Type") or "").lower()
-            if raw and ctype.startswith("application/octet-stream"):
-                pass  # binary upload (blob daemon): no decode attempt
-            elif raw:
+            if raw:
                 # Try JSON regardless of Content-Type — real clients (curl
                 # -d without -H) post JSON bodies under the default form
                 # type. Non-JSON bodies stay raw strings; handlers that
@@ -194,11 +431,12 @@ def _make_handler_class(router: Router, server_name: str):
                     body = raw.decode("utf-8", errors="replace")
             req = Request(
                 method=method,
-                path=parsed.path,
+                path=path,
                 params=params,
                 body=body,
                 raw_body=raw,
-                headers={k.lower(): v for k, v in self.headers.items()},
+                body_file=body_file,
+                headers=headers,
                 client_addr=self.client_address[0],
             )
             try:
@@ -206,8 +444,11 @@ def _make_handler_class(router: Router, server_name: str):
             except HTTPError as e:
                 status, out = e.status, {"message": e.message}
             except Exception:
-                log.exception("unhandled error on %s %s", method, parsed.path)
+                log.exception("unhandled error on %s %s", method, path)
                 status, out = 500, {"message": "internal server error"}
+            finally:
+                if body_file is not None:
+                    body_file.close()
             self._respond(status, out)
             if req.after_response is not None:
                 try:
@@ -215,21 +456,6 @@ def _make_handler_class(router: Router, server_name: str):
                 except OSError:
                     pass
                 req.after_response()
-
-        def do_GET(self):
-            self._handle("GET")
-
-        def do_POST(self):
-            self._handle("POST")
-
-        def do_PUT(self):
-            self._handle("PUT")
-
-        def do_HEAD(self):
-            self._handle("HEAD")
-
-        def do_DELETE(self):
-            self._handle("DELETE")
 
     return JsonHandler
 
@@ -274,6 +500,16 @@ class _TLSThreadingHTTPServer(ThreadingHTTPServer):
     #: overflows it and the dropped SYNs retransmit after ~1 s, which
     #: shows up directly as a serving p95 spike under concurrent load
     request_queue_size = 128
+    #: SO_REUSEPORT before bind — lets N worker processes share one port
+    #: with kernel-level connection balancing (serving pool mode)
+    reuse_port = False
+
+    def server_bind(self):
+        if self.reuse_port:
+            self.socket.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+            )
+        super().server_bind()
 
     def finish_request(self, request, client_address):
         if self.ssl_ctx is None:
@@ -307,10 +543,20 @@ class JsonHTTPServer:
 
     def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0,
                  name: str = "pio-tpu",
-                 ssl_context: Any = SSL_FROM_ENV):
+                 ssl_context: Any = SSL_FROM_ENV,
+                 pre_body: Optional[Callable[[Request], None]] = None,
+                 reuse_port: bool = False):
         self._httpd = _TLSThreadingHTTPServer(
-            (host, port), _make_handler_class(router, name)
+            (host, port), _make_handler_class(router, name, pre_body),
+            bind_and_activate=False,
         )
+        self._httpd.reuse_port = reuse_port
+        try:
+            self._httpd.server_bind()
+            self._httpd.server_activate()
+        except BaseException:
+            self._httpd.server_close()
+            raise
         ctx = (
             ssl_context_from_env()
             if ssl_context is SSL_FROM_ENV
@@ -335,6 +581,9 @@ class JsonHTTPServer:
         self._httpd.serve_forever()
 
     def stop(self):
+        if getattr(self, "_stopped", False):
+            return  # idempotent: /undeploy and a pool supervisor may race
+        self._stopped = True
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:

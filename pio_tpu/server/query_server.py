@@ -31,7 +31,9 @@ from pio_tpu.controller.engine import Engine, EngineParams
 from pio_tpu.controller.params import ParamsError, params_from_dict
 from pio_tpu.data.event import Event
 from pio_tpu.parallel.context import ComputeContext
-from pio_tpu.server.http import HTTPError, JsonHTTPServer, Request, Router
+from pio_tpu.server.http import (
+    HTTPError, JsonHTTPServer, Request, Router, keys_equal,
+)
 from pio_tpu.storage import Storage
 from pio_tpu.workflow.core_workflow import load_models_for_instance
 from pio_tpu.workflow.deploy_common import (
@@ -89,7 +91,7 @@ class _LatencyStats:
 
 class _MicroBatcher:
     """Coalesces concurrent ``/queries.json`` requests into one
-    ``algo.batch_predict`` dispatch.
+    ``algo.batch_predict`` dispatch — WHEN that wins.
 
     The reference serves strictly per-request (one ``predictBase`` per
     HTTP call on the driver JVM). On an accelerator the per-dispatch
@@ -101,6 +103,18 @@ class _MicroBatcher:
     ONE ``[B, K] @ [K, N]`` device matmul + top-k instead of B separate
     dispatches — then serves each query individually.
 
+    **Adaptive bypass.** Whether coalescing wins depends on the deploy:
+    on a device-resident scorer with real per-dispatch RTT it does; on a
+    host-mirror scorer the extra condition-variable handoffs can cost
+    more than the batched matmul saves (measured losing in the round-3
+    driver bench). Predicting that from first principles is guesswork,
+    so the batcher measures it live: the first ``PROBE_QUERIES``
+    requests run coalesced, the next ``PROBE_QUERIES`` run per-request
+    in the caller's thread, and whichever regime had the lower median
+    request latency under the SAME live load becomes permanent
+    (Little's law: under fixed concurrency, lower mean latency ⇔ higher
+    throughput). ``PIO_TPU_SERVE_MICROBATCH_ADAPTIVE=0`` pins it on.
+
     Enabled via ``PIO_TPU_SERVE_MICROBATCH_US`` (collection window in
     microseconds; unset/0 = off, classic per-request path). If a batch
     dispatch fails, every member falls back to the per-query path so one
@@ -108,8 +122,15 @@ class _MicroBatcher:
     """
 
     MAX_BATCH = 512
+    #: probe sample size per regime before the permanent mode decision.
+    #: Only the chronologically LAST half of each window is compared —
+    #: the first batches of a fresh deploy pay one-off XLA bucket
+    #: compiles (seconds-scale) that would otherwise poison the batched
+    #: median and lock in "off" exactly where coalescing wins.
+    PROBE_QUERIES = 96
 
-    def __init__(self, service: "QueryServerService", window_s: float):
+    def __init__(self, service: "QueryServerService", window_s: float,
+                 adaptive: bool = True):
         self._service = service
         self._window_s = window_s
         self._cv = threading.Condition()
@@ -118,17 +139,32 @@ class _MicroBatcher:
         self.batches = 0
         self.batched_queries = 0
         self.max_batch = 0
+        #: probe_batch → probe_solo → on | off
+        self._mode = "probe_batch" if adaptive else "on"
+        #: set when the probe decides "off" — query() then skips the
+        #: batcher entirely (inline per-request path, no residual cost)
+        self.bypassed = False
+        self._probe_lock = threading.Lock()
+        self._probe: dict = {"batch": [], "solo": []}
         self._thread = threading.Thread(
             target=self._run, name="pio-tpu-microbatch", daemon=True
         )
         self._thread.start()
 
     def submit(self, query):
-        """Enqueue one query; blocks until its batch is served. If the
-        batch dispatch failed, the fallback per-query predict runs HERE —
-        in the request's own thread — so one poisoned query degrades its
-        batch-mates to ordinary concurrent serving, not to a serial queue
-        behind the single worker."""
+        """Serve one query through the current regime; blocks until done.
+        If the batch dispatch failed, the fallback per-query predict runs
+        HERE — in the request's own thread — so one poisoned query
+        degrades its batch-mates to ordinary concurrent serving, not to a
+        serial queue behind the single worker."""
+        mode = self._mode
+        if mode == "off" or mode == "probe_solo":
+            t0 = time.monotonic()
+            out = self._service._predict_one(query)
+            if mode == "probe_solo":
+                self._note_probe("solo", time.monotonic() - t0)
+            return out
+        t0 = time.monotonic()
         pend = [query, None, None, threading.Event()]  # q, result, exc, done
         with self._cv:
             if self._stopped:
@@ -136,11 +172,44 @@ class _MicroBatcher:
             self._queue.append(pend)
             self._cv.notify()
         pend[3].wait()
+        if mode == "probe_batch":
+            self._note_probe("batch", time.monotonic() - t0)
         if pend[2] is _BATCH_FAILED:
             return self._service._predict_one(pend[0])
         if pend[2] is not None:
             raise pend[2]
         return pend[1]
+
+    def _note_probe(self, kind: str, dt: float) -> None:
+        with self._probe_lock:
+            samples = self._probe[kind]
+            samples.append(dt)
+            if len(samples) < self.PROBE_QUERIES:
+                return
+            if kind == "batch" and self._mode == "probe_batch":
+                self._mode = "probe_solo"
+            elif kind == "solo" and self._mode == "probe_solo":
+                # steady-state comparison: drop each window's first half
+                # (bucket-compile and cache warmup transients land there)
+                med = lambda xs: sorted(xs[len(xs) // 2:])[len(xs) // 4]
+                batch_med = med(self._probe["batch"])
+                solo_med = med(self._probe["solo"])
+                self._mode = "on" if batch_med <= solo_med else "off"
+                log.info(
+                    "micro-batch probe: batched p50 %.3f ms vs per-query "
+                    "p50 %.3f ms under live load -> %s",
+                    batch_med * 1e3, solo_med * 1e3, self._mode,
+                )
+                if self._mode == "off":
+                    # true bypass: the query path re-checks this flag and
+                    # goes back to inline per-request serving, byte-for-
+                    # byte the no-batcher code path (zero residual cost)
+                    self.bypassed = True
+
+    @property
+    def mode(self) -> str:
+        """Current regime (lock-free read — for cheap polling)."""
+        return self._mode
 
     def stop(self):
         with self._cv:
@@ -148,7 +217,17 @@ class _MicroBatcher:
             self._cv.notify()
 
     def to_dict(self) -> dict:
+        with self._probe_lock:
+            med = lambda xs: (
+                round(sorted(xs)[len(xs) // 2] * 1e3, 3) if xs else None
+            )
+            probe = {
+                "batchedP50Ms": med(self._probe["batch"]),
+                "perQueryP50Ms": med(self._probe["solo"]),
+            }
         return {
+            "mode": self._mode,
+            "probe": probe,
             "batches": self.batches,
             "batchedQueries": self.batched_queries,
             "maxBatch": self.max_batch,
@@ -217,14 +296,25 @@ class QueryServerService:
         self.stats = _LatencyStats()
         self._swap_lock = threading.Lock()
         self._deployed = True
+        #: pool mode (see server/worker_pool.py): shared reload generation
+        #: + shutdown event wired in by enable_pool()
+        self._pool_idx = None
+        self._pool_size = None
+        self._pool_gen = None
+        self._pool_shutdown = None
+        self._seen_gen = 0
         #: set via attach_server(); when present, /undeploy also stops the
         #: HTTP server shortly after responding (reference parity: `pio
         #: undeploy` terminates the server process, not just the flag)
         self._server = None
         self._load(instance_id)
         window_us = float(os.environ.get("PIO_TPU_SERVE_MICROBATCH_US", "0"))
+        adaptive = os.environ.get(
+            "PIO_TPU_SERVE_MICROBATCH_ADAPTIVE", "1"
+        ) != "0"
         self._batcher = (
-            _MicroBatcher(self, window_us / 1e6) if window_us > 0 else None
+            _MicroBatcher(self, window_us / 1e6, adaptive=adaptive)
+            if window_us > 0 else None
         )
 
         self.router = Router()
@@ -258,6 +348,7 @@ class QueryServerService:
 
     # -- handlers -----------------------------------------------------------
     def status(self, req: Request):
+        self._pool_sync()
         return 200, {
             "status": "deployed" if self._deployed else "undeployed",
             "engineId": self.variant.engine_id,
@@ -284,9 +375,32 @@ class QueryServerService:
 
         return 200, installed_plugins()
 
+    def enable_pool(self, idx: int, size: int, gen, shutdown_evt) -> None:
+        """Wire this worker into a serving pool: ``gen`` is a shared
+        multiprocessing generation counter (a /reload on ANY worker bumps
+        it; the others lazily reload before their next query), and
+        ``shutdown_evt`` a shared event that /undeploy sets so the
+        supervisor brings the whole pool down."""
+        self._pool_idx = idx
+        self._pool_size = size
+        self._pool_gen = gen
+        self._pool_shutdown = shutdown_evt
+        self._seen_gen = gen.value
+
+    def _pool_sync(self) -> None:
+        gen = self._pool_gen
+        if gen is not None and gen.value != self._seen_gen:
+            target = gen.value
+            # mark the generation consumed only AFTER a successful load —
+            # a transient reload failure must be retried on the next
+            # query, not leave this worker on the stale model forever
+            self._load(None)
+            self._seen_gen = target
+
     def query(self, req: Request):
         if not self._deployed:
             raise HTTPError(503, "undeployed")
+        self._pool_sync()
         t0 = time.monotonic()
         error = True
         try:
@@ -298,7 +412,7 @@ class QueryServerService:
                 pairs, serving, qc = self.pairs, self.serving, self.query_class
             query = self._parse_query(req.body, qc)
             query = serving.supplement(query)
-            if self._batcher is not None:
+            if self._batcher is not None and not self._batcher.bypassed:
                 result = self._batcher.submit(query)
             else:
                 predictions = [algo.predict(m, query) for algo, m in pairs]
@@ -371,6 +485,11 @@ class QueryServerService:
         out = self.stats.to_dict()
         if self._batcher is not None:
             out["microbatch"] = self._batcher.to_dict()
+        if self._pool_idx is not None:
+            # pool mode: these are ONE worker's numbers (the kernel
+            # balanced this connection here); aggregate client-side
+            out["worker"] = self._pool_idx
+            out["poolSize"] = self._pool_size
         return 200, out
 
     def get_metrics(self, req: Request):
@@ -418,7 +537,7 @@ class QueryServerService:
 
     def _check_admin(self, req: Request):
         if self.admin_key is not None:
-            if req.bearer_key() != self.admin_key:
+            if not keys_equal(req.bearer_key(), self.admin_key):
                 raise HTTPError(401, "invalid admin accessKey")
         elif req.client_addr not in ("127.0.0.1", "::1"):
             raise HTTPError(
@@ -426,9 +545,17 @@ class QueryServerService:
             )
 
     def reload(self, req: Request):
-        """Hot-swap to the newest COMPLETED instance (reference /reload)."""
+        """Hot-swap to the newest COMPLETED instance (reference /reload).
+
+        In pool mode the shared generation counter is bumped, so every
+        sibling worker reloads before serving its next query — one admin
+        POST rolls the whole pool."""
         self._check_admin(req)
         self._load(None)
+        if self._pool_gen is not None:
+            with self._pool_gen.get_lock():
+                self._pool_gen.value += 1
+                self._seen_gen = self._pool_gen.value
         return 200, {"engineInstanceId": self.instance_id}
 
     def undeploy(self, req: Request):
@@ -436,15 +563,21 @@ class QueryServerService:
         self._deployed = False
         if self._batcher is not None:
             self._batcher.stop()
-        if self._server is not None:
-            # after_response fires once the reply is flushed to the
-            # socket, so shutdown can never race the client's read (a
-            # fixed timer would); stop() runs in its own thread because
-            # it blocks until the accept loop exits
-            server = self._server
-            req.after_response = lambda: threading.Thread(
-                target=server.stop, daemon=True
-            ).start()
+        server, shutdown_evt = self._server, self._pool_shutdown
+
+        def _after():
+            # fires once the reply is flushed to the socket, so shutdown
+            # can never race the client's read (a fixed timer would);
+            # stop() runs in its own thread because it blocks until the
+            # accept loop exits. In pool mode the shared event tells the
+            # supervisor to bring down every sibling worker too.
+            if shutdown_evt is not None:
+                shutdown_evt.set()
+            if server is not None:
+                threading.Thread(target=server.stop, daemon=True).start()
+
+        if server is not None or shutdown_evt is not None:
+            req.after_response = _after
         return 200, {"message": "undeployed"}
 
     def attach_server(self, server) -> None:
@@ -462,6 +595,7 @@ def create_query_server(
     feedback: bool = False,
     feedback_app_id: Optional[int] = None,
     admin_key: Optional[str] = None,
+    reuse_port: bool = False,
 ) -> Tuple[JsonHTTPServer, QueryServerService]:
     from pio_tpu.server.plugins import load_plugins_from_env
 
@@ -470,6 +604,7 @@ def create_query_server(
         variant, instance_id, ctx, feedback, feedback_app_id, admin_key
     )
     server = JsonHTTPServer(
-        service.router, host, port, name="pio-tpu-queryserver"
+        service.router, host, port, name="pio-tpu-queryserver",
+        reuse_port=reuse_port,
     )
     return server, service

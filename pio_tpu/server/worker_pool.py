@@ -1,0 +1,231 @@
+"""SO_REUSEPORT serving pool — N query-server processes on one port.
+
+The reference serves queries from one JVM whose thread pool scales across
+cores (``core/.../workflow/CreateServer.scala`` — UNVERIFIED path;
+SURVEY.md §2.6 serving-concurrency row). CPython's GIL serializes nearly
+all per-request work in one process, so the TPU rebuild's equivalent is a
+POOL of worker processes that each bind the same TCP port with
+``SO_REUSEPORT``; the kernel load-balances incoming connections across the
+listeners, multiplying host-path QPS by the worker count on multi-core
+serving hosts.
+
+Accelerator ownership: libtpu admits ONE process per chip. Every pool
+worker therefore scores on the **host mirror** of the factor tables (the
+deserialized model state — the same adaptive scorer fallback path that
+``ops/topn.py`` uses for small batches), with an opt-in for worker 0 to
+own the device scorer (``device_worker=True``) when the pool runs on the
+TPU VM itself. Non-owner workers pin JAX to CPU before anything imports
+it, so they can never grab the chip.
+
+Pool semantics (shared ``multiprocessing`` primitives, spawn context):
+
+- **/reload** on any worker bumps a shared generation counter after
+  reloading itself; every sibling lazily reloads before serving its next
+  query — one admin POST rolls the whole pool.
+- **/undeploy** on any worker sets a shared shutdown event; the
+  supervisor terminates every worker — matching single-process behavior
+  where ``pio undeploy`` stops the server.
+- **/stats.json** reports per-worker numbers plus ``worker``/``poolSize``
+  fields (the kernel decides which worker answers a given connection);
+  aggregate across workers client-side or via Prometheus scrapes.
+
+Start one with ``pio deploy --workers N`` or programmatically::
+
+    pool = ServingPool(variant, port=8000, n_workers=4)
+    pool.start()
+    pool.wait()          # supervise until /undeploy or pool.stop()
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing as mp
+import os
+import socket
+import time
+from typing import Optional
+
+from pio_tpu.workflow.engine_json import EngineVariant
+
+log = logging.getLogger("pio_tpu.workerpool")
+
+#: respawn budget per worker index — a worker that keeps dying signals a
+#: real fault (bad model, port clash), not a transient, so stop burning
+#: processes on it
+_MAX_RESPAWNS = 3
+
+
+def _worker_main(spec: dict, idx: int, gen, shutdown_evt) -> None:
+    """Entry point of one pool worker (spawned process)."""
+    if not (spec["device_worker"] and idx == 0):
+        # host-mirror scoring only; pin JAX to CPU before ANY import can
+        # initialize the TPU runtime (single-owner constraint)
+        os.environ["PIO_TPU_SERVE_DEVICE"] = "host"
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # jax missing/unconfigurable → host numpy only
+            pass
+
+    from pio_tpu.server.query_server import create_query_server
+
+    variant = EngineVariant(**spec["variant"])
+    # a respawn AFTER a pool-wide /reload must join its siblings on the
+    # newest COMPLETED instance, not resurrect the originally pinned one
+    instance_id = spec.get("instance_id") if gen.value == 0 else None
+    server, service = create_query_server(
+        variant,
+        host=spec["host"],
+        port=spec["port"],
+        instance_id=instance_id,
+        feedback=spec.get("feedback", False),
+        feedback_app_id=spec.get("feedback_app_id"),
+        admin_key=spec.get("admin_key"),
+        reuse_port=True,
+    )
+    service.enable_pool(idx, spec["n_workers"], gen, shutdown_evt)
+    service.attach_server(server)
+    server.start()
+    log.info("pool worker %d serving on :%d", idx, server.port)
+    try:
+        shutdown_evt.wait()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+
+
+class ServingPool:
+    """Supervisor for a fixed-size SO_REUSEPORT query-server pool."""
+
+    def __init__(
+        self,
+        variant: EngineVariant,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        n_workers: int = 2,
+        instance_id: Optional[str] = None,
+        feedback: bool = False,
+        feedback_app_id: Optional[int] = None,
+        admin_key: Optional[str] = None,
+        device_worker: bool = False,
+    ):
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self._ctx = mp.get_context("spawn")
+        self._gen = self._ctx.Value("L", 0)
+        self._shutdown = self._ctx.Event()
+        self._host = host
+        # port 0 → reserve an ephemeral port ALL workers can share: bind a
+        # SO_REUSEPORT socket here and keep it open (bound but never
+        # listening, so the kernel excludes it from connection balancing)
+        self._anchor: Optional[socket.socket] = None
+        if port == 0:
+            self._anchor = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._anchor.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+            )
+            self._anchor.bind((host, 0))
+            port = self._anchor.getsockname()[1]
+        self.port = port
+        self._spec = {
+            "variant": {
+                "engine_id": variant.engine_id,
+                "engine_version": variant.engine_version,
+                "engine_factory": variant.engine_factory,
+                "variant": variant.variant,
+                "path": variant.path,
+            },
+            "host": host,
+            "port": port,
+            "n_workers": n_workers,
+            "instance_id": instance_id,
+            "feedback": feedback,
+            "feedback_app_id": feedback_app_id,
+            "admin_key": admin_key,
+            "device_worker": device_worker,
+        }
+        self.n_workers = n_workers
+        self._procs: list = []
+        self._respawns = [0] * n_workers
+
+    def _spawn(self, idx: int):
+        p = self._ctx.Process(
+            target=_worker_main,
+            args=(self._spec, idx, self._gen, self._shutdown),
+            name=f"pio-tpu-serve-{idx}",
+            daemon=True,
+        )
+        p.start()
+        return p
+
+    def start(self) -> "ServingPool":
+        self._procs = [self._spawn(i) for i in range(self.n_workers)]
+        return self
+
+    def wait_ready(self, timeout: float = 60.0) -> None:
+        """Block until a worker answers on the port (deploy readiness)."""
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            if self._shutdown.is_set():
+                raise RuntimeError("pool shut down during startup")
+            probe_host = (
+                "127.0.0.1" if self._host in ("", "0.0.0.0", "::")
+                else self._host
+            )
+            try:
+                with socket.create_connection(
+                    (probe_host, self.port), timeout=2.0
+                ):
+                    return
+            except OSError as e:
+                last_err = e
+                if all(not p.is_alive() for p in self._procs):
+                    raise RuntimeError(
+                        "every pool worker exited during startup"
+                    ) from e
+                time.sleep(0.1)
+        raise TimeoutError(
+            f"no pool worker answering on :{self.port}: {last_err}"
+        )
+
+    def wait(self, poll_s: float = 0.5) -> None:
+        """Supervise until /undeploy (or stop()): respawn crashed workers
+        within budget, then reap everything once the event fires."""
+        while not self._shutdown.is_set():
+            for i, p in enumerate(self._procs):
+                if p.is_alive() or self._shutdown.is_set():
+                    continue
+                if self._respawns[i] >= _MAX_RESPAWNS:
+                    log.error(
+                        "worker %d died %d times; not respawning",
+                        i, self._respawns[i],
+                    )
+                    continue
+                self._respawns[i] += 1
+                log.warning(
+                    "worker %d exited (code %s); respawning (%d/%d)",
+                    i, p.exitcode, self._respawns[i], _MAX_RESPAWNS,
+                )
+                self._procs[i] = self._spawn(i)
+            if all(
+                not p.is_alive() for p in self._procs
+            ) and all(r >= _MAX_RESPAWNS for r in self._respawns):
+                log.error("all workers dead and out of respawn budget")
+                break
+            self._shutdown.wait(poll_s)
+        self.stop()
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._shutdown.set()
+        for p in self._procs:
+            p.join(timeout=join_timeout)
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=2.0)
+        if self._anchor is not None:
+            self._anchor.close()
+            self._anchor = None

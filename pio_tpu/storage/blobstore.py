@@ -32,12 +32,19 @@ from __future__ import annotations
 
 import abc
 import hashlib
+import io
 import os
+import tempfile
 from typing import Callable, Dict, List, Optional
 from urllib.parse import quote, urlparse
 
 from pio_tpu.storage import base
 from pio_tpu.storage.records import Model
+
+#: reserved suffix for in-flight atomic-write staging files; list() hides
+#: exactly this suffix, so ordinary keys (even ones ending ".tmp") are
+#: never masked. Don't name blobs with it.
+_STAGING_SUFFIX = ".pio-staging"
 
 
 class BlobBackend(abc.ABC):
@@ -75,12 +82,35 @@ class FileBlobBackend(BlobBackend):
         return p
 
     def put(self, key: str, data: bytes) -> None:
+        self.put_file(key, io.BytesIO(data))
+
+    def put_file(self, key: str, src, chunk_size: int = 1 << 20) -> int:
+        """Stream an open binary file into the store in constant memory
+        (the blob daemon's PUT path). Returns the byte count stored.
+
+        The temp file is uniquely named per call (mkstemp) — the daemon
+        is threaded, and two concurrent PUTs to one key must each write
+        their own staging file; last os.replace wins atomically."""
         p = self._path(key)
         os.makedirs(os.path.dirname(p), exist_ok=True)
-        tmp = f"{p}.{os.getpid()}.tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, p)
+        fd, tmp = tempfile.mkstemp(
+            prefix=os.path.basename(p) + ".", suffix=_STAGING_SUFFIX,
+            dir=os.path.dirname(p),
+        )
+        n = 0
+        try:
+            with os.fdopen(fd, "wb") as f:
+                while chunk := src.read(chunk_size):
+                    f.write(chunk)
+                    n += len(chunk)
+            os.replace(tmp, p)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return n
 
     def get(self, key: str) -> Optional[bytes]:
         p = self._path(key)
@@ -110,6 +140,8 @@ class FileBlobBackend(BlobBackend):
         out = []
         for dirpath, _dirs, files in os.walk(base_dir):
             for f in files:
+                if f.endswith(_STAGING_SUFFIX):
+                    continue  # in-flight put_file staging, not a blob
                 full = os.path.join(dirpath, f)
                 out.append(os.path.relpath(full, self.root).replace(
                     os.sep, "/"

@@ -234,9 +234,9 @@ class LogRegParams(Params):
     learning_rate: float = 0.1
     reg: float = 0.0
     seed: int = 0
-    #: feature wire/matmul dtype — "bfloat16" (default, MXU-native,
-    #: half the host→device bytes) or "float32" for exact arithmetic
-    input_dtype: str = "bfloat16"
+    #: feature wire/matmul dtype — "float32" (default, exact arithmetic)
+    #: or opt-in "bfloat16" (MXU-native, half the host→device bytes)
+    input_dtype: str = "float32"
 
 
 @dataclasses.dataclass

@@ -331,6 +331,32 @@ def cmd_deploy(args) -> int:
     feedback_app_id = None
     if args.feedback_app:
         feedback_app_id = _resolve_app(args.feedback_app).id
+    if getattr(args, "workers", 1) > 1:
+        from pio_tpu.server.worker_pool import ServingPool
+
+        pool = ServingPool(
+            variant,
+            host=args.ip,
+            port=args.port,
+            n_workers=args.workers,
+            instance_id=args.engine_instance_id,
+            feedback=bool(args.feedback_app),
+            feedback_app_id=feedback_app_id,
+            admin_key=args.admin_key,
+            device_worker=args.device_worker,
+        )
+        pool.start()
+        pool.wait_ready()
+        _out(
+            f"Query Server pool ({args.workers} workers) listening on "
+            f"{args.ip}:{pool.port}"
+        )
+        try:
+            pool.wait()
+        except KeyboardInterrupt:
+            _out("shutting down pool")
+            pool.stop()
+        return 0
     server, service = create_query_server(
         variant,
         host=args.ip,
@@ -678,6 +704,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--admin-key", default=None,
         help="access key required by /reload and /undeploy; "
              "without one those routes are loopback-only",
+    )
+    a.add_argument(
+        "--workers", type=int, default=1,
+        help="serving processes sharing the port via SO_REUSEPORT "
+             "(>1 multiplies host-path QPS on multi-core hosts; "
+             "workers score on the host model mirror)",
+    )
+    a.add_argument(
+        "--device-worker", action="store_true",
+        help="with --workers>1: let worker 0 own the accelerator scorer "
+             "(libtpu single-owner); others stay on the host mirror",
     )
     a.set_defaults(fn=cmd_deploy)
 

@@ -232,6 +232,101 @@ class TestHTTPBlobScheme:
         finally:
             conn.close()
 
+    def test_large_put_spools_exact_bytes(self, blob_daemon):
+        """A PUT bigger than the in-memory spool threshold streams
+        through a temp file (never fully buffered) and must land
+        byte-identical."""
+        import hashlib
+
+        payload = bytes(range(256)) * 65536  # 16 MiB > 8 MiB spool cutoff
+        b = open_blob_backend(blob_daemon)
+        b.put("objects/hugeput", payload)
+        got = b.get("objects/hugeput")
+        assert len(got) == len(payload)
+        assert hashlib.sha256(got).hexdigest() == \
+            hashlib.sha256(payload).hexdigest()
+
+    def test_oversize_body_rejected_413(self, blob_daemon, monkeypatch):
+        import http.client
+        from urllib.parse import urlsplit
+
+        import pio_tpu.server.http as http_mod
+
+        monkeypatch.setattr(http_mod, "MAX_BODY_MB", 1.0)
+        host, port = urlsplit(blob_daemon).netloc.split(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        try:
+            # the server rejects on Content-Length alone and closes
+            # without draining; a reset mid-upload is also a rejection
+            conn.request(
+                "PUT", "/blobs/objects/toolarge", body=b"x" * (2 << 20),
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            r = conn.getresponse()
+            assert r.status == 413
+            r.read()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            conn.close()
+        # either way, nothing may have been stored
+        b = open_blob_backend(blob_daemon)
+        assert not b.exists("objects/toolarge")
+
+    def test_truncated_put_rejected(self, blob_daemon):
+        """A client dying mid-PUT (Content-Length > bytes sent) must not
+        store a truncated artifact over a complete one."""
+        import socket
+        from urllib.parse import urlsplit
+
+        b = open_blob_backend(blob_daemon)
+        b.put("objects/tr", b"complete-artifact")
+        host, port = urlsplit(blob_daemon).netloc.split(":")
+        s = socket.create_connection((host, int(port)), timeout=10)
+        try:
+            s.sendall(
+                b"PUT /blobs/objects/tr HTTP/1.1\r\n"
+                b"Host: x\r\nContent-Type: application/octet-stream\r\n"
+                b"Content-Length: 1000\r\n\r\n" + b"short"
+            )
+            s.shutdown(socket.SHUT_WR)  # die mid-body
+            resp = s.recv(4096)
+            assert b"400" in resp.split(b"\r\n", 1)[0], resp
+        finally:
+            s.close()
+        assert b.get("objects/tr") == b"complete-artifact"
+
+    def test_unauthenticated_put_rejected_before_body(self, tmp_path):
+        """With an access key set, a bad-key octet-stream PUT is refused
+        pre-body (the connection closes without the body being read)."""
+        import socket
+
+        from pio_tpu.server.blob_server import create_blob_server
+
+        server = create_blob_server(
+            str(tmp_path / "s"), host="127.0.0.1", port=0,
+            access_key="sekrit",
+        )
+        server.start()
+        try:
+            s = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            try:
+                # headers announce a large body; send none of it
+                s.sendall(
+                    b"PUT /blobs/objects/x HTTP/1.1\r\n"
+                    b"Host: x\r\n"
+                    b"Content-Type: application/octet-stream\r\n"
+                    b"Content-Length: 104857600\r\n\r\n"
+                )
+                resp = s.recv(4096)  # 401 arrives despite no body sent
+                assert b"401" in resp.split(b"\r\n", 1)[0], resp
+            finally:
+                s.close()
+        finally:
+            server.stop()
+
     def test_daemon_rejects_escaping_keys(self, blob_daemon):
         import urllib.error
         import urllib.request

@@ -451,6 +451,70 @@ class TestQueryServer:
         finally:
             server.stop()
 
+    def test_microbatch_adaptive_probe_decides(self, app_and_key,
+                                               monkeypatch):
+        """The adaptive batcher A/B-probes both regimes under live load
+        and settles on a permanent mode; in the losing regime's place it
+        stops paying that regime's cost (bypass or stay coalesced)."""
+        import concurrent.futures
+
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_US", "500")
+        app_id, _ = app_and_key
+        variant, ctx, iid = _train(app_id)
+        server, service = create_query_server(
+            variant, host="127.0.0.1", port=0, ctx=ctx
+        )
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+
+            def one(t):
+                return http(
+                    "POST", f"{url}/queries.json",
+                    {"user": f"u{t % 8}", "num": 2},
+                )[0]
+
+            # 2× probe window + slack → the decision must have been made
+            n = 2 * service._batcher.PROBE_QUERIES + 40
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                statuses = list(ex.map(one, range(n)))
+            assert all(s == 200 for s in statuses)
+            mb = service._batcher.to_dict()
+            assert mb["mode"] in ("on", "off"), mb
+            assert mb["probe"]["batchedP50Ms"] is not None
+            assert mb["probe"]["perQueryP50Ms"] is not None
+            if mb["mode"] == "off":
+                # bypass: further queries never touch the batch queue
+                before = service._batcher.to_dict()["batchedQueries"]
+                for t in range(10):
+                    assert one(t) == 200
+                assert service._batcher.to_dict()["batchedQueries"] == before
+
+        finally:
+            server.stop()
+
+    def test_microbatch_adaptive_opt_out(self, app_and_key, monkeypatch):
+        """PIO_TPU_SERVE_MICROBATCH_ADAPTIVE=0 pins coalescing on."""
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_US", "500")
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_ADAPTIVE", "0")
+        app_id, _ = app_and_key
+        variant, ctx, iid = _train(app_id)
+        server, service = create_query_server(
+            variant, host="127.0.0.1", port=0, ctx=ctx
+        )
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            for t in range(6):
+                assert http(
+                    "POST", f"{url}/queries.json", {"user": "u1", "num": 2}
+                )[0] == 200
+            mb = service._batcher.to_dict()
+            assert mb["mode"] == "on"
+            assert mb["batchedQueries"] >= 6
+        finally:
+            server.stop()
+
     def test_query_server_prometheus_metrics(self, queryserver):
         import urllib.request
 
@@ -500,3 +564,126 @@ class TestQueryServer:
         variant = variant_from_dict({**VARIANT, "id": "never-trained"})
         with pytest.raises(RuntimeError, match="no COMPLETED engine instance"):
             create_query_server(variant, host="127.0.0.1", port=0)
+
+
+class TestHTTPHardening:
+    """Hand-rolled HTTP/1.1 parser edge cases (pio_tpu/server/http.py):
+    framing attacks and resource-exhaustion vectors must be rejected
+    before any body is consumed or buffered."""
+
+    @pytest.fixture()
+    def echo(self):
+        from pio_tpu.server.http import JsonHTTPServer, Router
+
+        r = Router()
+        r.add("POST", "/echo", lambda req: (200, {"got": req.body}))
+        srv = JsonHTTPServer(r, "127.0.0.1", 0, name="echo")
+        srv.start()
+        yield srv.port
+        srv.stop()
+
+    @staticmethod
+    def _raw(port, payload: bytes) -> bytes:
+        import socket
+
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            s.sendall(payload)
+            return s.recv(65536)
+        finally:
+            s.close()
+
+    def test_negative_content_length_rejected(self, echo):
+        resp = self._raw(
+            echo,
+            b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: -1\r\n\r\n",
+        )
+        assert b"400" in resp.split(b"\r\n", 1)[0], resp
+
+    def test_differing_duplicate_content_length_rejected(self, echo):
+        resp = self._raw(
+            echo,
+            b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 5\r\nContent-Length: 50\r\n\r\nhello",
+        )
+        assert b"400" in resp.split(b"\r\n", 1)[0], resp
+
+    def test_equal_duplicate_content_length_collapses(self, echo):
+        resp = self._raw(
+            echo,
+            b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 2\r\nContent-Length: 2\r\n\r\n{}",
+        )
+        assert b"200" in resp.split(b"\r\n", 1)[0], resp
+
+    def test_structured_body_ram_cap(self, echo, monkeypatch):
+        import pio_tpu.server.http as http_mod
+
+        monkeypatch.setattr(http_mod, "MAX_JSON_BODY_MB", 0.001)  # 1 KiB
+        resp = self._raw(
+            echo,
+            b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: 10000\r\n\r\n" + b"x" * 100,
+        )
+        assert b"413" in resp.split(b"\r\n", 1)[0], resp
+
+    def test_chunked_transfer_rejected(self, echo):
+        resp = self._raw(
+            echo,
+            b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            b"2\r\nhi\r\n0\r\n\r\n",
+        )
+        assert b"411" in resp.split(b"\r\n", 1)[0], resp
+
+    def test_http10_and_keepalive_header(self, echo):
+        import socket
+
+        s = socket.create_connection(("127.0.0.1", echo), timeout=10)
+        try:
+            # HTTP/1.0 without keep-alive: served, then connection closes
+            s.sendall(
+                b"POST /echo HTTP/1.0\r\nHost: x\r\n"
+                b"Content-Length: 2\r\n\r\n{}"
+            )
+            buf = b""
+            while True:
+                got = s.recv(65536)
+                if not got:
+                    break
+                buf += got
+            assert b"200" in buf.split(b"\r\n", 1)[0]
+            assert b"Connection: close" in buf
+        finally:
+            s.close()
+
+    def test_unauth_json_put_rejected_before_body(self, tmp_path):
+        """The pre-body auth guard applies to ALL content types — a big
+        JSON-typed body must not be buffered in RAM before the 401."""
+        import socket
+
+        from pio_tpu.server.blob_server import create_blob_server
+
+        server = create_blob_server(
+            str(tmp_path / "s"), host="127.0.0.1", port=0,
+            access_key="sekrit",
+        )
+        server.start()
+        try:
+            s = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            try:
+                s.sendall(
+                    b"PUT /blobs/objects/x HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: 10000000\r\n\r\n"
+                )
+                resp = s.recv(4096)  # 401 without the body ever sent
+                assert b"401" in resp.split(b"\r\n", 1)[0], resp
+            finally:
+                s.close()
+        finally:
+            server.stop()

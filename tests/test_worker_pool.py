@@ -1,0 +1,155 @@
+"""SO_REUSEPORT serving pool (pio_tpu/server/worker_pool.py).
+
+Correctness tier for the multi-process query-serving mode: connections
+balance across workers, answers match the single-process server, /reload
+rolls every worker via the shared generation counter, and /undeploy
+brings the whole pool down. Perf (the pool's reason to exist) needs a
+multi-core host — this environment pins to ONE core, so QPS claims live
+in bench.py/BASELINE.md, not here.
+"""
+
+import datetime as dt
+import http.client
+import json
+import time
+
+import pytest
+
+import pio_tpu.templates  # noqa: F401  (registers the engine factory)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.storage import App, Storage
+from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+pytestmark = pytest.mark.slow  # spawns real worker processes
+
+VARIANT = {
+    "id": "pool-e2e",
+    "engineFactory": "templates.recommendation",
+    "datasource": {"params": {"app_name": "pool-test"}},
+    "algorithms": [
+        {
+            "name": "als",
+            "params": {
+                "rank": 4, "num_iterations": 5, "lambda_": 0.05, "seed": 1,
+            },
+        }
+    ],
+}
+
+
+def _seed_and_train(n_users=10, n_items=6):
+    app_id = Storage.get_meta_data_apps().insert(App(0, "pool-test"))
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    for u in range(n_users):
+        for i in range(n_items):
+            in_block = (u < 5) == (i < 3)
+            le.insert(
+                Event(
+                    "rate", "user", f"u{u}", "item", f"i{i}",
+                    properties={"rating": 5.0 if in_block else 1.0},
+                    event_time=t0 + dt.timedelta(minutes=u * 60 + i),
+                ),
+                app_id,
+            )
+    variant = variant_from_dict(VARIANT)
+    engine, ep = build_engine(variant)
+    run_train(engine, ep, variant, ctx=ComputeContext.create(seed=0))
+    return variant
+
+
+def _post(port, path, body, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", path, body=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        conn.close()
+
+
+@pytest.fixture()
+def pool(tmp_home):
+    from pio_tpu.server.worker_pool import ServingPool
+
+    Storage.reset()
+    variant = _seed_and_train()
+    pool = ServingPool(variant, host="127.0.0.1", port=0, n_workers=2)
+    pool.start()
+    pool.wait_ready(timeout=120)
+    yield pool
+    pool.stop()
+    Storage.reset()
+
+
+class TestServingPool:
+    def test_concurrent_correctness_and_balancing(self, pool):
+        # single-process reference answer (same storage, same instance)
+        status, ref = _post(pool.port, "/queries.json",
+                            {"user": "u1", "num": 3})
+        assert status == 200 and len(ref["itemScores"]) == 3
+        # u1 is in the first block → top items must come from i0..i2
+        top_ref = {s["item"] for s in ref["itemScores"]}
+        assert top_ref <= {"i0", "i1", "i2"}
+
+        # every worker (fresh connections rotate across listeners) must
+        # return the identical ranking — they loaded the same instance
+        workers_seen = set()
+        for _ in range(30):
+            status, got = _post(pool.port, "/queries.json",
+                                {"user": "u1", "num": 3})
+            assert status == 200
+            assert [s["item"] for s in got["itemScores"]] == \
+                [s["item"] for s in ref["itemScores"]]
+            _, stats = _get(pool.port, "/stats.json")
+            assert stats["poolSize"] == 2
+            workers_seen.add(stats["worker"])
+        # kernel balancing is stochastic but 60+ fresh connections
+        # virtually never all land on one listener
+        assert len(workers_seen) == 2, workers_seen
+
+    def test_reload_rolls_every_worker(self, pool):
+        # retrain → new COMPLETED instance; one /reload must roll ALL
+        # workers (generation counter), not just the one that got the POST
+        variant = variant_from_dict(VARIANT)
+        engine, ep = build_engine(variant)
+        new_id = run_train(
+            engine, ep, variant, ctx=ComputeContext.create(seed=0)
+        )
+        status, out = _post(pool.port, "/reload", {})
+        assert status == 200 and out["engineInstanceId"] == new_id
+        # every worker must now serve the new instance (lazy reload on
+        # next query) — hit both via fresh connections
+        seen = set()
+        for _ in range(30):
+            status, got = _post(pool.port, "/queries.json",
+                                {"user": "u2", "num": 2})
+            assert status == 200
+            _, st = _get(pool.port, "/")
+            seen.add(st["engineInstanceId"])
+        assert seen == {new_id}, seen
+
+    def test_undeploy_stops_whole_pool(self, pool):
+        status, out = _post(pool.port, "/undeploy", {})
+        assert status == 200
+        # the shared event reaches the supervisor and every worker
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(not p.is_alive() for p in pool._procs):
+                break
+            time.sleep(0.2)
+        assert all(not p.is_alive() for p in pool._procs)
