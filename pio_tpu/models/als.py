@@ -394,7 +394,8 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
                    matmul_dtype: str = "bfloat16", solver: str = "cg",
                    packed_shapes=None, rank: int = 0,
                    U_pad: int = 0, I_pad: int = 0,
-                   rating_wire: str = "f32", item_wire: str = "planes"):
+                   rating_wire: str = "f32", item_wire: str = "planes",
+                   mesh_wire_lens=None):
     """Jitted ALS trainer for one (mesh, static-config) combination.
 
     The returned function takes the two packed-block layouts + initial
@@ -486,6 +487,19 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
         #   deltas over the item-sorted adjacency + sparse overflow
         #   ratings: u4 nibble-packed half-star codes (2 edges/byte) when
         #   every code ≤ 15, u8 codes, else fp16/f32 raw
+        if mesh is not None and mesh_wire_lens is not None:
+            # mesh compact wire: edge arrays arrived SHARDED over the
+            # mesh axis (host link crossed once); re-replicate over ICI
+            # here, then drop the shard-divisibility padding — the
+            # decode's cumsum needs the whole stream on every device
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            E_lo, E_hi, E_r = mesh_wire_lens
+            i_lo = jax.lax.with_sharding_constraint(i_lo, repl)[:E_lo]
+            if i_hi.shape[0]:
+                i_hi = jax.lax.with_sharding_constraint(i_hi, repl)[:E_hi]
+            r = jax.lax.with_sharding_constraint(r, repl)[:E_r]
         E = i_lo.shape[0]
         i32 = math.decode_items(i_lo, i_hi, ovf_idx, ovf_val, counts_u)
         r32 = math.decode_ratings(r, E)
@@ -927,6 +941,146 @@ def _encode_ratings(r_sorted: np.ndarray) -> Tuple[np.ndarray, str]:
     return r_sorted, "f32"
 
 
+def _sort_edges_by_user(user_idx, item_idx, rating, n_edges, U_pad,
+                        counts_u):
+    """(user, item)-sorted item/rating columns: native two-pass sort
+    (counting sort by user + per-adjacency stable item sort) with a numpy
+    lexsort fallback. Item-sorted adjacencies are what make the delta
+    item wire dense AND improve factor-gather locality on device; ALS
+    itself is order-invariant within a user."""
+    native = _native_packer()
+    if native is not None:
+        i_sorted = np.empty(n_edges, np.int32)
+        r_sorted = np.empty(n_edges, np.float32)
+        native.als_sort_by_entity(
+            _i32p(user_idx), _i32p(item_idx), _f32p(rating),
+            n_edges, U_pad, _i64p(counts_u),
+            _i32p(i_sorted), _f32p(r_sorted),
+        )
+        rc = native.als_sort_within_entity(
+            _i32p(i_sorted), _f32p(r_sorted), U_pad, _i64p(counts_u)
+        )
+        if rc != 0:  # a single user with ≥2^24 edges: sorter refuses
+            # wholesale. Training is order-invariant so this is safe,
+            # but the delta wire then won't apply (negative gaps →
+            # planes fallback) — say so instead of silently diverging
+            # from the numpy lexsort path.
+            import logging
+
+            logging.getLogger("pio_tpu.als").warning(
+                "within-user item sort skipped (an entity exceeds "
+                "2^24 edges); item wire falls back to planes"
+            )
+    else:
+        order = np.lexsort((item_idx, user_idx))
+        i_sorted = np.ascontiguousarray(item_idx[order])
+        r_sorted = np.ascontiguousarray(rating[order])
+    return i_sorted, r_sorted
+
+
+def _choose_item_wire(i_sorted, counts_u, I_pad, n_edges):
+    """Pick the denser lossless item wire: uint16/24/32 planes vs 12-bit
+    deltas over the (user, item)-sorted adjacency, sized by a count-only
+    pass (PIO_TPU_ALS_ITEM_WIRE overrides: auto/delta12/planes).
+    Returns (item_wire, n_ovf, edge_item_bytes)."""
+    item_env = os.environ.get("PIO_TPU_ALS_ITEM_WIRE", "auto")
+    plane_width = 2 if I_pad < 65536 else (3 if I_pad < 2 ** 24 else 4)
+    n_ovf = None
+    delta_bytes = None
+    if I_pad < 65536 and item_env in ("auto", "delta12"):
+        sized = _delta_wire_size(i_sorted, counts_u)
+        if sized is not None:
+            delta_bytes, n_ovf = sized
+            if item_env == "delta12" or delta_bytes < 2 * n_edges:
+                return "delta12", n_ovf, delta_bytes
+    return "planes", n_ovf, plane_width * n_edges
+
+
+def _run_mesh_compact(config, mesh, axis, n_shards, user_idx, item_idx,
+                      rating, n_edges, U_pad, I_pad, w_user, w_item,
+                      counts_layout, trainer, seed, stats):
+    """Multi-shard training over the COMPACT edge wire.
+
+    The host link (PCIe on a TPU VM, a tunnel here) is the slow hop and
+    ICI the fast one, so the wire crosses the host link exactly once:
+    every edge-indexed array ships SHARDED over the mesh axis (each
+    device receives 1/n of ~2 B/edge), and the jitted trainer
+    re-replicates them with an all-gather that rides ICI before the
+    on-device dual blocked-layout construction (``device_pack``). The
+    constructed block arrays come out sharded by block index — the
+    layout the shard_map half-steps consume — so block CONTENT never
+    needed host-side shard routing at all (the round-3 design note in
+    docs/parallelism.md). Bit-identical to the host-packed blocked-f32
+    path by the device_pack parity guarantee."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    t0 = time.perf_counter()
+    counts_u, chunk_user, S_u = counts_layout(user_idx, w_user, U_pad)
+    counts_i, chunk_item, S_i = counts_layout(item_idx, w_item, I_pad)
+    if S_u * w_user >= 2 ** 31 or S_i * w_item >= 2 ** 31:
+        raise ValueError(
+            "edge set too large for int32 block addressing; raise "
+            "block width or shard the edge set first"
+        )
+    counts_u = np.ascontiguousarray(counts_u, np.int64)
+    i_sorted, r_sorted = _sort_edges_by_user(
+        user_idx, item_idx, rating, n_edges, U_pad, counts_u
+    )
+    r_ship, rating_wire = _encode_ratings(r_sorted)
+    item_wire, n_ovf, item_bytes = _choose_item_wire(
+        i_sorted, counts_u, I_pad, n_edges
+    )
+    if item_wire == "delta12":
+        i_ship, i_hi, ovf_idx, ovf_val, _ = _encode_items_delta(
+            i_sorted, counts_u, n_ovf=n_ovf
+        )
+    else:
+        i_ship, i_hi = _planes(i_sorted, I_pad)
+        ovf_idx = np.zeros(0, np.int32)
+        ovf_val = np.zeros(0, np.uint8)
+    if stats is not None:
+        stats["pack_s"] = time.perf_counter() - t0
+        stats["wire_bytes"] = (
+            item_bytes + r_ship.nbytes + 4 * (U_pad + I_pad)
+        )
+        stats["encoding"] = f"{rating_wire}+{item_wire}"
+        stats["n_stream"] = 1
+
+    run = trainer(
+        chunk_user, chunk_item, (S_u, w_user, S_i, w_item),
+        rating_wire, item_wire,
+        mesh_wire_lens=(len(i_ship), len(i_hi), len(r_ship)),
+    )
+    shard1 = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+
+    def pad_to_shards(a):
+        p = (-len(a)) % n_shards
+        return np.concatenate([a, np.zeros(p, a.dtype)]) if p else a
+
+    t0 = time.perf_counter()
+    args = (
+        jax.device_put(counts_u.astype(np.int32), repl),
+        jax.device_put(np.ascontiguousarray(counts_i, np.int32), repl),
+        jax.device_put(pad_to_shards(i_ship), shard1),
+        jax.device_put(pad_to_shards(i_hi), shard1),
+        jax.device_put(ovf_idx, repl),
+        jax.device_put(ovf_val, repl),
+        jax.device_put(pad_to_shards(r_ship), shard1),
+    )
+    if stats is not None:
+        jax.block_until_ready(args)
+        stats["h2d_s"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        P_f, Q_f = run(*args, seed)
+        jax.block_until_ready((P_f, Q_f))
+        stats["device_s"] = time.perf_counter() - t0
+    else:
+        P_f, Q_f = run(*args, seed)
+    return P_f, Q_f
+
+
 def train_als(
     ctx: ComputeContext,
     user_idx: np.ndarray,
@@ -992,7 +1146,7 @@ def train_als(
         S = max(pad_to, _round_up(max(n_blocks, 1), pad_to))
         return counts, chunk, S
 
-    def _layout(ent, other, width, n_entities):
+    def _layout(ent, other, rat, width, n_entities):
         """Host-packed blocks (the multi-shard path; single-device packs
         on device instead — see _build_trainer's COO variant)."""
         native = _native_packer()
@@ -1002,7 +1156,7 @@ def train_als(
             block_other = np.empty(S * width, np.int32)
             block_rating = np.empty(S * width, np.float32)
             native.als_pack_fill(
-                _i32p(ent), _i32p(other), _f32p(rating), len(ent),
+                _i32p(ent), _i32p(other), _f32p(rat), len(ent),
                 n_entities, width, _i64p(counts), S,
                 _i32p(block_ent), _i32p(block_other), _f32p(block_rating),
             )
@@ -1013,7 +1167,7 @@ def train_als(
             )
         else:
             blocks = _pack_blocks(
-                ent, other, rating, n_entities, width, S, counts=counts
+                ent, other, rat, n_entities, width, S, counts=counts
             )
             assert blocks[0].shape[0] == S
         return blocks, chunk
@@ -1021,7 +1175,7 @@ def train_als(
     seed = np.uint32(config.seed)
 
     def _trainer(chunk_user, chunk_item, packed_shapes, rating_wire="f32",
-                 item_wire="planes"):
+                 item_wire="planes", mesh_wire_lens=None):
         # one call site for the long positional signature so the mesh and
         # single-device branches can never drift apart
         return _build_trainer(
@@ -1030,37 +1184,72 @@ def train_als(
             chunk_user, chunk_item,
             str(config.matmul_dtype), str(config.solver),
             packed_shapes, K, U_pad, I_pad, rating_wire, item_wire,
+            mesh_wire_lens,
         )
 
     if n_shards > 1:
-        t0 = time.perf_counter()
-        by_user, chunk_user = _layout(user_idx, item_idx, w_user, U_pad)
-        by_item, chunk_item = _layout(item_idx, user_idx, w_item, I_pad)
-        run = _trainer(chunk_user, chunk_item, None)
-        blk = NamedSharding(mesh, P(axis))
-        blk2 = NamedSharding(mesh, P(axis, None))
-        put_blocks = lambda t: (
-            jax.device_put(t[0], blk),
-            jax.device_put(t[1], blk2),
-            jax.device_put(t[2], blk2),
-        )
-        if stats is not None:
-            stats["pack_s"] = time.perf_counter() - t0
-            stats["wire_bytes"] = sum(
-                a.nbytes for t in (by_user, by_item) for a in t
+        # wire policy: "compact" (default) ships the single-device delta/
+        # plane+code wire — each device receives 1/n of it over the host
+        # link (PCIe/DCN, the slow hop) and the jitted trainer re-
+        # replicates it over ICI (fast) before the on-device dual blocked-
+        # layout construction, whose sharded outputs feed the shard_map
+        # half-steps. "blocked" keeps the host-packed f32 block shipment
+        # (~16× the bytes/edge) — retained as the equality reference.
+        mesh_wire = os.environ.get("PIO_TPU_ALS_MESH_WIRE", "auto")
+        if mesh_wire in ("auto", "compact"):
+            P_f, Q_f = _run_mesh_compact(
+                config, mesh, axis, n_shards, user_idx, item_idx, rating,
+                n_edges, U_pad, I_pad, w_user, w_item, _counts_layout,
+                _trainer, seed, stats,
             )
-            stats["encoding"] = "blocked-f32"
-            stats["n_stream"] = 1
-            t0 = time.perf_counter()
-            u_dev, i_dev = put_blocks(by_user), put_blocks(by_item)
-            jax.block_until_ready((u_dev, i_dev))
-            stats["h2d_s"] = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            P_f, Q_f = run(u_dev, i_dev, seed)
-            jax.block_until_ready((P_f, Q_f))
-            stats["device_s"] = time.perf_counter() - t0
         else:
-            P_f, Q_f = run(put_blocks(by_user), put_blocks(by_item), seed)
+            t0 = time.perf_counter()
+            # canonical (user, item) edge order BEFORE packing: block
+            # content becomes input-order-invariant and bit-identical to
+            # the compact path's on-device construction (which composes
+            # through a stable sort of the same canonical stream)
+            cu0 = np.ascontiguousarray(
+                np.bincount(user_idx, minlength=U_pad), np.int64
+            )
+            i_srt, r_srt = _sort_edges_by_user(
+                user_idx, item_idx, rating, n_edges, U_pad, cu0
+            )
+            u_srt = np.repeat(
+                np.arange(U_pad, dtype=np.int32), cu0
+            )
+            by_user, chunk_user = _layout(
+                u_srt, i_srt, r_srt, w_user, U_pad
+            )
+            by_item, chunk_item = _layout(
+                i_srt, u_srt, r_srt, w_item, I_pad
+            )
+            run = _trainer(chunk_user, chunk_item, None)
+            blk = NamedSharding(mesh, P(axis))
+            blk2 = NamedSharding(mesh, P(axis, None))
+            put_blocks = lambda t: (
+                jax.device_put(t[0], blk),
+                jax.device_put(t[1], blk2),
+                jax.device_put(t[2], blk2),
+            )
+            if stats is not None:
+                stats["pack_s"] = time.perf_counter() - t0
+                stats["wire_bytes"] = sum(
+                    a.nbytes for t in (by_user, by_item) for a in t
+                )
+                stats["encoding"] = "blocked-f32"
+                stats["n_stream"] = 1
+                t0 = time.perf_counter()
+                u_dev, i_dev = put_blocks(by_user), put_blocks(by_item)
+                jax.block_until_ready((u_dev, i_dev))
+                stats["h2d_s"] = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                P_f, Q_f = run(u_dev, i_dev, seed)
+                jax.block_until_ready((P_f, Q_f))
+                stats["device_s"] = time.perf_counter() - t0
+            else:
+                P_f, Q_f = run(
+                    put_blocks(by_user), put_blocks(by_item), seed
+                )
     else:
         # Single-device path: ship the COO edges pre-sorted by user (see
         # _build_trainer's COO variant for the wire format) and let the
@@ -1078,60 +1267,18 @@ def train_als(
                 "use a multi-device mesh"
             )
 
-        # sort by (user, item): native two-pass (counting sort by user +
-        # per-adjacency stable sort), numpy lexsort fallback. Item-sorted
-        # adjacencies are what make the delta item wire dense AND improve
-        # factor-gather locality on device; ALS itself is order-invariant
-        # within a user.
         counts_u = np.ascontiguousarray(counts_u, np.int64)
-        native = _native_packer()
-        if native is not None:
-            i_sorted = np.empty(n_edges, np.int32)
-            r_sorted = np.empty(n_edges, np.float32)
-            native.als_sort_by_entity(
-                _i32p(user_idx), _i32p(item_idx), _f32p(rating),
-                n_edges, U_pad, _i64p(counts_u),
-                _i32p(i_sorted), _f32p(r_sorted),
-            )
-            rc = native.als_sort_within_entity(
-                _i32p(i_sorted), _f32p(r_sorted), U_pad, _i64p(counts_u)
-            )
-            if rc != 0:  # a single user with ≥2^24 edges: sorter refuses
-                # wholesale. Training is order-invariant so this is safe,
-                # but the delta wire then won't apply (negative gaps →
-                # planes fallback) — say so instead of silently diverging
-                # from the numpy lexsort path.
-                import logging
-
-                logging.getLogger("pio_tpu.als").warning(
-                    "within-user item sort skipped (an entity exceeds "
-                    "2^24 edges); item wire falls back to planes"
-                )
-        else:
-            order = np.lexsort((item_idx, user_idx))
-            i_sorted = np.ascontiguousarray(item_idx[order])
-            r_sorted = np.ascontiguousarray(rating[order])
-
+        i_sorted, r_sorted = _sort_edges_by_user(
+            user_idx, item_idx, rating, n_edges, U_pad, counts_u
+        )
         r_ship, rating_wire = _encode_ratings(r_sorted)
-        # item wire: u16/planes vs 12-bit deltas over the item-sorted
-        # adjacency — whichever is smaller, sized by a count-only pass so
-        # nothing is materialized before the stream/monolithic split
-        # (PIO_TPU_ALS_ITEM_WIRE overrides for tests: auto/delta12/planes)
-        item_env = os.environ.get("PIO_TPU_ALS_ITEM_WIRE", "auto")
-        plane_width = 2 if I_pad < 65536 else (3 if I_pad < 2 ** 24 else 4)
-        use_delta = False
-        n_ovf = None
-        if I_pad < 65536 and item_env in ("auto", "delta12"):
-            sized = _delta_wire_size(i_sorted, counts_u)
-            if sized is not None:
-                delta_bytes, n_ovf = sized
-                use_delta = (
-                    item_env == "delta12" or delta_bytes < 2 * n_edges
-                )
-        item_wire = "delta12" if use_delta else "planes"
-        edge_bytes = (
-            delta_bytes if use_delta else plane_width * n_edges
-        ) + r_ship.nbytes
+        # item wire sized by a count-only pass so nothing is materialized
+        # before the stream/monolithic split
+        item_wire, n_ovf, item_bytes = _choose_item_wire(
+            i_sorted, counts_u, I_pad, n_edges
+        )
+        use_delta = item_wire == "delta12"
+        edge_bytes = item_bytes + r_ship.nbytes
         if stats is not None:
             stats["pack_s"] = time.perf_counter() - t0
             stats["wire_bytes"] = (
@@ -1141,11 +1288,14 @@ def train_als(
 
         # stream threshold: chunked double-buffered shipment once the edge
         # wire exceeds ~one chunk (default 8 MiB); tiny runs keep the
-        # single-dispatch path
+        # single-dispatch path. <= 0 disables streaming entirely.
         stream_mb = float(os.environ.get("PIO_TPU_ALS_STREAM_MB", "8"))
-        n_stream = int(min(
-            8, -(-edge_bytes // max(1, int(stream_mb * 2 ** 20)))
-        ))
+        if stream_mb <= 0:
+            n_stream = 1
+        else:
+            n_stream = int(min(
+                8, -(-edge_bytes // max(1, int(stream_mb * 2 ** 20)))
+            ))
         if config.iterations < 1:
             # the streamed trainer fuses iteration 1's user half-step into
             # the chunk accumulation, so it can't express "0 iterations";

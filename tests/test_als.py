@@ -46,6 +46,42 @@ class TestALS:
         # same predictions up to reduction-order float noise
         assert np.abs(pl - pm).max() < 0.05
 
+    def test_mesh_compact_wire_matches_blocked(self, synthetic,
+                                               monkeypatch):
+        """The compact mesh wire (sharded h2d → ICI all-gather → device
+        dual-layout construction) must train BYTE-IDENTICAL factors to
+        the host-packed blocked-f32 shipment — the two paths feed the
+        same shard_map trainer and device_pack is bit-identical to the
+        host packers. Grid ratings make the u4 rating decode exact."""
+        s = synthetic
+        rng = np.random.default_rng(5)
+        r_grid = (rng.integers(1, 11, len(s["u"])) * 0.5).astype(np.float32)
+
+        monkeypatch.setenv("PIO_TPU_ALS_MESH_WIRE", "blocked")
+        st_b = {}
+        f_blocked = train_als(
+            ComputeContext.create(), s["u"], s["i"], r_grid,
+            s["U"], s["I"], CFG, stats=st_b,
+        )
+        assert st_b["encoding"] == "blocked-f32"
+
+        monkeypatch.setenv("PIO_TPU_ALS_MESH_WIRE", "compact")
+        st_c = {}
+        f_compact = train_als(
+            ComputeContext.create(), s["u"], s["i"], r_grid,
+            s["U"], s["I"], CFG, stats=st_c,
+        )
+        assert st_c["encoding"].startswith("u4"), st_c
+        assert np.array_equal(
+            f_blocked.user_factors, f_compact.user_factors
+        )
+        assert np.array_equal(
+            f_blocked.item_factors, f_compact.item_factors
+        )
+        # the whole point: the compact wire crosses the host link with a
+        # small fraction of the blocked-f32 bytes
+        assert st_c["wire_bytes"] < st_b["wire_bytes"] / 3, (st_c, st_b)
+
     def test_implicit_separates_observed(self, synthetic):
         s = synthetic
         f = train_als(
@@ -267,6 +303,19 @@ class TestALS:
         pm = f_mono.user_factors @ f_mono.item_factors.T
         ps = f_str.user_factors @ f_str.item_factors.T
         assert np.abs(pm - ps).max() < 0.05
+
+    def test_stream_disable_env(self, synthetic, monkeypatch):
+        """PIO_TPU_ALS_STREAM_MB <= 0 means 'streaming off' — the
+        intuitive disable value must not degenerate into a 1-byte
+        threshold that forces the max chunked path."""
+        s = synthetic
+        monkeypatch.setenv("PIO_TPU_ALS_STREAM_MB", "0")
+        stats = {}
+        train_als(
+            ComputeContext.local(), s["u"], s["i"], s["r"], s["U"], s["I"],
+            CFG, stats=stats,
+        )
+        assert stats["n_stream"] == 1, stats
 
     def test_streamed_u4_ratings(self, synthetic, monkeypatch):
         """Half-star-grid ratings ride the nibble-packed u4 wire; the
