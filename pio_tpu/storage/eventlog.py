@@ -101,9 +101,13 @@ class EventLogEvents(base.LEvents, base.PEvents):
         self.root = root
         os.makedirs(root, exist_ok=True)
         from pio_tpu.native import event_log_lib
+        from pio_tpu.storage.groupcommit import GroupCommitter
 
         self._lib = event_log_lib()
         self._repaired: set = set()  # paths torn-tail-checked this handle
+        # instance is registry-cached per root, so this coalesces across
+        # concurrent requests (see insert())
+        self._gc = GroupCommitter(self._flush_appends)
 
     # -- files --------------------------------------------------------------
     def _path(self, app_id: int, channel_id=None) -> str:
@@ -165,9 +169,42 @@ class EventLogEvents(base.LEvents, base.PEvents):
         )
 
     def insert(self, event: Event, app_id: int, channel_id=None) -> str:
+        """Single insert via GROUP COMMIT (storage/groupcommit.py):
+        concurrent single-event ingests coalesce into one open/write/
+        flush per (app, channel) log — the self-framed records make a
+        concatenation a valid append sequence, exactly as insert_batch
+        relies on."""
         event_id, rec = self._encode_event(event)
-        self._append(app_id, channel_id, rec)
-        return event_id
+        return self._gc.submit((event_id, app_id, channel_id, rec))
+
+    def _flush_appends(self, payloads):
+        """Batched flush over possibly several (app, channel) log files.
+        Appends to multiple files cannot be all-or-nothing, so a failed
+        group reports per-payload outcomes (PartialFlushOutcome) instead
+        of raising wholesale — a blind committer retry would re-append
+        the groups that already landed (duplicates in an append-only
+        log)."""
+        from pio_tpu.storage.groupcommit import PartialFlushOutcome
+
+        groups: dict = {}
+        for k, (eid, app_id, channel_id, rec) in enumerate(payloads):
+            groups.setdefault((app_id, channel_id), []).append((k, rec))
+        outcomes: list = [None] * len(payloads)
+        failed = False
+        for (app_id, channel_id), members in groups.items():
+            try:
+                self._append(
+                    app_id, channel_id, b"".join(r for _, r in members)
+                )
+                for k, _ in members:
+                    outcomes[k] = payloads[k][0]
+            except Exception as exc:
+                failed = True
+                for k, _ in members:
+                    outcomes[k] = exc
+        if failed:
+            raise PartialFlushOutcome(outcomes)
+        return outcomes
 
     def insert_batch(self, events, app_id: int, channel_id=None):
         """Frame every record and land them in ONE native append — a

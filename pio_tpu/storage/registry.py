@@ -65,11 +65,16 @@ class StorageConfigError(base.StorageError):
     pass
 
 
+_homes_made: set = set()
+
+
 def pio_home() -> str:
     home = os.environ.get("PIO_TPU_HOME")
     if not home:
         home = os.path.join(os.path.expanduser("~"), ".pio_tpu")
-    os.makedirs(home, exist_ok=True)
+    if home not in _homes_made:  # once per path — this sits on the
+        os.makedirs(home, exist_ok=True)  # per-request ingest hot path
+        _homes_made.add(home)
     return home
 
 
@@ -144,6 +149,7 @@ class Storage:
         with cls._lock:
             cls._clients.clear()
             cls._mem.clear()
+        _homes_made.clear()  # re-create homes on next touch
 
     # -- metadata stores ----------------------------------------------------
     @classmethod

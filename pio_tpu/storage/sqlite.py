@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
+import os
 import sqlite3
 import threading
 import uuid
@@ -188,9 +189,23 @@ class SQLiteClient:
     def conn(self) -> sqlite3.Connection:
         c = getattr(self._local, "conn", None)
         if c is None:
-            c = sqlite3.connect(self.path, timeout=30.0)
+            try:
+                c = sqlite3.connect(self.path, timeout=30.0)
+            except sqlite3.OperationalError:
+                # self-heal a vanished parent directory (cleanup/rotation
+                # under a long-running server) instead of failing every
+                # request until restart
+                parent = os.path.dirname(self.path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                c = sqlite3.connect(self.path, timeout=30.0)
             c.execute("PRAGMA journal_mode=WAL")
             c.execute("PRAGMA synchronous=NORMAL")
+            # default checkpoint-every-1000-pages runs mid-commit on the
+            # ingest hot path (measured ~2x per-insert cost); 16384 pages
+            # (~64 MB WAL ceiling) amortizes it — readers are unaffected,
+            # the WAL is part of the database
+            c.execute("PRAGMA wal_autocheckpoint=16384")
             self._local.conn = c
         return c
 
@@ -221,11 +236,46 @@ def _row_to_event(r) -> Event:
     )
 
 
+_EVENT_INSERT_SQL = (
+    "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)"
+)
+
+
 class SQLiteEvents(base.LEvents, base.PEvents):
     """LEvents + PEvents over the ``events`` table."""
 
     def __init__(self, client: SQLiteClient):
         self._c = client
+        # the group committer must coalesce across REQUESTS, and the
+        # registry builds a fresh wrapper per get_levents() call — so it
+        # lives on the (cached, shared) client, created once
+        gc = getattr(client, "_events_gc", None)
+        if gc is None:
+            with client._init_lock:
+                gc = getattr(client, "_events_gc", None)
+                if gc is None:
+                    from pio_tpu.storage.groupcommit import GroupCommitter
+
+                    def flush(payloads):
+                        conn = client.conn()
+                        try:
+                            conn.executemany(
+                                _EVENT_INSERT_SQL,
+                                [p[1] for p in payloads],
+                            )
+                            conn.commit()
+                        except Exception:
+                            # leave nothing pending on the thread-local
+                            # connection — an unrolled-back partial
+                            # executemany would ride out with the next
+                            # unrelated commit despite the client 500
+                            conn.rollback()
+                            raise
+                        return [p[0] for p in payloads]
+
+                    gc = GroupCommitter(flush)
+                    client._events_gc = gc
+        self._gc = gc
 
     def init_channel(self, app_id, channel_id=None) -> bool:
         return True  # single-table design; nothing to create
@@ -249,23 +299,23 @@ class SQLiteEvents(base.LEvents, base.PEvents):
         )
 
     def insert(self, event: Event, app_id, channel_id=None) -> str:
+        """Single insert via GROUP COMMIT: concurrent single-event
+        ingests coalesce into one executemany + one WAL commit (the
+        leader/follower protocol in storage/groupcommit.py — free for
+        serial traffic, amortized commits under concurrent POSTs)."""
         eid = event.event_id or Event.new_event_id()
-        conn = self._c.conn()
-        conn.execute(
-            "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-            self._row(eid, event, app_id, channel_id),
+        return self._gc.submit(
+            (eid, self._row(eid, event, app_id, channel_id))
         )
-        conn.commit()
-        return eid
 
     def insert_batch(self, events, app_id, channel_id=None):
         """One executemany + one commit for the whole batch (the WAL
-        fsync per commit dominates per-event cost; amortizing it across
+        commit per event dominates per-event cost; amortizing it across
         ≤50 events is the batch route's whole point)."""
         ids = [e.event_id or Event.new_event_id() for e in events]
         conn = self._c.conn()
         conn.executemany(
-            "INSERT OR REPLACE INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            _EVENT_INSERT_SQL,
             [
                 self._row(eid, e, app_id, channel_id)
                 for eid, e in zip(ids, events)

@@ -1,0 +1,202 @@
+"""Leader/follower group commit (pio_tpu/storage/groupcommit.py) and its
+wiring into the sqlite + native-eventlog single-insert paths."""
+
+import threading
+
+import pytest
+
+from pio_tpu.storage.groupcommit import GroupCommitter
+
+
+class TestGroupCommitter:
+    def test_serial_submits_flush_individually(self):
+        batches = []
+
+        def flush(ps):
+            batches.append(list(ps))
+            return [p * 10 for p in ps]
+
+        gc = GroupCommitter(flush)
+        assert gc.submit(1) == 10
+        assert gc.submit(2) == 20
+        # serial traffic: no artificial batching, no waiting
+        assert batches == [[1], [2]]
+
+    def test_concurrent_submits_coalesce(self):
+        """Block the first leader mid-flush; everyone who arrives
+        meanwhile must ride ONE follow-up flush."""
+        release = threading.Event()
+        in_flush = threading.Event()
+        batches = []
+
+        def flush(ps):
+            batches.append(list(ps))
+            if len(batches) == 1:
+                in_flush.set()
+                release.wait(10)
+            return list(ps)
+
+        gc = GroupCommitter(flush)
+        t0 = threading.Thread(target=lambda: gc.submit(0))
+        t0.start()
+        in_flush.wait(10)
+        followers = [
+            threading.Thread(target=lambda i=i: gc.submit(i))
+            for i in range(1, 9)
+        ]
+        for t in followers:
+            t.start()
+        # wait until every follower is queued, then release the leader
+        for _ in range(1000):
+            with gc._qlock:
+                if len(gc._q) == 8:
+                    break
+            threading.Event().wait(0.005)
+        release.set()
+        t0.join(10)
+        for t in followers:
+            t.join(10)
+        assert batches[0] == [0]
+        # all 8 followers coalesced into one (or at most two) flushes
+        assert len(batches) <= 3
+        assert sorted(p for b in batches[1:] for p in b) == list(range(1, 9))
+
+    def test_poisoned_payload_isolated(self):
+        """A failing payload in a batch must fail ONLY its own submit;
+        batch-mates retry individually and succeed."""
+        release = threading.Event()
+        in_flush = threading.Event()
+        calls = []
+
+        def flush(ps):
+            calls.append(list(ps))
+            if len(calls) == 1:
+                in_flush.set()
+                release.wait(10)
+            if any(p == "bad" for p in ps):
+                raise ValueError("poison")
+            return list(ps)
+
+        gc = GroupCommitter(flush)
+        results = {}
+
+        def run(p):
+            try:
+                results[p] = ("ok", gc.submit(p))
+            except ValueError as e:
+                results[p] = ("err", str(e))
+
+        t0 = threading.Thread(target=run, args=("warm",))
+        t0.start()
+        in_flush.wait(10)
+        ts = [threading.Thread(target=run, args=(p,))
+              for p in ("a", "bad", "b")]
+        for t in ts:
+            t.start()
+        for _ in range(1000):
+            with gc._qlock:
+                if len(gc._q) == 3:
+                    break
+            threading.Event().wait(0.005)
+        release.set()
+        t0.join(10)
+        for t in ts:
+            t.join(10)
+        assert results["a"] == ("ok", "a")
+        assert results["b"] == ("ok", "b")
+        assert results["bad"] == ("err", "poison")
+
+
+def test_partial_flush_outcomes_not_retried():
+    """A flush that raises PartialFlushOutcome (non-atomic backend, e.g.
+    multi-file appends) must have its per-payload outcomes assigned
+    verbatim — NO blind retry, which would duplicate landed payloads."""
+    from pio_tpu.storage.groupcommit import PartialFlushOutcome
+
+    release = threading.Event()
+    in_flush = threading.Event()
+    calls = []
+
+    def flush(ps):
+        calls.append(list(ps))
+        if len(calls) == 1:
+            in_flush.set()
+            release.wait(10)
+            return list(ps)
+        # mixed batch: 'x' landed, 'y' failed — report, don't raise raw
+        raise PartialFlushOutcome(
+            [p if p != "y" else ValueError("io error") for p in ps]
+        )
+
+    gc = GroupCommitter(flush)
+    results = {}
+
+    def run(p):
+        try:
+            results[p] = ("ok", gc.submit(p))
+        except ValueError as e:
+            results[p] = ("err", str(e))
+
+    t0 = threading.Thread(target=run, args=("warm",))
+    t0.start()
+    in_flush.wait(10)
+    ts = [threading.Thread(target=run, args=(p,)) for p in ("x", "y")]
+    for t in ts:
+        t.start()
+    for _ in range(1000):
+        with gc._qlock:
+            if len(gc._q) == 2:
+                break
+        threading.Event().wait(0.005)
+    release.set()
+    t0.join(10)
+    for t in ts:
+        t.join(10)
+    assert results["x"] == ("ok", "x")
+    assert results["y"] == ("err", "io error")
+    # exactly 2 flushes: warm + the partial batch; NO per-payload retries
+    assert len(calls) == 2, calls
+
+
+@pytest.mark.parametrize("backend", ["sqlite", "eventlog"])
+def test_concurrent_single_inserts_land(tmp_home, monkeypatch, backend):
+    """16 threads hammering the single-insert path: every event lands,
+    ids are unique, and the store reads them all back."""
+    from pio_tpu.data.event import Event
+    from pio_tpu.storage import Storage
+
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "GC")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_GC_TYPE", backend)
+    monkeypatch.setenv(
+        "PIO_STORAGE_SOURCES_GC_PATH", str(tmp_home / f"gc_{backend}")
+    )
+    Storage.reset()
+    try:
+        ids = []
+        lock = threading.Lock()
+
+        def worker(t):
+            le = Storage.get_levents()
+            got = []
+            for n in range(25):
+                eid = le.insert(
+                    Event("rate", "user", f"u{t}", "item", f"i{n}",
+                          properties={"rating": float(n % 5) + 1}),
+                    7,
+                )
+                got.append(eid)
+            with lock:
+                ids.extend(got)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert len(ids) == 400 and len(set(ids)) == 400
+        events = Storage.get_levents().find(7, limit=None)
+        assert len(events) == 400
+    finally:
+        Storage.reset()

@@ -177,7 +177,9 @@ class TestShell:
             [sys.executable, "-m", "pio_tpu", "shell"],
             input="print('SUM', int(jnp.arange(4).sum()));"
                   "print('HAS', PEventStore is not None, Event is not None)",
-            capture_output=True, text=True, timeout=120,
+            # a cold jax import in the child takes ~1 min on this host
+            # ALONE; a contended single core can triple that
+            capture_output=True, text=True, timeout=360,
             env={**__import__('os').environ, "JAX_PLATFORMS": "cpu"},
         )
         assert proc.returncode == 0, proc.stderr[-800:]
