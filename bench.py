@@ -562,9 +562,21 @@ def _bench_similarproduct(ctx, scale: float) -> float:
 
 
 def _bench_textclass(scale: float) -> dict:
-    """BASELINE config #4: the embedding-bag hot op — Pallas kernel vs the
-    plain-XLA gather+einsum lowering, tokens/sec (B·L per call)."""
+    """BASELINE config #4: the embedding-bag hot op — Pallas kernel vs
+    the plain-XLA gather+einsum lowering. Beyond raw tokens/sec, this
+    stage records the kernel's ACTUAL wins as artifacts:
+
+    - accuracy: max relative error vs a float64 host reference — the
+      XLA default contracts in bf16 on the MXU (~2 decimal digits); the
+      kernel accumulates f32 on the VPU. ``xla_f32_tokens_per_sec`` is
+      the apples-to-apples comparison at equal (f32) accuracy.
+    - memory: XLA materializes the gathered [B, L, D] intermediate in
+      HBM; the kernel streams rows through an O(depth·D) VMEM ring. The
+      large-shape stage runs a bag batch whose XLA intermediate alone
+      exceeds v5e HBM — the kernel must survive it, XLA cannot.
+    """
     import jax
+    import jax.numpy as jnp
 
     from pio_tpu.ops.embedding import (
         _embedding_bag_pallas, _embedding_bag_xla, _use_pallas,
@@ -573,26 +585,101 @@ def _bench_textclass(scale: float) -> dict:
     V, D = 50_000, 256
     B, L = int(4096 * scale) or 8, 64
     rng = np.random.default_rng(3)
-    table = jax.device_put(rng.normal(size=(V, D)).astype(np.float32))
-    ids = jax.device_put(rng.integers(0, V, (B, L)).astype(np.int32))
-    w = jax.device_put(rng.random((B, L)).astype(np.float32))
+    table_h = rng.normal(size=(V, D)).astype(np.float32)
+    ids_h = rng.integers(0, V, (B, L)).astype(np.int32)
+    w_h = rng.random((B, L)).astype(np.float32)
+    table = jax.device_put(table_h)
+    ids = jax.device_put(ids_h)
+    w = jax.device_put(w_h)
     tokens = B * L
 
-    def timed(fn):
-        jf = jax.jit(fn)
-        dt, _ = _best_of(
-            lambda: jax.block_until_ready(jf(table, ids, w)), repeats=3
-        )
-        return tokens / dt
+    K = 8  # chained applications per timed dispatch — amortizes the
+    # tunnel RTT and forces real execution (block_until_ready on this
+    # tunnel can ack before compute for small async programs; a scalar
+    # pulled to host cannot lie)
 
-    out = {"xla_tokens_per_sec": round(timed(_embedding_bag_xla), 1)}
+    def timed(fn):
+        def many(t, i, w):
+            def body(k, acc):
+                # roll by the loop index so no iteration can be hoisted
+                out = fn(t, jnp.roll(i, k, axis=0), w)
+                return acc + jnp.sum(out)
+
+            return jax.lax.fori_loop(0, K, body, jnp.float32(0))
+
+        jf = jax.jit(many)
+        dt, _ = _best_of(
+            lambda: float(jf(table, ids, w)), repeats=3
+        )
+        # accuracy sample from the JITTED op — what the templates run
+        # (eager and jitted einsum pick different default precisions)
+        return K * tokens / dt, np.asarray(jax.jit(fn)(table, ids, w))
+
+    def xla_unpinned(table, ids, w):
+        # the raw default lowering (no pinned precision) — reference
+        # point for what the shipped op's HIGHEST pin costs
+        rows = table[ids]
+        return jnp.einsum(
+            "bld,bl->bd", rows.astype(jnp.float32),
+            w.astype(jnp.float32),
+        )
+
+    xla_rate, xla_out = timed(_embedding_bag_xla)  # shipped path (f32)
+    out = {"xla_tokens_per_sec": round(xla_rate, 1)}
+    # f64 host reference for the accuracy artifact (sampled rows keep
+    # the host cost bounded at full scale)
+    sample = np.arange(0, B, max(1, B // 256))
+    ref = np.einsum(
+        "bld,bl->bd",
+        table_h.astype(np.float64)[ids_h[sample]],
+        w_h[sample].astype(np.float64),
+    )
+    denom = max(1e-9, float(np.abs(ref).max()))
+
+    def max_err(got):
+        return float(
+            np.abs(np.asarray(got)[sample].astype(np.float64) - ref).max()
+        ) / denom
+
+    acc = {"xla_max_err": round(max_err(xla_out), 8)}
+    unp_rate, unp_out = timed(xla_unpinned)
+    out["xla_unpinned_default_tokens_per_sec"] = round(unp_rate, 1)
+    acc["xla_unpinned_default_max_err"] = round(max_err(unp_out), 8)
     if _use_pallas(table):
-        out["pallas_tokens_per_sec"] = round(
-            timed(_embedding_bag_pallas), 1
+        p_rate, p_out = timed(_embedding_bag_pallas)
+        out["pallas_tokens_per_sec"] = round(p_rate, 1)
+        out["pallas_speedup_vs_xla"] = round(p_rate / xla_rate, 3)
+        acc["pallas_max_err"] = round(max_err(p_out), 8)
+    out["accuracy"] = acc
+    out["memory_mb"] = {
+        # what each path needs beyond inputs + outputs at this shape
+        "xla_intermediate": round(B * L * D * 4 / 1e6, 1),
+        "pallas_scratch": round(4 * D * 4 / 1e6, 4),
+    }
+
+    if _use_pallas(table) and scale >= 0.5:
+        # large-shape survival: the gathered [B, L, D] f32 intermediate
+        # is ~24 GB > v5e HBM; the kernel's O(B·D) output + VMEM ring
+        # fits easily
+        Bl, Ll = 16_384, 1_436
+        ids_l = jax.device_put(
+            rng.integers(0, V, (Bl, Ll)).astype(np.int32)
         )
-        out["pallas_speedup"] = round(
-            out["pallas_tokens_per_sec"] / out["xla_tokens_per_sec"], 3
-        )
+        w_l = jax.device_put(rng.random((Bl, Ll)).astype(np.float32))
+        big = {"B": Bl, "L": Ll,
+               "xla_intermediate_gb": round(Bl * Ll * D * 4 / 1e9, 1)}
+        try:
+            jf = jax.jit(
+                lambda t, i, w: jnp.sum(_embedding_bag_pallas(t, i, w))
+            )
+            dt, _ = _best_of(
+                lambda: float(jf(table, ids_l, w_l)), repeats=1
+            )
+            big["pallas_tokens_per_sec"] = round(Bl * Ll / dt, 1)
+        except Exception as exc:
+            big["pallas_error"] = str(exc)[:200]
+        big["xla"] = "skipped: intermediate alone exceeds v5e HBM"
+        out["large_shape"] = big
     return out
 
 
@@ -1050,8 +1137,11 @@ def main() -> None:
                 try:
                     with jax.default_device(cpu_dev):
                         tc_cpu = _bench_textclass(sscale * 0.25)
-                    best = tc.get(
-                        "pallas_tokens_per_sec", tc["xla_tokens_per_sec"]
+                    # the shipped op dispatches to XLA at this shape, so
+                    # the device number of record is the faster path
+                    best = max(
+                        tc.get("pallas_tokens_per_sec", 0.0),
+                        tc["xla_tokens_per_sec"],
                     )
                     tc["cpu_anchor"] = tc_cpu["xla_tokens_per_sec"]
                     tc["vs_baseline"] = round(

@@ -927,14 +927,27 @@ def _encode_ratings(r_sorted: np.ndarray) -> Tuple[np.ndarray, str]:
     nibble-packed half-star codes (2 edges/byte — MovieLens's 0.5..5.0
     grid and implicit r=1 both qualify), byte codes to 127.5 stars, fp16
     when that cast is exact, else raw f32. The decode lives in
-    ``_make_math.decode_ratings``; every kind round-trips exactly.
-    """
-    r2 = r_sorted * np.float32(2.0)
-    if r2.size and np.all(r2 == np.round(r2)) and float(r2.min()) >= 0.0:
-        if float(r2.max()) <= 15.0:
-            return _nibble_pack(r2.astype(np.uint8)), "u4"
-        if float(r2.max()) <= 255.0:
-            return r2.astype(np.uint8), "u8"
+    ``_make_math.decode_ratings``; every kind round-trips exactly. The
+    grid check + byte coding is one fused native pass when available
+    (the numpy pipeline was ~10% of the whole host pack)."""
+    native = _native_packer()
+    if native is not None and r_sorted.size:
+        codes = np.empty(r_sorted.size, np.uint8)
+        mx = native.als_rating_codes(
+            _f32p(r_sorted), r_sorted.size, _u8p(codes)
+        )
+        if mx >= 0:
+            if mx <= 15:
+                return _nibble_pack(codes), "u4"
+            return codes, "u8"
+    else:
+        r2 = r_sorted * np.float32(2.0)
+        if r2.size and np.all(r2 == np.round(r2)) \
+                and float(r2.min()) >= 0.0:
+            if float(r2.max()) <= 15.0:
+                return _nibble_pack(r2.astype(np.uint8)), "u4"
+            if float(r2.max()) <= 255.0:
+                return r2.astype(np.uint8), "u8"
     r16 = r_sorted.astype(np.float16)
     if np.array_equal(r16.astype(np.float32), r_sorted):
         return r16, "f16"

@@ -153,5 +153,7 @@ def als_pack_lib():
             u8p, u8p, i32p, u8p,
         ]
         lib.als_delta_fill.restype = ctypes.c_int
+        lib.als_rating_codes.argtypes = [f32p, ctypes.c_int64, u8p]
+        lib.als_rating_codes.restype = ctypes.c_int64
         _cache["als_pack"] = lib
         return lib

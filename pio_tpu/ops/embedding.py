@@ -7,23 +7,26 @@ The sparse hot loop of the text-classification and two-tower templates is
 i.e. a TF-IDF document (or a feature-bag) times an embedding table. On the
 reference's substrate this is a Spark-side sparse-vector dot
 (MLlib ``HashingTF``/``IDF`` pipelines — UNVERIFIED paths; SURVEY.md §2.6).
-A naive XLA lowering materializes the gathered ``[B, L, D]`` tensor in HBM
-and contracts it on the MXU in bfloat16. The Pallas kernel instead streams
-table rows HBM→VMEM with an N-deep ring of async DMAs and accumulates in
-float32 on the VPU — the ``[B, L, D]`` intermediate never exists.
+The XLA lowering materializes the gathered ``[B, L, D]`` tensor in HBM and
+contracts it on the MXU. The Pallas kernel instead streams table rows
+HBM→VMEM with an N-deep ring of async DMAs and accumulates in float32 on
+the VPU — the ``[B, L, D]`` intermediate never exists.
 
-Measured on v5e-1 (V=50k, D=256, B=4096, L=64, f32):
+Measured on v5e-1 (V=50k, D=256, f32; the bench records these each round
+in ``secondary.textclassification``):
 
-- Pallas kernel: 9.8 ms, max err vs float64 7e-6 (full f32 accuracy),
-  O(B·D) scratch memory.
-- XLA gather+einsum: 6.9 ms at default (bf16 MXU) precision but max err
-  6e-2; 268 MB HBM intermediate → OOMs at large B·L.
-- XLA at ``Precision.HIGHEST``: f32-accurate but pays the same HBM
-  intermediate.
+- At B=4096, L=64 (intermediate 268 MB, fits HBM): jitted XLA wins —
+  23.3M tokens/s at max err 9e-8 vs f64 (the jitted default contracts
+  f32 inputs via 3-pass bf16, so there is NO accuracy gap to close);
+  the kernel does 13.9M tokens/s at err 2.6e-7.
+- At B=16384, L=1436 the intermediate alone would be **24 GB — over
+  v5e HBM, XLA cannot run at all**; the kernel streams it at 11.3M
+  tokens/s through a 4 KB VMEM ring.
 
-So the kernel is the accuracy- and memory-robust path; plain XLA is kept as
-the fallback for CPU and for callers that prefer raw bf16 throughput
-(``prefer='xla'``).
+So the kernel is the MEMORY-robust path and ``embedding_bag`` dispatches
+by intermediate size: shapes whose ``[B, L, D]`` gather fits comfortably
+take XLA, larger ones take the kernel
+(``PIO_TPU_EMBED_PALLAS_OVER_MB`` overrides the cutoff; CPU always XLA).
 
 Layout notes (Mosaic constraints):
 
@@ -188,13 +191,34 @@ def _embedding_bag_pallas(
 def _embedding_bag_xla(
     table: jax.Array, ids: jax.Array, weights: jax.Array
 ) -> jax.Array:
-    """Gather + weighted sum; materializes [B, L, D], bf16 MXU contraction."""
+    """Gather + weighted sum; materializes [B, L, D] in HBM.
+
+    Precision is PINNED to HIGHEST: the jitted default already contracts
+    f32 inputs via 3-pass bf16 (f32-level accuracy, measured err 9e-8),
+    but the eager default and ``jax_default_matmul_precision='bfloat16'``
+    would silently drop to single-pass bf16 (~2 digits) — the public op
+    must not lose accuracy based on how it's called."""
     rows = table[ids]  # [B, L, D]
     return jnp.einsum(
         "bld,bl->bd",
         rows.astype(jnp.float32),
         weights.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
     )
+
+
+#: dispatch cutoff: intermediates up to this many MB take the (faster)
+#: XLA path; beyond it the kernel's O(1) scratch wins (a [B, L, D] gather
+#: several GB deep crowds HBM; past HBM size XLA cannot run at all)
+_PALLAS_OVER_MB_DEFAULT = 2048.0
+
+
+def _pallas_cutoff_bytes() -> float:
+    import os
+
+    return float(os.environ.get(
+        "PIO_TPU_EMBED_PALLAS_OVER_MB", _PALLAS_OVER_MB_DEFAULT
+    )) * 2 ** 20
 
 
 def _use_pallas(table) -> bool:
@@ -229,9 +253,15 @@ def embedding_bag(table, ids, weights):
     """``out[b] = Σ_l weights[b,l] · table[ids[b,l]]`` → float32 [B, D].
 
     ``ids`` int32 [B, L] (pad with any valid row + weight 0), ``weights``
-    [B, L]. Differentiable in ``table`` and ``weights``.
-    """
-    if _use_pallas(table):
+    [B, L]. Differentiable in ``table`` and ``weights``. Dispatch: XLA
+    while the gathered ``[B, L, D]`` intermediate fits comfortably (it
+    measured faster at equal accuracy — see module docstring), the
+    Pallas streaming kernel beyond that (O(1) scratch; shapes XLA OOMs
+    on)."""
+    B, L = ids.shape
+    D = table.shape[1]
+    intermediate = B * L * D * max(4, table.dtype.itemsize)
+    if _use_pallas(table) and intermediate > _pallas_cutoff_bytes():
         return _embedding_bag_pallas(table, ids, weights)
     return _embedding_bag_xla(table, ids, weights)
 

@@ -76,3 +76,31 @@ def test_duplicate_ids_accumulate():
     w = jnp.asarray([[1.0, 2.0, 3.0, 0, 0, 0, 0, 0]], jnp.float32)
     out = embedding_bag(table, ids, w)
     assert float(out[0, 2]) == pytest.approx(6.0)
+
+
+def test_embedding_bag_dispatch_by_intermediate_size(monkeypatch):
+    """Dispatch policy: XLA while the gathered [B, L, D] intermediate is
+    small (measured faster at equal accuracy on TPU), the Pallas
+    streaming kernel beyond the cutoff (O(1) scratch)."""
+    import pio_tpu.ops.embedding as emb
+
+    calls = []
+    monkeypatch.delenv("PIO_TPU_EMBED_PALLAS_OVER_MB", raising=False)
+    monkeypatch.setattr(emb, "_use_pallas", lambda t: True)
+    monkeypatch.setattr(
+        emb, "_embedding_bag_pallas",
+        lambda t, i, w: calls.append("pallas") or emb._embedding_bag_xla(
+            t, i, w
+        ),
+    )
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(size=(64, 128)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, 64, (4, 8)).astype(np.int32))
+    w = jnp.asarray(rng.random((4, 8)).astype(np.float32))
+    # 4*8*128*4 B = 16 KB — far under any sane cutoff → XLA
+    emb.embedding_bag.__wrapped__(table, ids, w)
+    assert calls == []
+    # force a 1-byte cutoff → kernel path
+    monkeypatch.setenv("PIO_TPU_EMBED_PALLAS_OVER_MB", "0.000001")
+    emb.embedding_bag.__wrapped__(table, ids, w)
+    assert calls == ["pallas"]
