@@ -82,6 +82,56 @@ class TestALS:
         # small fraction of the blocked-f32 bytes
         assert st_c["wire_bytes"] < st_b["wire_bytes"] / 3, (st_c, st_b)
 
+    def test_mesh_compact_planes_wire_with_high_plane(self, monkeypatch):
+        """Items ≥ 2^16 force the planes wire with a NON-EMPTY high
+        plane — that array rides the sharded put + slice path too and
+        must stay byte-identical to blocked."""
+        rng = np.random.default_rng(11)
+        n = 3000
+        u = rng.integers(0, 40, n).astype(np.int32)
+        i = rng.integers(0, 70_000, n).astype(np.int32)
+        r = (rng.integers(1, 11, n) * 0.5).astype(np.float32)
+        cfg = ALSConfig(rank=4, iterations=4, reg=0.05,
+                        blocks_per_chunk=16)
+        monkeypatch.setenv("PIO_TPU_ALS_MESH_WIRE", "blocked")
+        f_b = train_als(ComputeContext.create(), u, i, r, 40, 70_000, cfg)
+        monkeypatch.setenv("PIO_TPU_ALS_MESH_WIRE", "compact")
+        st = {}
+        f_c = train_als(ComputeContext.create(), u, i, r, 40, 70_000,
+                        cfg, stats=st)
+        assert st["encoding"].endswith("planes"), st
+        assert np.array_equal(f_b.user_factors, f_c.user_factors)
+        assert np.array_equal(f_b.item_factors, f_c.item_factors)
+
+    def test_mesh_compact_delta_overflow(self, monkeypatch):
+        """Within-user item gaps > 4095 exercise the sparse overflow
+        list on the mesh wire; factors must match blocked exactly."""
+        rng = np.random.default_rng(12)
+        n_users, n_items = 24, 60_000
+        us, its = [], []
+        for uu in range(n_users):
+            # a handful of items spread across the full range → most
+            # consecutive gaps exceed 4095
+            for ii in range(0, n_items, 7013):
+                us.append(uu)
+                its.append((ii + uu * 311) % n_items)
+        u = np.array(us, np.int32)
+        i = np.array(its, np.int32)
+        r = (rng.integers(1, 11, len(u)) * 0.5).astype(np.float32)
+        cfg = ALSConfig(rank=4, iterations=3, reg=0.05,
+                        blocks_per_chunk=16)
+        monkeypatch.setenv("PIO_TPU_ALS_ITEM_WIRE", "delta12")
+        monkeypatch.setenv("PIO_TPU_ALS_MESH_WIRE", "blocked")
+        f_b = train_als(ComputeContext.create(), u, i, r,
+                        n_users, n_items, cfg)
+        monkeypatch.setenv("PIO_TPU_ALS_MESH_WIRE", "compact")
+        st = {}
+        f_c = train_als(ComputeContext.create(), u, i, r,
+                        n_users, n_items, cfg, stats=st)
+        assert st["encoding"].endswith("delta12"), st
+        assert np.array_equal(f_b.user_factors, f_c.user_factors)
+        assert np.array_equal(f_b.item_factors, f_c.item_factors)
+
     def test_implicit_separates_observed(self, synthetic):
         s = synthetic
         f = train_als(

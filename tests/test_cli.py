@@ -191,3 +191,20 @@ class TestRunVerb:
         from pio_tpu.tools.cli import main
 
         assert main(["run", "userdata:VALUE"]) == 1
+
+
+def test_deploy_workers_flags_parse():
+    """`deploy --workers N --device-worker` must parse (the pool branch
+    of cmd_deploy keys off these; pool behavior itself is covered by
+    tests/test_worker_pool.py)."""
+    from pio_tpu.tools.cli import build_parser
+
+    p = build_parser()
+    args = p.parse_args(
+        ["deploy", "--workers", "4", "--device-worker", "--port", "8123"]
+    )
+    assert args.workers == 4 and args.device_worker is True
+    assert args.port == 8123
+    # default stays single-process
+    args = p.parse_args(["deploy"])
+    assert args.workers == 1 and args.device_worker is False
