@@ -8,9 +8,11 @@ SURVEY.md §2); this package holds the rebuild's own native pieces:
 - ``als_pack.cpp`` — parallel COO→blocked-CSR packer feeding the ALS
   trainer's coalesced device transfer (pio_tpu/models/als.py).
 
-Build model: no wheels, no pybind11 — ``g++ -O2 -shared -fPIC`` at first
-import, cached under ``$PIO_TPU_HOME/native/<source-sha>.so`` so rebuilds
-happen only when the source changes. ctypes loads the result. Environments
+Build model: no wheels, no pybind11 — ``g++ -O3 -march=native`` at first
+import, cached under ``$PIO_TPU_HOME/native/<src+flags sha>-<isa>.so`` so
+rebuilds happen when the source, flags, or host ISA change (a
+native-codegen binary never loads on a CPU missing its instructions).
+ctypes loads the result. Environments
 without a toolchain get :class:`NativeUnavailable` and callers fall back to
 pure-Python backends.
 """
@@ -42,19 +44,49 @@ def _build_dir() -> str:
     return d
 
 
+_FLAGS = ["-O3", "-march=native", "-shared", "-fPIC", "-std=c++17"]
+
+
+def _host_isa_tag() -> str:
+    """Short tag of this host's ISA feature set — part of the .so cache
+    key, so a ``-march=native`` binary built on one CPU (shared home,
+    baked image) is never loaded on a CPU missing its instructions
+    (SIGILL), it just rebuilds."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    return hashlib.sha256(line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    # no /proc/cpuinfo (macOS, sandbox): fall back to a platform string —
+    # coarser than the feature set, but never a shared constant that
+    # would let one host's -march=native binary load on another
+    import platform
+
+    return hashlib.sha256(
+        f"{platform.system()}-{platform.machine()}-"
+        f"{platform.processor()}".encode()
+    ).hexdigest()[:8]
+
+
 def build_library(name: str) -> str:
-    """Compile ``<name>.cpp`` (beside this file) → cached .so path."""
+    """Compile ``<name>.cpp`` (beside this file) → cached .so path.
+    Cache key = source hash + compile flags + host ISA tag."""
     src = os.path.join(os.path.dirname(__file__), f"{name}.cpp")
     with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    out = os.path.join(_build_dir(), f"{name}-{digest}.so")
+        digest = hashlib.sha256(
+            f.read() + " ".join(_FLAGS).encode()
+        ).hexdigest()[:16]
+    out = os.path.join(
+        _build_dir(), f"{name}-{digest}-{_host_isa_tag()}.so"
+    )
     if os.path.exists(out):
         return out
     tmp = f"{out}.{os.getpid()}.tmp"  # per-process: concurrent first builds
-    cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        "-o", tmp, src,
-    ]
+    # -O3 + -march=native: the packers and the host scorer are SIMD-bound
+    # inner loops; the ISA tag above keeps native codegen host-correct
+    cmd = ["g++", *_FLAGS, "-o", tmp, src]
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=120
@@ -156,4 +188,22 @@ def als_pack_lib():
         lib.als_rating_codes.argtypes = [f32p, ctypes.c_int64, u8p]
         lib.als_rating_codes.restype = ctypes.c_int64
         _cache["als_pack"] = lib
+        return lib
+
+
+def topn_host_lib():
+    """Load (building if needed) the host top-N scorer library; cached."""
+    with _lock:
+        if "topn_host" in _cache:
+            return _cache["topn_host"]
+        lib = ctypes.CDLL(build_library("topn_host"))
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.topn_host_f32.argtypes = [
+            f32p, f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            i32p, ctypes.c_int64, ctypes.c_int32, i64p, f32p,
+        ]
+        lib.topn_host_f32.restype = ctypes.c_int
+        _cache["topn_host"] = lib
         return lib

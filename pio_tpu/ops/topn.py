@@ -164,6 +164,7 @@ class DeviceTopNScorer:
         self._rows_np = rows
         self._cols_np = cols
         self._rows_dev = self._cols_dev = None
+        self._cols_t = None  # lazy transposed mirror (native host path)
 
         if self.n_rows == 0 or self.n_cols == 0:
             # degenerate factor tables cannot be probed (the host-row
@@ -211,6 +212,11 @@ class DeviceTopNScorer:
                     self.score_pairs(
                         np.zeros(1, np.int32), np.zeros(1, np.int32)
                     )
+        if warmup and self.min_device_batch > 1:
+            # small batches will route to the host mirror: pay the
+            # native-library g++ build and the transposed-table copy at
+            # DEPLOY time, not inside the first live request
+            self.top_n_batch(np.zeros(1, np.int32), 1)
 
     @property
     def on_device(self) -> bool:
@@ -263,8 +269,32 @@ class DeviceTopNScorer:
             val_out[lo:lo + m] = vals[:m]
         return idx_out[:, :n], val_out[:, :n]
 
+    #: native host scorer is a SINGLE-CORE fused loop targeting the
+    #: per-request serving path; larger batches keep the multithreaded
+    #: BLAS GEMM + argpartition (batch_predict on many-core hosts)
+    _NATIVE_HOST_MAX_BATCH = 8
+
     # ------------------------------------------------------------- host path
     def _top_n_host(self, codes, n, exclude):
+        if exclude is None and codes.shape[0] <= self._NATIVE_HOST_MAX_BATCH:
+            got = self._top_n_host_native(codes, n)
+            if got is not None:
+                return got
+        B = codes.shape[0]
+        # chunk rows so the [chunk, N] score + key planes stay ~100 MB
+        # regardless of batch size (batch_predict can send thousands)
+        chunk = max(1, (8 << 20) // max(1, self.n_cols))
+        idx_out = np.empty((B, n), np.int64)
+        val_out = np.empty((B, n), np.float32)
+        for lo in range(0, B, chunk):
+            hi = min(B, lo + chunk)
+            ex = exclude[lo:hi] if exclude is not None else None
+            idx_out[lo:hi], val_out[lo:hi] = self._top_n_host_chunk(
+                codes[lo:hi], n, ex
+            )
+        return idx_out, val_out
+
+    def _top_n_host_chunk(self, codes, n, exclude):
         scores = self._rows_np[codes] @ self._cols_np.T  # [B, N]
         if exclude is not None:
             b = np.arange(scores.shape[0])[:, None]
@@ -273,14 +303,71 @@ class DeviceTopNScorer:
                 np.broadcast_to(b, exclude.shape)[keep],
                 exclude[keep],
             ] = -np.inf
+        # composite u64 keys encode (-score, index): selection and order
+        # become DETERMINISTIC under score ties — the same (-score, idx)
+        # contract the native serving path implements, so predict and
+        # batch_predict agree on tied items (exactly, up to summation
+        # rounding differences between the two dot-product loops). NaN
+        # (diverged factors) maps to -inf in BOTH paths: ranks tied-last,
+        # surfaces as -inf. `+ 0.0` canonicalizes -0.0 to +0.0 so the
+        # bit transform ties them like the native float compare does.
+        scores += np.float32(0.0)
+        np.copyto(scores, -np.inf, where=np.isnan(scores))
+        bits = scores.view(np.uint32)
+        ordered = np.where(
+            (bits >> np.uint32(31)).astype(bool),
+            ~bits, bits | np.uint32(0x80000000),
+        )
+        keys = (
+            ((np.uint32(0xFFFFFFFF) - ordered).astype(np.uint64)
+             << np.uint64(32))
+            | np.arange(self.n_cols, dtype=np.uint64)[None, :]
+        )
         if n < self.n_cols:
-            part = np.argpartition(-scores, n - 1, axis=1)[:, :n]
+            part = np.argpartition(keys, n - 1, axis=1)[:, :n]
         else:
-            part = np.argsort(-scores, axis=1)
-        pv = np.take_along_axis(scores, part, axis=1)
-        order = np.argsort(-pv, axis=1)
-        idx = np.take_along_axis(part, order, axis=1)
-        return idx, np.take_along_axis(pv, order, axis=1)
+            part = np.argsort(keys, axis=1)
+        pk = np.take_along_axis(keys, part, axis=1)
+        order = np.argsort(pk, axis=1)
+        idx = np.take_along_axis(part, order, axis=1).astype(np.int64)
+        return idx, np.take_along_axis(scores, idx, axis=1)
+
+    def _top_n_host_native(self, codes, n):
+        """Fused native blocked scan-and-select (no [B, N] score array):
+        stride-1 FMA over a transposed [K, N] table in L1-sized blocks,
+        heap selection while each block is cache-hot. None → caller uses
+        the numpy path (library unavailable, or exclusions requested)."""
+        import ctypes
+
+        try:
+            from pio_tpu.native import topn_host_lib
+
+            lib = topn_host_lib()
+        except Exception:  # no toolchain → numpy fallback
+            self._top_n_host_native = lambda codes, n: None
+            return None
+        if self._cols_t is None:
+            # one-time transposed mirror (the kernel's layout); built
+            # lazily so scorers that never take the host path skip it
+            self._cols_t = np.ascontiguousarray(self._cols_np.T)
+        B = codes.shape[0]
+        out_idx = np.empty((B, n), np.int64)
+        out_val = np.empty((B, n), np.float32)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        rc = lib.topn_host_f32(
+            self._rows_np.ctypes.data_as(f32p),
+            self._cols_t.ctypes.data_as(f32p),
+            self.n_rows, self.n_cols, self.rank,
+            np.ascontiguousarray(codes).ctypes.data_as(i32p),
+            B, n,
+            out_idx.ctypes.data_as(i64p),
+            out_val.ctypes.data_as(f32p),
+        )
+        if rc != 0:
+            return None  # out-of-range code: numpy path raises the error
+        return out_idx, out_val
 
     # -------------------------------------------------------------- public
     def top_n_batch(

@@ -272,3 +272,68 @@ def test_prepare_for_serving_attaches_scorer():
     ep = EngineParams(algorithm_params_list=(("als", None),))
     pairs = engine.algorithms_with_models(ep, [model])
     assert "_scorer" in pairs[0][1].__dict__
+
+
+class TestNativeHostScorer:
+    """Fused native scan-and-select vs the numpy reference path."""
+
+    @pytest.fixture(autouse=True)
+    def _require_native(self):
+        # without the toolchain both paths would be numpy — a parity
+        # test against itself proves nothing
+        from pio_tpu.native import NativeUnavailable, topn_host_lib
+
+        try:
+            topn_host_lib()
+        except NativeUnavailable:
+            pytest.skip("no C++ toolchain: native scorer not buildable")
+
+    def test_parity_with_numpy_path(self):
+        rng = np.random.default_rng(7)
+        rows = rng.normal(size=(300, 8)).astype(np.float32)
+        cols = rng.normal(size=(500, 8)).astype(np.float32)
+        s = DeviceTopNScorer(rows, cols, prefer_device=False)
+        codes = rng.integers(0, 300, 8).astype(np.int32)
+        for n in (1, 5, 10, 500):  # incl. n == n_cols (full sort)
+            i_nat, v_nat = s.top_n_batch(codes, n)
+            native = s._top_n_host_native
+            s._top_n_host_native = lambda c, k: None
+            try:
+                i_np, v_np = s.top_n_batch(codes, n)
+            finally:
+                s._top_n_host_native = native
+            assert np.array_equal(i_nat, i_np), n
+            assert np.allclose(v_nat, v_np), n
+
+    def test_nan_scores_do_not_crash(self):
+        """NaN factors (diverged model) must rank last, not crash the
+        comparator (strict-weak-ordering UB in std::sort)."""
+        rng = np.random.default_rng(10)
+        rows = np.ones((4, 4), np.float32)
+        cols = rng.normal(size=(200, 4)).astype(np.float32)
+        cols[::3] = np.nan  # third of the table poisoned
+        s = DeviceTopNScorer(rows, cols, prefer_device=False)
+        idx, vals = s.top_n_batch(np.array([0], np.int32), 10)
+        assert np.isfinite(vals).all()  # NaN rows never outrank real ones
+        assert not (set(idx.flat) & set(range(0, 200, 3)))
+
+    def test_exclusions_use_numpy_path(self):
+        """The native kernel doesn't handle exclusions — masked queries
+        must still produce masked results (numpy path)."""
+        rng = np.random.default_rng(8)
+        rows = rng.normal(size=(20, 4)).astype(np.float32)
+        cols = rng.normal(size=(30, 4)).astype(np.float32)
+        s = DeviceTopNScorer(rows, cols, prefer_device=False)
+        codes = np.arange(3, dtype=np.int32)
+        excl = np.tile(np.array([[0, 1, 2]], np.int32), (3, 1))
+        idx, _ = s.top_n_batch(codes, 5, exclude=excl)
+        assert not (set(idx.flat) & {0, 1, 2})
+
+    def test_tiny_table_smaller_than_topn(self):
+        rng = np.random.default_rng(9)
+        rows = rng.normal(size=(4, 4)).astype(np.float32)
+        cols = rng.normal(size=(3, 4)).astype(np.float32)
+        s = DeviceTopNScorer(rows, cols, prefer_device=False)
+        idx, vals = s.top_n_batch(np.array([1], np.int32), 10)
+        assert idx.shape == (1, 3)  # clamped to n_cols
+        assert sorted(idx[0].tolist()) == [0, 1, 2]
