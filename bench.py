@@ -901,29 +901,15 @@ def _bench_event_ingest(scale: float) -> dict:
                 import concurrent.futures
 
                 def conc_worker(t):
-                    c = http.client.HTTPConnection(
-                        "127.0.0.1", server.port, timeout=30
-                    )
+                    client = _KeepAliveClient(server.port)
                     try:
                         for n in range(n_single // 4):
-                            body = json.dumps(
-                                ev(100_000 + t * 10_000 + n)
-                            ).encode()
-                            c.request(
-                                "POST", f"/events.json?accessKey={key}",
-                                body=body,
-                                headers={
-                                    "Content-Type": "application/json"
-                                },
+                            client(
+                                ev(100_000 + t * 10_000 + n),
+                                path=f"/events.json?accessKey={key}",
                             )
-                            resp = c.getresponse()
-                            resp.read()
-                            if resp.status >= 400:
-                                raise RuntimeError(
-                                    f"concurrent ingest: {resp.status}"
-                                )
                     finally:
-                        c.close()
+                        client.close()
 
                 t0 = time.perf_counter()
                 with concurrent.futures.ThreadPoolExecutor(8) as ex:

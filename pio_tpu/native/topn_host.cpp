@@ -59,7 +59,9 @@ int topn_host_f32(const float* rows, const float* cols_t, int32_t n_rows,
     float thresh = 0.0f;  // valid once filled == topn
     for (int32_t j0 = 0; j0 < n_cols; j0 += BLOCK) {
       const int32_t w = std::min(BLOCK, n_cols - j0);
-      {
+      if (k_rank == 0) {  // degenerate rank: every dot product is 0
+        for (int32_t j = 0; j < w; ++j) blk[j] = 0.0f;
+      } else {
         const float* c0 = cols_t + j0;
         const float q0 = qv[0];
         for (int32_t j = 0; j < w; ++j) blk[j] = q0 * c0[j];

@@ -29,6 +29,7 @@ sliced off on the way out; excluded item slots use the sentinel index
 
 from __future__ import annotations
 
+import ctypes
 import functools
 import os
 import time
@@ -38,6 +39,12 @@ import numpy as np
 
 #: largest per-dispatch batch bucket; bigger batches loop in chunks of this
 _MAX_BATCH_BUCKET = 512
+
+#: ctypes pointer types for the native host scorer (hoisted off the
+#: per-request path)
+_F32P = ctypes.POINTER(ctypes.c_float)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_I64P = ctypes.POINTER(ctypes.c_int64)
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -337,8 +344,6 @@ class DeviceTopNScorer:
         stride-1 FMA over a transposed [K, N] table in L1-sized blocks,
         heap selection while each block is cache-hot. None → caller uses
         the numpy path (library unavailable, or exclusions requested)."""
-        import ctypes
-
         try:
             from pio_tpu.native import topn_host_lib
 
@@ -353,17 +358,14 @@ class DeviceTopNScorer:
         B = codes.shape[0]
         out_idx = np.empty((B, n), np.int64)
         out_val = np.empty((B, n), np.float32)
-        f32p = ctypes.POINTER(ctypes.c_float)
-        i32p = ctypes.POINTER(ctypes.c_int32)
-        i64p = ctypes.POINTER(ctypes.c_int64)
         rc = lib.topn_host_f32(
-            self._rows_np.ctypes.data_as(f32p),
-            self._cols_t.ctypes.data_as(f32p),
+            self._rows_np.ctypes.data_as(_F32P),
+            self._cols_t.ctypes.data_as(_F32P),
             self.n_rows, self.n_cols, self.rank,
-            np.ascontiguousarray(codes).ctypes.data_as(i32p),
+            np.ascontiguousarray(codes).ctypes.data_as(_I32P),
             B, n,
-            out_idx.ctypes.data_as(i64p),
-            out_val.ctypes.data_as(f32p),
+            out_idx.ctypes.data_as(_I64P),
+            out_val.ctypes.data_as(_F32P),
         )
         if rc != 0:
             return None  # out-of-range code: numpy path raises the error

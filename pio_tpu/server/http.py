@@ -189,9 +189,7 @@ def _make_handler_class(
                 while not self.close_connection:
                     if not self._handle_one():
                         break
-            except (ConnectionError, TimeoutError):
-                pass
-            except OSError:
+            except OSError:  # covers ConnectionError and TimeoutError
                 pass
 
         # -- response writing ------------------------------------------

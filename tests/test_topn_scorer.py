@@ -329,6 +329,16 @@ class TestNativeHostScorer:
         idx, _ = s.top_n_batch(codes, 5, exclude=excl)
         assert not (set(idx.flat) & {0, 1, 2})
 
+    def test_rank_zero_degenerate(self):
+        """Rank-0 factor tables (0 == 0 passes the mismatch check) must
+        score everything 0 and rank by index — no out-of-bounds read."""
+        rows = np.empty((3, 0), np.float32)
+        cols = np.empty((5, 0), np.float32)
+        s = DeviceTopNScorer(rows, cols, prefer_device=False)
+        idx, vals = s.top_n_batch(np.array([0, 2], np.int32), 3)
+        assert np.array_equal(idx, [[0, 1, 2], [0, 1, 2]])
+        assert np.all(vals == 0.0)
+
     def test_tiny_table_smaller_than_topn(self):
         rng = np.random.default_rng(9)
         rows = rng.normal(size=(4, 4)).astype(np.float32)
