@@ -90,7 +90,15 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt) -> None:
     server.start()
     log.info("pool worker %d serving on :%d", idx, server.port)
     try:
-        shutdown_evt.wait()
+        # POLL the event — never park in Event.wait(): a worker killed
+        # while registered as a sleeper on the condition (SIGTERM/OOM,
+        # i.e. exactly the crashes the supervisor exists to absorb)
+        # corrupts the sleeper count, after which every set()/is_set()
+        # on the SHARED event blocks forever and /undeploy can no longer
+        # stop the pool. is_set() holds the internal lock only for
+        # microseconds, shrinking the corruption window to ~nothing.
+        while not shutdown_evt.is_set():
+            time.sleep(0.25)
     except KeyboardInterrupt:
         pass
     server.stop()
@@ -215,7 +223,10 @@ class ServingPool:
             ) and all(r >= _MAX_RESPAWNS for r in self._respawns):
                 log.error("all workers dead and out of respawn budget")
                 break
-            self._shutdown.wait(poll_s)
+            # plain sleep, not Event.wait(): nobody ever registers as a
+            # sleeper on the shared event, so a killed process can never
+            # corrupt it (see the matching note in _worker_main)
+            time.sleep(poll_s)
         self.stop()
 
     def stop(self, join_timeout: float = 5.0) -> None:

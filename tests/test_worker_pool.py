@@ -143,6 +143,32 @@ class TestServingPool:
             seen.add(st["engineInstanceId"])
         assert seen == {new_id}, seen
 
+    def test_supervisor_respawns_crashed_worker(self, pool):
+        """A worker killed out-of-band comes back under supervision and
+        serves again; /undeploy then stops supervision and every worker."""
+        import threading
+
+        sup = threading.Thread(target=pool.wait, daemon=True)
+        sup.start()
+        victim = pool._procs[0]
+        victim.terminate()
+        victim.join(10)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if pool._procs[0] is not victim and pool._procs[0].is_alive():
+                break
+            time.sleep(0.2)
+        assert pool._procs[0] is not victim, "worker never respawned"
+        assert pool._respawns[0] == 1
+        # the pool still answers (either worker may take the connection)
+        status, got = _post(pool.port, "/queries.json",
+                            {"user": "u1", "num": 2})
+        assert status == 200 and len(got["itemScores"]) == 2
+        _post(pool.port, "/undeploy", {})
+        sup.join(30)
+        assert not sup.is_alive()
+        assert all(not p.is_alive() for p in pool._procs)
+
     def test_undeploy_stops_whole_pool(self, pool):
         status, out = _post(pool.port, "/undeploy", {})
         assert status == 200
