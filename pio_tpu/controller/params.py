@@ -12,6 +12,7 @@ missing required key is an error naming the field.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import re
 import typing
@@ -19,6 +20,14 @@ from typing import Any, Mapping, Optional, Type, TypeVar
 
 #: camelCase → snake_case boundary (see params_from_dict wire parity)
 _SNAKE_RE = re.compile(r"(?<=[a-z0-9])([A-Z])")
+
+
+@functools.lru_cache(maxsize=None)
+def _hints_of(cls: type) -> Mapping[str, Any]:
+    """Per-class cache of ``get_type_hints`` — it re-evaluates forward
+    references (compile() per annotation) on every call, and query
+    binding runs once per serving request."""
+    return typing.get_type_hints(cls)
 
 P = TypeVar("P", bound="Params")
 
@@ -74,7 +83,7 @@ def params_from_dict(cls: Type[P], d: Optional[Mapping[str, Any]]) -> P:
     d = dict(d or {})
     if not dataclasses.is_dataclass(cls):
         raise ParamsError(f"{cls.__name__} must be a dataclass")
-    hints = typing.get_type_hints(cls)
+    hints = _hints_of(cls)
     fields = {f.name: f for f in dataclasses.fields(cls)}
     # reference wire parity: queries and engine.json use camelCase keys
     # ("whiteList", "numIterations"); fields here are snake_case. Accept
