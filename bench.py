@@ -36,10 +36,15 @@ plus ``als_rank_sweep`` (rank 16/64/128 MXU scaling) and
 ``eventserver_events_per_sec`` (HTTP ingest into sqlite + native
 eventlog backends).
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-     "p50_predict_ms": N, "p50_inproc_ms": N, "phases": {...},
+Output contract (round 5 — the driver records only the LAST 2000 chars
+of stdout, and round 4's single fat JSON line was truncated FRONT-first,
+losing the headline; see VERDICT r4 weak #1): the full detail blob
+    {"metric": ..., "value": N, ..., "phases": {...},
      "serving": {...}, "secondary": {...}}
+is written to ``BENCH_FULL.json`` next to this file, and stdout carries
+exactly ONE compact summary line (≤1900 chars, built by
+``build_summary``) with the headline value/vs_baseline, link probe,
+device rate, pack_s, p50s, concurrent/pool QPS and per-config ratios.
 
 Env knobs (for smoke runs): PIO_TPU_BENCH_EDGES, PIO_TPU_BENCH_ITERS,
 PIO_TPU_BENCH_RANK, PIO_TPU_BENCH_CPU_EDGES, PIO_TPU_BENCH_QUERIES,
@@ -944,6 +949,155 @@ def _bench_event_ingest(scale: float) -> dict:
     return out
 
 
+#: hard budget for the final stdout line — the driver records only the
+#: LAST 2000 characters of output, so the printed summary (plus newline)
+#: must always fit; the full result goes to BENCH_FULL.json instead
+SUMMARY_CHAR_BUDGET = 1900
+
+
+def build_summary(full: dict, full_path: str = "BENCH_FULL.json") -> dict:
+    """Compact, tail-window-safe summary of a full bench result.
+
+    The round-4 artifact of record was lost because the single JSON line
+    outgrew the driver's 2000-char tail window and the FRONT of the line
+    (the headline) was truncated away. The contract now: the full detail
+    blob is written to ``BENCH_FULL.json`` and stdout carries ONLY this
+    summary — headline value/vs_baseline, link probe, device-phase rate,
+    pack_s, serving p50s + concurrent/pool QPS, and per-config
+    vs_baseline ratios — small enough that the whole line always
+    survives the tail window.
+    """
+
+    def get(*path, default=None):
+        node = full
+        for key in path:
+            if not isinstance(node, dict) or key not in node:
+                return default
+            node = node[key]
+        return node
+
+    s = {
+        "metric": full.get("metric"),
+        "value": full.get("value"),
+        "unit": full.get("unit"),
+        "vs_baseline": full.get("vs_baseline"),
+        "value_best_of_5": full.get("value_best_of_5"),
+        "link_mb_s": full.get("link_mb_s"),
+        "device_examples_per_sec": full.get("device_examples_per_sec"),
+        "pack_s": get("phases", "pack_s"),
+        "p50_predict_ms": full.get("p50_predict_ms"),
+        "p50_inproc_ms": full.get("p50_inproc_ms"),
+        "serving_qps": get("serving", "concurrent", "qps"),
+        "serving_mb_qps": get("serving", "concurrent_microbatch", "qps"),
+        "serving_mb_mode": get("serving", "concurrent_microbatch", "mode"),
+        "pool_qps": get("serving", "pool", "qps"),
+        "pool_workers": get("serving", "pool", "workers"),
+        "host_cores": get("serving", "pool", "host_cores"),
+    }
+    sec = full.get("secondary") or {}
+    configs: dict = {}
+    for short, key in (
+        ("classification", "classification_examples_per_sec"),
+        ("similarproduct", "similarproduct_examples_per_sec"),
+        ("twotower", "twotower_examples_per_sec"),
+    ):
+        entry = sec.get(key)
+        if isinstance(entry, dict):
+            c = {"v": entry.get("value"), "x": entry.get("vs_baseline")}
+            if "achieved_gflops" in entry:
+                c["gflops"] = entry["achieved_gflops"]
+            if "anchor_note" in entry:
+                c["anchor"] = entry["anchor_note"]
+            configs[short] = c
+    if isinstance(sec.get("seqrec"), dict):
+        sq = sec["seqrec"]
+        configs["seqrec"] = {
+            "tokens_s": sq.get("tokens_per_sec"),
+            "gflops": sq.get("achieved_gflops"),
+        }
+    if isinstance(sec.get("textclassification"), dict):
+        tc = sec["textclassification"]
+        configs["textclass"] = {
+            "tokens_s": max(
+                tc.get("pallas_tokens_per_sec") or 0.0,
+                tc.get("xla_tokens_per_sec") or 0.0,
+            ) or None,
+            "x": tc.get("vs_baseline"),
+        }
+    ing = sec.get("eventserver_events_per_sec")
+    if isinstance(ing, dict):
+        flat = {}
+        for backend, row in ing.items():
+            if isinstance(row, dict):
+                flat[f"{backend}_single"] = row.get("single_events_per_sec")
+                flat[f"{backend}_batch"] = row.get("batch_events_per_sec")
+        if flat:
+            configs["ingest"] = flat
+    if configs:
+        s["configs"] = configs
+    s["full"] = os.path.basename(full_path)
+    # belt and braces: if the summary somehow outgrows the budget, shed
+    # down to the driver-required core rather than risk truncation again
+    if len(json.dumps(s)) > SUMMARY_CHAR_BUDGET:
+        s = {k: s.get(k) for k in
+             ("metric", "value", "unit", "vs_baseline", "full")}
+    return s
+
+
+#: workload env knobs and their full-scale defaults — a knob set to a
+#: NON-default value marks a SMOKE run, whose artifact must not clobber
+#: the committed artifact of record (explicitly exporting a default is
+#: still a full run)
+_FULL_SCALE_DEFAULTS = {
+    "PIO_TPU_BENCH_EDGES": "25000000",
+    "PIO_TPU_BENCH_ITERS": "10",
+    "PIO_TPU_BENCH_RANK": "16",
+    "PIO_TPU_BENCH_CPU_EDGES": "2000000",
+    "PIO_TPU_BENCH_QUERIES": "200",
+    "PIO_TPU_BENCH_SECONDARY": "1",
+    "PIO_TPU_BENCH_SCALE": "1",
+    "PIO_TPU_BENCH_RANKSWEEP": "1",
+    "PIO_TPU_BENCH_DEADLINE_S": "3000",
+}
+
+
+def _is_smoke_run() -> bool:
+    for k, default in _FULL_SCALE_DEFAULTS.items():
+        v = os.environ.get(k)
+        if v is None:
+            continue
+        try:
+            if float(v) != float(default):
+                return True
+        except ValueError:
+            return True  # unparseable knob: refuse to claim full scale
+    return False
+
+
+def emit(full: dict, path: str | None = None,
+         base_dir: str | None = None) -> str:
+    """Write ``full`` to its JSON file and return the summary line (the
+    ONLY thing main prints to stdout, as its last act). Full-scale runs
+    write BENCH_FULL.json (the committed artifact of record); runs with
+    any workload-shrinking env knob write the gitignored
+    bench_full_smoke.json instead."""
+    if path is None:
+        if base_dir is None:
+            base_dir = os.path.dirname(os.path.abspath(__file__))
+        name = ("bench_full_smoke.json" if _is_smoke_run()
+                else "BENCH_FULL.json")
+        path = os.path.join(base_dir, name)
+    # atomic replace: a mid-serialization failure (e.g. a stage leaking
+    # a non-JSON type) must not destroy the previous artifact of record
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(full, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(f"# full result written to {path}", file=sys.stderr)
+    return json.dumps(build_summary(full, full_path=path))
+
+
 def main() -> None:
     # isolate the serving benchmark's storage in a throwaway home (must be
     # set before the first Storage touch; always overridden — bench junk
@@ -1185,7 +1339,7 @@ def main() -> None:
         "serving": serving,
         "secondary": secondary,
     }
-    print(json.dumps(out))
+    print(emit(out))
 
 
 if __name__ == "__main__":
