@@ -1090,10 +1090,14 @@ def emit(full: dict, path: str | None = None,
     # atomic replace: a mid-serialization failure (e.g. a stage leaking
     # a non-JSON type) must not destroy the previous artifact of record
     tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(full, f, indent=1)
-        f.write("\n")
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "w") as f:
+            json.dump(full, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # failed mid-write: no .tmp litter
+            os.unlink(tmp)
     print(f"# full result written to {path}", file=sys.stderr)
     return json.dumps(build_summary(full, full_path=path))
 

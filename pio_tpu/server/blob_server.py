@@ -120,4 +120,8 @@ def create_blob_server(
         # reject bad keys BEFORE the body is spooled off the socket —
         # an unauthenticated PUT must not burn disk up to the body limit
         pre_body=service._auth,
+        # the blob daemon is the ONE server allowed multi-GB
+        # octet-stream bodies (pre-body-authenticated); every other
+        # server keeps the tight structured-body cap for raw uploads too
+        large_uploads=True,
     )

@@ -128,7 +128,11 @@ class EventServerService:
     def __init__(self):
         self.stats = _Stats()
         self._auth_cache: dict = {}
+        self._auth_gen = 0  # bumped by invalidation; fences re-caching
         self._auth_cache_lock = threading.Lock()
+        # a Storage.reset() within AUTH_CACHE_TTL_S must not keep serving
+        # AccessKey records from the store that was just dropped
+        Storage.add_reset_hook(self.invalidate_auth_cache)
         self.router = Router()
         r = self.router
         r.add("GET", "/", self.alive)
@@ -145,6 +149,17 @@ class EventServerService:
         r.add("GET", "/plugins\\.json", self.list_plugins)
 
     # -- auth ---------------------------------------------------------------
+    def invalidate_auth_cache(self) -> None:
+        """Drop cached positive key lookups (called on Storage.reset and
+        available to key-mutation paths; the TTL still bounds staleness
+        for out-of-process mutations). The generation bump fences an
+        in-flight ``_auth`` that already read from the OLD store: its
+        insert is discarded rather than repopulating the cache with a
+        record from a store that no longer exists."""
+        with self._auth_cache_lock:
+            self._auth_gen += 1
+            self._auth_cache.clear()
+
     def _auth(self, req: Request) -> Tuple[int, Optional[int], tuple]:
         """accessKey+channel → (app_id, channel_id, event_whitelist)."""
         key = req.bearer_key()
@@ -153,6 +168,7 @@ class EventServerService:
         now = time.monotonic()
         with self._auth_cache_lock:
             hit = self._auth_cache.get(key)
+            gen = self._auth_gen
         ak = hit[1] if hit is not None and hit[0] > now else None
         if ak is None:
             ak = Storage.get_meta_data_access_keys().get(key)
@@ -160,9 +176,10 @@ class EventServerService:
                 with self._auth_cache_lock:
                     if len(self._auth_cache) > 4096:
                         self._auth_cache.clear()  # crude bound; refills
-                    self._auth_cache[key] = (
-                        now + self.AUTH_CACHE_TTL_S, ak
-                    )
+                    if self._auth_gen == gen:  # no invalidation raced us
+                        self._auth_cache[key] = (
+                            now + self.AUTH_CACHE_TTL_S, ak
+                        )
         if ak is None:
             raise HTTPError(401, "invalid accessKey")
         channel_id = None

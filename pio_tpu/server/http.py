@@ -164,6 +164,7 @@ def _make_handler_class(
     router: Router,
     server_name: str,
     pre_body: Optional[Callable[[Request], None]] = None,
+    large_uploads: bool = False,
 ):
     """Per-connection handler with a hand-rolled HTTP/1.1 parser.
 
@@ -182,6 +183,7 @@ def _make_handler_class(
         disable_nagle_algorithm = True
 
         command = ""  # current request method (HEAD gates body writes)
+        http10 = False  # current request is HTTP/1.0 (keep-alive echo)
 
         def handle(self):
             self.close_connection = False
@@ -205,6 +207,11 @@ def _make_handler_class(
                 head += f"{k}: {v}\r\n"
             if self.close_connection:
                 head += "Connection: close\r\n"
+            elif self.http10:
+                # an HTTP/1.0 client assumes close unless keep-alive is
+                # echoed back — without this it would never reuse the
+                # connection while we block in readline waiting for it
+                head += "Connection: keep-alive\r\n"
             return (head + "\r\n").encode("latin-1")
 
         def _respond(self, status: int, body: Any):
@@ -321,7 +328,8 @@ def _make_handler_class(
 
             self.command = method
             conn_tok = headers.get("connection", "").lower()
-            if version == b"HTTP/1.0":
+            self.http10 = version == b"HTTP/1.0"
+            if self.http10:
                 self.close_connection = "keep-alive" not in conn_tok
             else:
                 self.close_connection = "close" in conn_tok
@@ -357,11 +365,16 @@ def _make_handler_class(
                 return
             ctype = headers.get("content-type", "").lower()
             octet = ctype.startswith("application/octet-stream")
-            if length and not octet \
+            if length and (not octet or not large_uploads) \
                     and length > MAX_JSON_BODY_MB * 2 ** 20:
                 # structured bodies are parsed in RAM — cap them far
                 # below the raw-upload limit (a big Content-Length with
-                # a JSON Content-Type must not buffer gigabytes)
+                # a JSON Content-Type must not buffer gigabytes). The
+                # same cap covers octet-stream bodies unless the server
+                # opted into large uploads (only the blob server, whose
+                # pre_body auth runs before any body byte is consumed):
+                # otherwise each connection could spool MAX_BODY_MB of
+                # unauthenticated bytes to disk
                 self._reject(
                     413,
                     f"body exceeds {MAX_JSON_BODY_MB:g} MiB limit "
@@ -381,6 +394,12 @@ def _make_handler_class(
                     ))
                 except HTTPError as e:
                     self._reject(e.status, e.message)  # body unread
+                    return
+                except Exception:
+                    # a pre_body bug must produce an HTTP response, not
+                    # a dropped connection + raw socketserver traceback
+                    log.exception("pre_body hook failed")
+                    self._reject(500, "internal server error")
                     return
             if length and headers.get(
                 "expect", ""
@@ -543,9 +562,11 @@ class JsonHTTPServer:
                  name: str = "pio-tpu",
                  ssl_context: Any = SSL_FROM_ENV,
                  pre_body: Optional[Callable[[Request], None]] = None,
-                 reuse_port: bool = False):
+                 reuse_port: bool = False,
+                 large_uploads: bool = False):
         self._httpd = _TLSThreadingHTTPServer(
-            (host, port), _make_handler_class(router, name, pre_body),
+            (host, port),
+            _make_handler_class(router, name, pre_body, large_uploads),
             bind_and_activate=False,
         )
         self._httpd.reuse_port = reuse_port

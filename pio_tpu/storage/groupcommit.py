@@ -39,6 +39,19 @@ class PartialFlushOutcome(Exception):
         self.outcomes = outcomes
 
 
+class FlushProtocolError(RuntimeError):
+    """A flush (or PartialFlushOutcome) returned a different number of
+    outcomes than payloads. The committer cannot tell which payloads
+    landed — zip would silently mark the tail done with result=None
+    (success with nothing written), and a blind solo retry could
+    duplicate the ones that did land — so the whole batch fails."""
+
+    def __init__(self, got: int, expected: int):
+        super().__init__(
+            f"flush returned {got} outcomes for {expected} payloads"
+        )
+
+
 class _Item:
     __slots__ = ("payload", "done", "result", "exc")
 
@@ -85,15 +98,33 @@ class GroupCommitter:
                     batch = self._q
                     self._q = []
                 try:
-                    results = self._flush([i.payload for i in batch])
+                    # list() BEFORE the length check: a generator return
+                    # would raise TypeError on len() after the flush
+                    # already committed, and the generic handler's solo
+                    # retry would then duplicate every payload
+                    results = list(
+                        self._flush([i.payload for i in batch])
+                    )
+                    if len(results) != len(batch):
+                        raise FlushProtocolError(len(results), len(batch))
                     for i, r in zip(batch, results):
                         i.result = r
+                except FlushProtocolError as proto:
+                    for i in batch:
+                        i.exc = proto
                 except PartialFlushOutcome as partial:
-                    for i, outcome in zip(batch, partial.outcomes):
-                        if isinstance(outcome, Exception):
-                            i.exc = outcome
-                        else:
-                            i.result = outcome
+                    if len(partial.outcomes) != len(batch):
+                        proto = FlushProtocolError(
+                            len(partial.outcomes), len(batch)
+                        )
+                        for i in batch:
+                            i.exc = proto
+                    else:
+                        for i, outcome in zip(batch, partial.outcomes):
+                            if isinstance(outcome, Exception):
+                                i.exc = outcome
+                            else:
+                                i.result = outcome
                 except Exception:
                     for i in batch:  # isolate the poisoned payload
                         try:

@@ -114,6 +114,23 @@ class Storage:
     _lock = threading.RLock()
     _clients: Dict[str, object] = {}
     _mem: Dict[str, object] = {}
+    _reset_hooks: list = []  # weakref-wrapped callables
+
+    @classmethod
+    def add_reset_hook(cls, hook) -> None:
+        """Register a callable invoked by :meth:`reset` — for caches
+        OUTSIDE the registry that hold records read through it (e.g. a
+        server's positive access-key cache, which must not keep
+        authenticating keys from a store that was just reset). Bound
+        methods are held weakly so registering never pins a server."""
+        import weakref
+
+        try:
+            ref = weakref.WeakMethod(hook)
+        except TypeError:  # plain function/lambda: hold directly
+            ref = (lambda h=hook: h)
+        with cls._lock:
+            cls._reset_hooks.append(ref)
 
     # -- internal -----------------------------------------------------------
     @classmethod
@@ -149,7 +166,20 @@ class Storage:
         with cls._lock:
             cls._clients.clear()
             cls._mem.clear()
+            hooks = list(cls._reset_hooks)
         _homes_made.clear()  # re-create homes on next touch
+        dead = []
+        for ref in hooks:  # outside the lock: hooks take their own locks
+            hook = ref()
+            if hook is None:
+                dead.append(ref)
+            else:
+                hook()
+        if dead:
+            with cls._lock:
+                cls._reset_hooks = [
+                    r for r in cls._reset_hooks if r not in dead
+                ]
 
     # -- metadata stores ----------------------------------------------------
     @classmethod

@@ -194,6 +194,7 @@ def test_emit_failure_preserves_previous_artifact(bench, tmp_path):
     with pytest.raises(TypeError):
         bench.emit(bad, path=path)
     assert open(path).read() == before
+    assert not (tmp_path / "BENCH_FULL.json.tmp").exists()  # no litter
 
 
 def test_summary_sheds_to_core_when_over_budget(bench):

@@ -158,6 +158,54 @@ def test_partial_flush_outcomes_not_retried():
     assert len(calls) == 2, calls
 
 
+def test_short_flush_results_fail_loudly():
+    """A flush returning fewer results than payloads is a protocol
+    violation: zip would silently mark the tail done with result=None
+    (success with nothing written). Every submitter must get an error —
+    and NO solo retry, since we can't tell which payloads landed."""
+    from pio_tpu.storage.groupcommit import FlushProtocolError
+
+    calls = []
+
+    def flush(ps):
+        calls.append(list(ps))
+        return list(ps)[:-1]  # drops the last result
+
+    gc = GroupCommitter(flush)
+    with pytest.raises(FlushProtocolError):
+        gc.submit("a")
+    assert len(calls) == 1, calls  # no blind retry
+
+
+def test_generator_flush_results_accepted():
+    """A flush returning a lazy iterable is legal — the length guard
+    must materialize it rather than raise TypeError on len() (which the
+    generic handler would solo-retry, DUPLICATING the landed batch)."""
+    calls = []
+
+    def flush(ps):
+        calls.append(list(ps))
+        return (p for p in ps)
+
+    gc = GroupCommitter(flush)
+    assert gc.submit("a") == "a"
+    assert calls == [["a"]]  # exactly one flush, no retry
+
+
+def test_short_partial_outcomes_fail_loudly():
+    from pio_tpu.storage.groupcommit import (
+        FlushProtocolError,
+        PartialFlushOutcome,
+    )
+
+    def flush(ps):
+        raise PartialFlushOutcome([])  # fewer outcomes than payloads
+
+    gc = GroupCommitter(flush)
+    with pytest.raises(FlushProtocolError):
+        gc.submit("a")
+
+
 @pytest.mark.parametrize("backend", ["sqlite", "eventlog"])
 def test_concurrent_single_inserts_land(tmp_home, monkeypatch, backend):
     """16 threads hammering the single-insert path: every event lands,

@@ -97,6 +97,40 @@ class TestEventServer:
         )
         assert status == 201
 
+    def test_storage_reset_invalidates_auth_cache(self, eventserver,
+                                                  app_and_key):
+        """A reset within AUTH_CACHE_TTL_S must not keep serving cached
+        AccessKey records from the store that was just dropped."""
+        _, key = app_and_key
+        url = f"{eventserver}/events.json?accessKey={key}"
+        assert http("POST", url, EV)[0] == 201  # primes the auth cache
+        Storage.reset()  # key store gone; cached positive auth must go too
+        assert http("POST", url, EV)[0] == 401
+
+    def test_auth_cache_generation_fences_stale_insert(self, app_and_key,
+                                                       monkeypatch):
+        """An invalidation landing BETWEEN the store lookup and the
+        cache insert must win: the in-flight _auth's record came from
+        the old store and must not repopulate the cache."""
+        from pio_tpu.server.event_server import EventServerService
+        from pio_tpu.server.http import Request
+
+        _, key = app_and_key
+        service = EventServerService()
+        store = Storage.get_meta_data_access_keys()
+        orig_get = store.get
+
+        def racy_get(k):
+            ak = orig_get(k)
+            service.invalidate_auth_cache()  # reset races the lookup
+            return ak
+
+        monkeypatch.setattr(store, "get", racy_get)
+        req = Request(method="POST", path="/events.json",
+                      params={"accessKey": key}, body=None)
+        service._auth(req)  # authenticates against the pre-reset store
+        assert service._auth_cache == {}  # ...but must NOT re-cache
+
     def test_event_whitelist(self, eventserver, app_and_key):
         app_id, _ = app_and_key
         limited = Storage.get_meta_data_access_keys().insert(
@@ -656,6 +690,91 @@ class TestHTTPHardening:
                 buf += got
             assert b"200" in buf.split(b"\r\n", 1)[0]
             assert b"Connection: close" in buf
+        finally:
+            s.close()
+
+    def test_octet_stream_capped_without_large_uploads(self, echo,
+                                                       monkeypatch):
+        """Servers that did not opt into large uploads apply the tight
+        structured-body cap to octet-stream bodies too — otherwise every
+        connection could spool MAX_BODY_MB of unauthenticated bytes."""
+        import pio_tpu.server.http as http_mod
+
+        monkeypatch.setattr(http_mod, "MAX_JSON_BODY_MB", 0.001)  # 1 KiB
+        resp = self._raw(
+            echo,
+            b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/octet-stream\r\n"
+            b"Content-Length: 10000\r\n\r\n" + b"x" * 100,
+        )
+        assert b"413" in resp.split(b"\r\n", 1)[0], resp
+
+    def test_blob_server_still_accepts_large_octet_stream(self, tmp_path,
+                                                          monkeypatch):
+        import pio_tpu.server.http as http_mod
+        from pio_tpu.server.blob_server import create_blob_server
+
+        monkeypatch.setattr(http_mod, "MAX_JSON_BODY_MB", 0.001)  # 1 KiB
+        server = create_blob_server(
+            str(tmp_path / "s"), host="127.0.0.1", port=0
+        )
+        server.start()
+        try:
+            body = b"y" * 4096  # above the structured cap
+            resp = self._raw(
+                server.port,
+                b"PUT /blobs/objects/big HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Type: application/octet-stream\r\n"
+                + f"Content-Length: {len(body)}\r\n\r\n".encode() + body,
+            )
+            assert b"201" in resp.split(b"\r\n", 1)[0], resp
+        finally:
+            server.stop()
+
+    def test_pre_body_exception_returns_500(self):
+        """A pre_body bug must produce an HTTP 500, not a dropped
+        connection with a raw socketserver traceback."""
+        from pio_tpu.server.http import JsonHTTPServer, Router
+
+        r = Router()
+        r.add("POST", "/x", lambda req: (200, {}))
+
+        def boom(req):
+            raise ValueError("bug in pre_body")
+
+        srv = JsonHTTPServer(
+            r, "127.0.0.1", 0, name="boom", pre_body=boom
+        ).start()
+        try:
+            resp = self._raw(
+                srv.port,
+                b"POST /x HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: 2\r\n\r\n{}",
+            )
+            assert b"500" in resp.split(b"\r\n", 1)[0], resp
+            assert b"internal server error" in resp
+        finally:
+            srv.stop()
+
+    def test_http10_keepalive_echoed_and_reusable(self, echo):
+        """Honoring an HTTP/1.0 keep-alive must be ECHOED, or a
+        conforming 1.0 client assumes close and never reuses the
+        connection we keep holding open."""
+        import socket
+
+        s = socket.create_connection(("127.0.0.1", echo), timeout=10)
+        try:
+            req = (
+                b"POST /echo HTTP/1.0\r\nHost: x\r\n"
+                b"Connection: keep-alive\r\nContent-Length: 2\r\n\r\n{}"
+            )
+            s.sendall(req)
+            buf = s.recv(65536)
+            assert b"200" in buf.split(b"\r\n", 1)[0], buf
+            assert b"Connection: keep-alive" in buf
+            s.sendall(req)  # the connection is genuinely reusable
+            buf2 = s.recv(65536)
+            assert b"200" in buf2.split(b"\r\n", 1)[0], buf2
         finally:
             s.close()
 
