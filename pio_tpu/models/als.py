@@ -41,13 +41,17 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import itertools
 import os
 import time
 from typing import Optional, Tuple
 
 import numpy as np
 
-from pio_tpu.utils.numutil import round_up as _round_up
+from pio_tpu.utils.numutil import (
+    n_stream_chunks as _n_stream_chunks,
+    round_up as _round_up,
+)
 
 from pio_tpu.parallel.context import ComputeContext
 
@@ -489,17 +493,30 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
         #   every code ≤ 15, u8 codes, else fp16/f32 raw
         if mesh is not None and mesh_wire_lens is not None:
             # mesh compact wire: edge arrays arrived SHARDED over the
-            # mesh axis (host link crossed once); re-replicate over ICI
-            # here, then drop the shard-divisibility padding — the
-            # decode's cumsum needs the whole stream on every device
+            # mesh axis (host link crossed once) as one or more CHUNKS
+            # per array (PIO_TPU_ALS_STREAM_MB — chunked puts pipeline
+            # the per-device transfers); re-replicate each chunk over
+            # ICI here, drop its shard-divisibility padding, and splice
+            # the stream back together — the decode's cumsum needs the
+            # whole stream on every device. Chunking never re-encodes:
+            # concat(trimmed chunks) is byte-identical to the
+            # monolithic array.
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             repl = NamedSharding(mesh, P())
-            E_lo, E_hi, E_r = mesh_wire_lens
-            i_lo = jax.lax.with_sharding_constraint(i_lo, repl)[:E_lo]
-            if i_hi.shape[0]:
-                i_hi = jax.lax.with_sharding_constraint(i_hi, repl)[:E_hi]
-            r = jax.lax.with_sharding_constraint(r, repl)[:E_r]
+            lens_lo, lens_hi, lens_r = mesh_wire_lens
+
+            def gather_cat(chunks, lens):
+                parts = [
+                    jax.lax.with_sharding_constraint(c, repl)[:n]
+                    for c, n in zip(chunks, lens)
+                ]
+                return parts[0] if len(parts) == 1 \
+                    else jnp.concatenate(parts)
+
+            i_lo = gather_cat(i_lo, lens_lo)
+            i_hi = gather_cat(i_hi, lens_hi)
+            r = gather_cat(r, lens_r)
         E = i_lo.shape[0]
         i32 = math.decode_items(i_lo, i_hi, ovf_idx, ovf_val, counts_u)
         r32 = math.decode_ratings(r, E)
@@ -1052,18 +1069,41 @@ def _run_mesh_compact(config, mesh, axis, n_shards, user_idx, item_idx,
         i_ship, i_hi = _planes(i_sorted, I_pad)
         ovf_idx = np.zeros(0, np.int32)
         ovf_val = np.zeros(0, np.uint8)
+    # chunked shipment (the single-device stream discipline applied to
+    # the sharded puts): slice each ENCODED array into ≤8 spans so the
+    # per-device transfers of span k+1 pipeline behind span k instead of
+    # one monolithic put per array serializing the whole h2d. Slicing
+    # happens after encoding, so the wire BYTES are unchanged — the
+    # trainer splices the trimmed spans back together before decoding.
+    edge_bytes = item_bytes + r_ship.nbytes
+    n_stream = _n_stream_chunks(edge_bytes, "PIO_TPU_ALS_STREAM_MB")
+
+    def spans_of(a):
+        if n_stream == 1 or len(a) == 0:
+            return [a]
+        bounds = [len(a) * c // n_stream for c in range(n_stream + 1)]
+        return [a[s:e] for s, e in zip(bounds[:-1], bounds[1:]) if e > s]
+
+    lo_spans = spans_of(i_ship)
+    hi_spans = spans_of(i_hi)
+    r_spans = spans_of(r_ship)
+
     if stats is not None:
         stats["pack_s"] = time.perf_counter() - t0
         stats["wire_bytes"] = (
             item_bytes + r_ship.nbytes + 4 * (U_pad + I_pad)
         )
         stats["encoding"] = f"{rating_wire}+{item_wire}"
-        stats["n_stream"] = 1
+        stats["n_stream"] = max(len(lo_spans), len(r_spans))
 
     run = trainer(
         chunk_user, chunk_item, (S_u, w_user, S_i, w_item),
         rating_wire, item_wire,
-        mesh_wire_lens=(len(i_ship), len(i_hi), len(r_ship)),
+        mesh_wire_lens=(
+            tuple(len(s) for s in lo_spans),
+            tuple(len(s) for s in hi_spans),
+            tuple(len(s) for s in r_spans),
+        ),
     )
     shard1 = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
@@ -1073,18 +1113,34 @@ def _run_mesh_compact(config, mesh, axis, n_shards, user_idx, item_idx,
         return np.concatenate([a, np.zeros(p, a.dtype)]) if p else a
 
     t0 = time.perf_counter()
-    args = (
+    small = (
         jax.device_put(counts_u.astype(np.int32), repl),
         jax.device_put(np.ascontiguousarray(counts_i, np.int32), repl),
-        jax.device_put(pad_to_shards(i_ship), shard1),
-        jax.device_put(pad_to_shards(i_hi), shard1),
         jax.device_put(ovf_idx, repl),
         jax.device_put(ovf_val, repl),
-        jax.device_put(pad_to_shards(r_ship), shard1),
     )
+    # interleave the arrays' spans so early spans of every array are in
+    # flight together; per-span timings land in stats on profiled runs
+    lo_dev: list = []
+    hi_dev: list = []
+    r_dev: list = []
+    chunk_ts = []
+    for parts in itertools.zip_longest(lo_spans, hi_spans, r_spans):
+        tc = time.perf_counter()
+        group = []
+        for part, dev in zip(parts, (lo_dev, hi_dev, r_dev)):
+            if part is not None:
+                dev.append(jax.device_put(pad_to_shards(part), shard1))
+                group.append(dev[-1])
+        if stats is not None:
+            jax.block_until_ready(group)
+            chunk_ts.append(round(time.perf_counter() - tc, 3))
+    args = (*small[:2], tuple(lo_dev), tuple(hi_dev), *small[2:],
+            tuple(r_dev))
     if stats is not None:
         jax.block_until_ready(args)
         stats["h2d_s"] = time.perf_counter() - t0
+        stats["h2d_chunk_s"] = chunk_ts
         t0 = time.perf_counter()
         P_f, Q_f = run(*args, seed)
         jax.block_until_ready((P_f, Q_f))
@@ -1302,13 +1358,7 @@ def train_als(
         # stream threshold: chunked double-buffered shipment once the edge
         # wire exceeds ~one chunk (default 8 MiB); tiny runs keep the
         # single-dispatch path. <= 0 disables streaming entirely.
-        stream_mb = float(os.environ.get("PIO_TPU_ALS_STREAM_MB", "8"))
-        if stream_mb <= 0:
-            n_stream = 1
-        else:
-            n_stream = int(min(
-                8, -(-edge_bytes // max(1, int(stream_mb * 2 ** 20)))
-            ))
+        n_stream = _n_stream_chunks(edge_bytes, "PIO_TPU_ALS_STREAM_MB")
         if config.iterations < 1:
             # the streamed trainer fuses iteration 1's user half-step into
             # the chunk accumulation, so it can't express "0 iterations";

@@ -234,8 +234,10 @@ class LogRegParams(Params):
     learning_rate: float = 0.1
     reg: float = 0.0
     seed: int = 0
-    #: feature wire/matmul dtype — "float32" (default, exact arithmetic)
-    #: or opt-in "bfloat16" (MXU-native, half the host→device bytes)
+    #: feature wire/matmul dtype — "float32" (default, exact arithmetic),
+    #: opt-in "bfloat16" (MXU-native, half the host→device bytes), or
+    #: "int8" (quarter the bytes: per-column scales fold into the weights
+    #: on device, so the learned model still serves raw float features)
     input_dtype: str = "float32"
 
 

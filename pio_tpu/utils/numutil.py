@@ -2,7 +2,21 @@
 
 from __future__ import annotations
 
+import os
+
 
 def round_up(x: int, mult: int) -> int:
     """Smallest multiple of ``mult`` ≥ ``x``."""
     return -(-x // mult) * mult
+
+
+def n_stream_chunks(n_bytes: int, env_var: str, default: str = "8",
+                    cap: int = 8) -> int:
+    """Chunk count for a streamed host→device shipment: ``ceil(bytes /
+    chunk_mb)`` capped at ``cap``; 1 (streaming off) when the env knob
+    is ≤ 0. Shared by the ALS single-device/mesh wires and the logreg
+    feature wire so the threshold semantics can't drift."""
+    mb = float(os.environ.get(env_var, default))
+    if mb <= 0:
+        return 1
+    return int(min(cap, -(-n_bytes // max(1, int(mb * 2 ** 20)))))

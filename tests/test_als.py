@@ -82,6 +82,37 @@ class TestALS:
         # small fraction of the blocked-f32 bytes
         assert st_c["wire_bytes"] < st_b["wire_bytes"] / 3, (st_c, st_b)
 
+    def test_mesh_compact_wire_chunked_stream(self, synthetic,
+                                              monkeypatch):
+        """PIO_TPU_ALS_STREAM_MB applies to the mesh path too: the
+        encoded wire ships as multiple sharded spans (pipelined puts)
+        and the trainer splices them back — factors stay byte-identical
+        to blocked-f32 and the stats record the per-chunk timings."""
+        s = synthetic
+        rng = np.random.default_rng(7)
+        r_grid = (rng.integers(1, 11, len(s["u"])) * 0.5).astype(np.float32)
+
+        monkeypatch.setenv("PIO_TPU_ALS_MESH_WIRE", "blocked")
+        f_blocked = train_als(
+            ComputeContext.create(), s["u"], s["i"], r_grid,
+            s["U"], s["I"], CFG,
+        )
+        monkeypatch.setenv("PIO_TPU_ALS_MESH_WIRE", "compact")
+        monkeypatch.setenv("PIO_TPU_ALS_STREAM_MB", "0.001")  # force chunks
+        st = {}
+        f_chunked = train_als(
+            ComputeContext.create(), s["u"], s["i"], r_grid,
+            s["U"], s["I"], CFG, stats=st,
+        )
+        assert st["n_stream"] > 1, st
+        assert len(st["h2d_chunk_s"]) == st["n_stream"], st
+        assert np.array_equal(
+            f_blocked.user_factors, f_chunked.user_factors
+        )
+        assert np.array_equal(
+            f_blocked.item_factors, f_chunked.item_factors
+        )
+
     def test_mesh_compact_planes_wire_with_high_plane(self, monkeypatch):
         """Items ≥ 2^16 force the planes wire with a NON-EMPTY high
         plane — that array rides the sharded put + slice path too and

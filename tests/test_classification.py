@@ -65,28 +65,68 @@ class TestLogReg:
         assert acc > 0.95
 
     def test_input_dtype_wire_parity(self):
-        """bf16 feature wire (default — halves the dominant transfer)
-        must learn the same boundary as the exact f32 wire."""
+        """Compressed feature wires (bf16 halves the dominant transfer,
+        int8 quarters it with weight-folded scales) must learn the same
+        boundary as the exact f32 wire — and the int8 model's WEIGHTS
+        must apply to raw float features (the scales never leak into
+        the serving contract)."""
         rng = np.random.default_rng(1)
         X = rng.normal(size=(512, 8)).astype(np.float32)
         w = rng.normal(size=(8, 3))
         y = np.argmax(X @ w, axis=1).astype(np.int32)
         ctx = ComputeContext.create(seed=0)
         accs = {}
-        for dt in ("bfloat16", "float32"):
+        for dt in ("bfloat16", "float32", "int8"):
             m = train_logreg(
                 ctx, X, y, n_classes=3,
                 config=LogRegConfig(iterations=200, learning_rate=0.3,
                                     input_dtype=dt),
             )
+            # predict() consumes RAW floats in every wire mode
             accs[dt] = (m.predict(X) == y).mean()
         assert accs["float32"] > 0.9
         assert abs(accs["bfloat16"] - accs["float32"]) < 0.05, accs
+        assert abs(accs["int8"] - accs["float32"]) < 0.05, accs
         import pytest as _pytest
 
         with _pytest.raises(ValueError, match="input_dtype"):
             train_logreg(None, X, y, 3,
                          LogRegConfig(input_dtype="fp8"))
+
+    def test_int8_constant_column_safe(self):
+        """An all-zero feature column must not divide by zero in the
+        quantizer (scale falls back to 1)."""
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(128, 4)).astype(np.float32)
+        X[:, 2] = 0.0
+        y = (X[:, 0] > 0).astype(np.int32)
+        m = train_logreg(
+            None, X, y, n_classes=2,
+            config=LogRegConfig(iterations=150, learning_rate=0.3,
+                                input_dtype="int8"),
+        )
+        assert np.isfinite(m.weights).all()
+        assert (m.predict(X) == y).mean() > 0.9
+
+    def test_streamed_wire_matches_monolithic(self, monkeypatch):
+        """Chunked double-buffered shipment is a transport change only:
+        identical bytes in identical order → bitwise-identical model."""
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(1024, 16)).astype(np.float32)
+        w = rng.normal(size=(16, 3))
+        y = np.argmax(X @ w, axis=1).astype(np.int32)
+        for dt in ("float32", "int8"):
+            cfg = LogRegConfig(iterations=50, learning_rate=0.2,
+                               input_dtype=dt)
+            monkeypatch.setenv("PIO_TPU_LOGREG_STREAM_MB", "0")
+            mono = train_logreg(None, X, y, 3, cfg)
+            # ~64 KiB wire / 0.01 MB chunks → the max 8 spans
+            monkeypatch.setenv("PIO_TPU_LOGREG_STREAM_MB", "0.01")
+            streamed = train_logreg(None, X, y, 3, cfg)
+            np.testing.assert_array_equal(
+                mono.weights, streamed.weights, err_msg=dt
+            )
+            np.testing.assert_array_equal(mono.bias, streamed.bias)
 
     def test_single_device_path(self):
         X = np.array([[0.0], [1.0], [2.0], [3.0]], np.float32)
