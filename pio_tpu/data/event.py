@@ -11,7 +11,7 @@ the ``pio_`` prefix on entity types / property keys are reserved.
 from __future__ import annotations
 
 import datetime as _dt
-import uuid
+import os
 from dataclasses import dataclass, field, replace
 from typing import Any, Mapping, Optional, Tuple
 
@@ -78,7 +78,10 @@ class Event:
 
     @staticmethod
     def new_event_id() -> str:
-        return uuid.uuid4().hex
+        # 128 random bits as 32 hex chars — the uuid4 wrapper's version-
+        # bit bookkeeping cost ~5 µs/event on the ingest hot path for an
+        # id that is opaque everywhere in the system
+        return os.urandom(16).hex()
 
     # -- JSON (API wire format; reference EventJson4sSupport) ---------------
     def to_api_dict(self) -> dict:

@@ -114,6 +114,7 @@ class Storage:
     _lock = threading.RLock()
     _clients: Dict[str, object] = {}
     _mem: Dict[str, object] = {}
+    _facades: Dict[str, object] = {}  # hot-path store facades (reset-scoped)
     _reset_hooks: list = []  # weakref-wrapped callables
 
     @classmethod
@@ -166,6 +167,7 @@ class Storage:
         with cls._lock:
             cls._clients.clear()
             cls._mem.clear()
+            cls._facades.clear()
             hooks = list(cls._reset_hooks)
         _homes_made.clear()  # re-create homes on next touch
         dead = []
@@ -260,6 +262,25 @@ class Storage:
     # -- event stores -------------------------------------------------------
     @classmethod
     def get_levents(cls) -> base.LEvents:
+        # the one facade on the per-request ingest hot path: rebuilding
+        # it (env reads + wrapper allocation) cost ~24 µs/event, so it
+        # is memoized until Storage.reset() — the documented way to
+        # change storage config mid-process
+        cached = cls._facades.get("levents")
+        if cached is not None:
+            return cached
+        with cls._lock:
+            # build INSIDE the lock: reset() clears _facades under the
+            # same lock, so a facade built from pre-reset env config can
+            # never be stored into the post-reset cache
+            cached = cls._facades.get("levents")
+            if cached is None:
+                cached = cls._build_levents()
+                cls._facades["levents"] = cached
+            return cached
+
+    @classmethod
+    def _build_levents(cls) -> base.LEvents:
         cfg = _source_config("EVENTDATA")
         if cfg.type == "sqlite":
             return SQLiteEvents(cls._sqlite_client(cfg))

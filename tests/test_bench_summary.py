@@ -94,12 +94,22 @@ def _full_result() -> dict:
                                 (128, 4.4e6, 14120.0))
             },
             "eventserver_events_per_sec": {
-                "sqlite": {"single_events_per_sec": 3022.0,
+                "sqlite": {"single_events_per_sec": 3844.0,
+                           "single_trials": [3758.9, 3844.8, 4877.2],
+                           "single_p50_us": 146.9,
+                           "single_p50_events_per_sec": 6806.9,
+                           "inproc_events_per_sec": 16_311.0,
                            "concurrent_single_events_per_sec": 3900.0,
-                           "batch_events_per_sec": 24_900.0},
-                "eventlog": {"single_events_per_sec": 3247.0,
-                             "concurrent_single_events_per_sec": 4100.0,
-                             "batch_events_per_sec": 27_800.0},
+                           "batch_events_per_sec": 24_900.0,
+                           "client": "raw-keepalive"},
+                "eventlog": {"single_events_per_sec": 7555.0,
+                             "single_trials": [5881.6, 7555.0, 7571.6],
+                             "single_p50_us": 126.8,
+                             "single_p50_events_per_sec": 7888.2,
+                             "inproc_events_per_sec": 13_397.9,
+                             "concurrent_single_events_per_sec": 5877.0,
+                             "batch_events_per_sec": 37_697.0,
+                             "client": "raw-keepalive"},
             },
         },
     }
@@ -137,8 +147,9 @@ def test_summary_survives_tail_truncation(bench):
     assert cfg["similarproduct"]["x"] == 5.28
     assert cfg["twotower"]["gflops"] == 847.6
     assert cfg["seqrec"]["gflops"] == 3980.0
-    assert cfg["ingest"]["sqlite_single"] == 3022.0
-    assert cfg["ingest"]["eventlog_batch"] == 27_800.0
+    assert cfg["ingest"]["sqlite_single"] == 3844.0
+    assert cfg["ingest"]["sqlite_p50"] == 6806.9
+    assert cfg["ingest"]["eventlog_batch"] == 37_697.0
     assert parsed["full"] == "BENCH_FULL.json"
 
 
