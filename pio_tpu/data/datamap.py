@@ -151,14 +151,14 @@ class DataMap:
         value = _check_type(name, value, typ)
         # Containers come back as copies so callers can't mutate the map
         # (hash stability); scalar gets — the common case — stay copy-free.
-        return _copy.deepcopy(value) if isinstance(value, (list, dict)) else value
+        return _json_copy(value) if isinstance(value, (list, dict)) else value
 
     def get_opt(self, name: str, typ: Optional[Type[T]] = None) -> Optional[T]:
         value = self._fields.get(name)
         if value is None:
             return None
         value = _check_type(name, value, typ)
-        return _copy.deepcopy(value) if isinstance(value, (list, dict)) else value
+        return _json_copy(value) if isinstance(value, (list, dict)) else value
 
     def get_or_else(self, name: str, default: T, typ: Optional[Type[T]] = None) -> T:
         value = self.get_opt(name, typ)

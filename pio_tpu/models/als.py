@@ -990,7 +990,8 @@ def _sort_edges_by_user(user_idx, item_idx, rating, n_edges, U_pad,
         rc = native.als_sort_within_entity(
             _i32p(i_sorted), _f32p(r_sorted), U_pad, _i64p(counts_u)
         )
-        if rc != 0:  # a single user with ≥2^24 edges: sorter refuses
+        if rc != 0:  # a single entity with ≥2^32 edges: the radix
+            # sorter's 32-bit cursors would wrap, so it refuses
             # wholesale. Training is order-invariant so this is safe,
             # but the delta wire then won't apply (negative gaps →
             # planes fallback) — say so instead of silently diverging

@@ -174,10 +174,15 @@ int als_pack_fill(const int32_t* ent, const int32_t* other,
 // bytes at MovieLens scale) and the device rebuilds ids with one repeat.
 // counts is als_pack_count's output. Returns 0.
 //
-// The scatter writes one interleaved {other, rating} u64 per edge into a
-// scratch array, then splits sequentially: one random write stream
-// instead of two (the scatter is TLB/cache-miss bound; measured ~25%
-// faster at 25M edges than dual scattered stores).
+// Two-level scatter: a direct counting-sort scatter is TLB-miss bound
+// (25M random 8 B writes across a 200 MB destination ≈ 35 ns each).
+// Pass 1 partitions edges into ≤256 coarse buckets of contiguous entity
+// ranges (≤256 active write streams — TLB-resident); pass 2 scatters
+// each bucket internally, where the destination range is ~1 MB and
+// cache-resident. Both passes are stable (edges keep arrival order per
+// thread, threads are rank-ordered per bucket/entity), so the result
+// matches a stable sort by entity exactly. Measured ~2× faster than the
+// direct scatter at MovieLens-25M scale on one core.
 int als_sort_by_entity(const int32_t* ent, const int32_t* other,
                        const float* rating, int64_t n_edges,
                        int32_t n_entities, const int64_t* counts,
@@ -189,45 +194,62 @@ int als_sort_by_entity(const int32_t* ent, const int32_t* other,
   for (int32_t e = 0; e < n_entities; ++e)
     edge_start[e + 1] = edge_start[e] + counts[e];
 
-  // per-(thread, entity) cursors, stable by thread order (same scheme as
-  // als_pack_fill)
-  std::vector<std::vector<int64_t>> cursor(
-      T, std::vector<int64_t>(n_entities, 0));
+  // bucket = entity >> shift, sized so bucket count ≤ 256
+  int shift = 0;
+  while ((static_cast<int64_t>(n_entities - 1) >> shift) >= 256) ++shift;
+  const int B = static_cast<int>(((n_entities - 1) >> shift) + 1);
+  std::vector<int64_t> bucket_start(B + 1);
+  for (int b = 0; b < B; ++b)
+    bucket_start[b] = edge_start[std::min<int64_t>(
+        static_cast<int64_t>(b) << shift, n_entities)];
+  bucket_start[B] = n_edges;
+
+  // per-(thread, bucket) cursors, stable by thread order
+  std::vector<std::vector<int64_t>> bcur(T, std::vector<int64_t>(B, 0));
   if (T > 1) {
     parallel_ranges(n_edges, T, [&](int t, int64_t lo, int64_t hi) {
-      auto& h = cursor[t];
-      for (int64_t k = lo; k < hi; ++k) ++h[ent[k]];
+      auto& h = bcur[t];
+      for (int64_t k = lo; k < hi; ++k) ++h[ent[k] >> shift];
     });
-    for (int32_t e = 0; e < n_entities; ++e) {
+    for (int b = 0; b < B; ++b) {
       int64_t acc = 0;
       for (int t = 0; t < T; ++t) {
-        int64_t c = cursor[t][e];
-        cursor[t][e] = acc;
+        int64_t c = bcur[t][b];
+        bcur[t][b] = acc;
         acc += c;
       }
     }
   }
 
-  // default-init scratch (no value-init memset — every slot is written
-  // exactly once by the scatter)
+  // default-init scratch (every slot written exactly once)
   std::unique_ptr<uint64_t[]> packed(new uint64_t[n_edges]);
+  std::unique_ptr<int32_t[]> ent_tmp(new int32_t[n_edges]);
   parallel_ranges(n_edges, T, [&](int t, int64_t lo, int64_t hi) {
-    auto& cur = cursor[t];
+    auto& cur = bcur[t];
     for (int64_t k = lo; k < hi; ++k) {
       int32_t e = ent[k];
-      int64_t dst = edge_start[e] + cur[e]++;
+      int64_t dst = bucket_start[e >> shift] + cur[e >> shift]++;
       uint32_t rbits;
       std::memcpy(&rbits, &rating[k], 4);
+      ent_tmp[dst] = e;
       packed[dst] = (static_cast<uint64_t>(rbits) << 32) |
                     static_cast<uint32_t>(other[k]);
     }
   });
-  parallel_ranges(n_edges, T, [&](int, int64_t lo, int64_t hi) {
-    for (int64_t k = lo; k < hi; ++k) {
-      uint64_t p = packed[k];
-      other_sorted[k] = static_cast<int32_t>(p & 0xFFFFFFFFu);
-      uint32_t rbits = static_cast<uint32_t>(p >> 32);
-      std::memcpy(&rating_sorted[k], &rbits, 4);
+
+  // pass 2: buckets own disjoint entity ranges, so one global per-entity
+  // cursor array has no cross-bucket races; parallel over buckets
+  std::vector<int64_t> ecur(n_entities, 0);
+  parallel_ranges(B, std::min(T, B), [&](int, int64_t blo, int64_t bhi) {
+    for (int64_t b = blo; b < bhi; ++b) {
+      for (int64_t k = bucket_start[b]; k < bucket_start[b + 1]; ++k) {
+        int32_t e = ent_tmp[k];
+        int64_t dst = edge_start[e] + ecur[e]++;
+        uint64_t p = packed[k];
+        other_sorted[dst] = static_cast<int32_t>(p & 0xFFFFFFFFu);
+        uint32_t rbits = static_cast<uint32_t>(p >> 32);
+        std::memcpy(&rating_sorted[dst], &rbits, 4);
+      }
     }
   });
   return 0;
@@ -274,11 +296,15 @@ int64_t als_rating_codes(const float* rating, int64_t n_edges,
 // order, and the sorted adjacency is what makes the delta item wire
 // (pio_tpu/models/als.py _encode_items_delta) dense: gaps between
 // consecutive items fit 12 bits almost everywhere. Matches numpy's
-// np.lexsort((other, ent)) order exactly (stable on duplicates —
-// achieved by packing (id << 24 | position) into one u64 sort key, which
-// also avoids per-segment allocations and comparator indirection).
-// counts is als_pack_count's output. Returns 0, or -1 if a segment
-// exceeds 2^24 edges (key positions would collide).
+// np.lexsort((other, ent)) order exactly: stable on duplicate ids.
+//
+// Implementation: per-segment LSD radix over the id bytes (digit count
+// from the global max id — 2 passes at MovieLens scale), with a stable
+// insertion sort for tiny segments. Radix is branchless where introsort
+// on random ids mispredicts half its compares — measured ~2× faster at
+// 25M edges / 154-edge average segments, and the id+rating pair moves
+// together so there is no key-pack/unpack pass. counts is
+// als_pack_count's output. Returns 0.
 int als_sort_within_entity(int32_t* other_sorted, float* rating_sorted,
                            int32_t n_entities, const int64_t* counts) {
   int64_t n_edges = 0, max_seg = 0;
@@ -286,7 +312,8 @@ int als_sort_within_entity(int32_t* other_sorted, float* rating_sorted,
     n_edges += counts[e];
     max_seg = std::max(max_seg, counts[e]);
   }
-  if (max_seg >= (1LL << 24)) return -1;
+  // fail loud rather than let the uint32 radix cursors wrap silently
+  if (max_seg >= (1LL << 32)) return -1;
   const int T = n_threads(n_edges, n_entities);
 
   std::vector<int64_t> edge_start(n_entities + 1);
@@ -294,24 +321,75 @@ int als_sort_within_entity(int32_t* other_sorted, float* rating_sorted,
   for (int32_t e = 0; e < n_entities; ++e)
     edge_start[e + 1] = edge_start[e] + counts[e];
 
+  // digit count for the radix from the global max id (sequential scan:
+  // ~1 ns/edge, keeps every segment's pass count identical)
+  int32_t max_id = 0;
+  for (int64_t k = 0; k < n_edges; ++k)
+    max_id = std::max(max_id, other_sorted[k]);
+  // 64-bit shift + passes<=4 bound: a 32-bit shift by 32 (ids >= 2^24)
+  // would be UB and, with x86 mod-32 semantics, an infinite loop
+  int passes = 1;
+  while (passes < 4 &&
+         (static_cast<uint64_t>(static_cast<uint32_t>(max_id)) >>
+          (8 * passes)) != 0)
+    ++passes;
+
   parallel_ranges(n_entities, T, [&](int, int64_t lo, int64_t hi) {
-    std::vector<uint64_t> keys;
+    std::vector<int32_t> tmp_o;
     std::vector<float> tmp_r;
+    uint32_t cnt[256];
     for (int64_t e = lo; e < hi; ++e) {
       int64_t s = edge_start[e], n = counts[e];
       if (n < 2) continue;
       int32_t* o = other_sorted + s;
       float* r = rating_sorted + s;
-      keys.resize(n);
-      for (int64_t k = 0; k < n; ++k)
-        keys[k] = (static_cast<uint64_t>(static_cast<uint32_t>(o[k]))
-                   << 24) |
-                  static_cast<uint64_t>(k);
-      std::sort(keys.begin(), keys.end());
-      tmp_r.assign(r, r + n);
-      for (int64_t k = 0; k < n; ++k) {
-        o[k] = static_cast<int32_t>(keys[k] >> 24);
-        r[k] = tmp_r[keys[k] & 0xFFFFFF];
+      if (n <= 24) {
+        // stable insertion sort (shift only while strictly greater)
+        for (int64_t k = 1; k < n; ++k) {
+          int32_t ok = o[k];
+          float rk = r[k];
+          int64_t j = k - 1;
+          while (j >= 0 && o[j] > ok) {
+            o[j + 1] = o[j];
+            r[j + 1] = r[j];
+            --j;
+          }
+          o[j + 1] = ok;
+          r[j + 1] = rk;
+        }
+        continue;
+      }
+      if (static_cast<int64_t>(tmp_o.size()) < n) {
+        tmp_o.resize(n);
+        tmp_r.resize(n);
+      }
+      int32_t* src_o = o;
+      float* src_r = r;
+      int32_t* dst_o = tmp_o.data();
+      float* dst_r = tmp_r.data();
+      for (int pass = 0; pass < passes; ++pass) {
+        const int shift = 8 * pass;
+        std::memset(cnt, 0, sizeof(cnt));
+        for (int64_t k = 0; k < n; ++k)
+          ++cnt[(static_cast<uint32_t>(src_o[k]) >> shift) & 0xFF];
+        uint32_t acc = 0;
+        for (int b = 0; b < 256; ++b) {
+          uint32_t c = cnt[b];
+          cnt[b] = acc;
+          acc += c;
+        }
+        for (int64_t k = 0; k < n; ++k) {
+          uint32_t pos =
+              cnt[(static_cast<uint32_t>(src_o[k]) >> shift) & 0xFF]++;
+          dst_o[pos] = src_o[k];
+          dst_r[pos] = src_r[k];
+        }
+        std::swap(src_o, dst_o);
+        std::swap(src_r, dst_r);
+      }
+      if (passes & 1) {  // result landed in the scratch: copy back
+        std::memcpy(o, src_o, sizeof(int32_t) * n);
+        std::memcpy(r, src_r, sizeof(float) * n);
       }
     }
   });
