@@ -731,9 +731,16 @@ def _bench_textclass(scale: float) -> dict:
 _TT_BATCH, _TT_EMBED, _TT_HIDDEN, _TT_OUT = 4096, 64, 128, 64
 
 
-def _bench_twotower(ctx, scale: float) -> float:
+def _bench_twotower(ctx, scale: float) -> dict:
     """BASELINE config #5: two-tower retrieval training, examples/sec
-    (one example = one positive pair through a contrastive step)."""
+    (one example = one positive pair through a contrastive step).
+
+    Round-5 finding: training is ONE compiled scan over device-resident
+    ids — the e2e cost was ~78% the OUTPUT readback of the full vector
+    tables over the tunneled link, not any input feed. The stage opts
+    into the bf16 table wire (half those bytes; tables are retrieval
+    embeddings) and records the phase split so the achieved-GFLOP/s
+    figure carries its real bound."""
     from pio_tpu.models.two_tower import TwoTowerConfig, train_two_tower
     from pio_tpu.parallel.mesh import MeshSpec, build_mesh
 
@@ -745,16 +752,35 @@ def _bench_twotower(ctx, scale: float) -> float:
     rng = np.random.default_rng(4)
     u = rng.integers(0, n_users, n_pairs).astype(np.int32)
     i = rng.integers(0, n_items, n_pairs).astype(np.int32)
-    cfg = TwoTowerConfig(embed_dim=_TT_EMBED, hidden=_TT_HIDDEN,
-                         out_dim=_TT_OUT, steps=steps, batch_size=batch)
+    on_acc = _on_accelerator(ctx)
+    cfg = TwoTowerConfig(
+        embed_dim=_TT_EMBED, hidden=_TT_HIDDEN, out_dim=_TT_OUT,
+        steps=steps, batch_size=batch,
+        # bf16 emulation only slows the CPU anchor — each side at its
+        # best config, like the classification wire policy
+        table_wire="bfloat16" if on_acc else "float32",
+    )
     mesh = build_mesh(  # the tower shardings need a model axis too
         MeshSpec(data=-1, model=1), devices=list(ctx.mesh.devices.flat)
     )
-    dt, _ = _best_of(
+    times, _ = _timed_runs(
         lambda: train_two_tower(mesh, u, i, n_users, n_items, cfg),
-        repeats=2,
+        repeats=5 if on_acc else 3,
     )
-    return steps * batch / dt
+    dt = times[len(times) // 2]
+    out = {
+        "value": steps * batch / dt,
+        "table_wire": cfg.table_wire,
+        "anchor_note": "median each side, same program+depth",
+    }
+    if on_acc:
+        st = {}
+        train_two_tower(mesh, u, i, n_users, n_items, cfg, stats=st)
+        out["phases"] = {
+            k: round(v, 3) if isinstance(v, float) else v
+            for k, v in st.items()
+        }
+    return out
 
 
 #: v5e bf16 peak, GFLOP/s — the roofline anchor for utilization notes
@@ -1419,7 +1445,9 @@ def main() -> None:
             tt["achieved_gflops"] = round(g, 1)
             tt["roofline_note"] = (
                 f"{g / _V5E_BF16_PEAK_GFLOPS:.2%} of v5e bf16 peak — "
-                "e2e wall-clock incl. per-step host batch feed"
+                "e2e wall-clock; bound = output table readback over "
+                "the host link (see phases), training is one "
+                "compiled scan"
             )
 
         if not over_deadline("seqrec"):

@@ -478,6 +478,8 @@ def train_seqrec(
     )
     fitted = state[1]
 
-    host = jax.tree.map(lambda a: np.asarray(a), fitted)
+    # ONE fused pull (device_get returns host numpy): per-leaf
+    # np.asarray paid a host link round trip per parameter tensor
+    host = jax.device_get(fitted)
     host["emb"] = host["emb"][: n_items + 1]
     return SeqRecModel(params=host, n_items=n_items, config=cfg)

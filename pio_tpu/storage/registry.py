@@ -263,21 +263,30 @@ class Storage:
     @classmethod
     def get_levents(cls) -> base.LEvents:
         # the one facade on the per-request ingest hot path: rebuilding
-        # it (env reads + wrapper allocation) cost ~24 µs/event, so it
-        # is memoized until Storage.reset() — the documented way to
-        # change storage config mid-process
-        cached = cls._facades.get("levents")
-        if cached is not None:
-            return cached
+        # it (full config resolution + wrapper allocation) cost
+        # ~24 µs/event. Memoized KEYED ON the config env fingerprint —
+        # a caller that swaps PIO_STORAGE_*/PIO_TPU_HOME without
+        # Storage.reset() still gets the right backend, exactly like
+        # the unmemoized behavior (tests re-home per case this way)
+        env = os.environ
+        src = env.get("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE")
+        fp = (env.get("PIO_TPU_HOME"), src)
+        if src:
+            fp += (env.get(f"PIO_STORAGE_SOURCES_{src}_TYPE"),
+                   env.get(f"PIO_STORAGE_SOURCES_{src}_PATH"))
+        hit = cls._facades.get("levents")
+        if hit is not None and hit[0] == fp:
+            return hit[1]
         with cls._lock:
             # build INSIDE the lock: reset() clears _facades under the
             # same lock, so a facade built from pre-reset env config can
             # never be stored into the post-reset cache
-            cached = cls._facades.get("levents")
-            if cached is None:
-                cached = cls._build_levents()
-                cls._facades["levents"] = cached
-            return cached
+            hit = cls._facades.get("levents")
+            if hit is not None and hit[0] == fp:
+                return hit[1]
+            built = cls._build_levents()
+            cls._facades["levents"] = (fp, built)
+            return built
 
     @classmethod
     def _build_levents(cls) -> base.LEvents:
