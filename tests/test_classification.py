@@ -203,8 +203,16 @@ class TestClassificationEndToEnd:
                 "name": "logreg",
                 "params": {"iterations": 300, "learning_rate": 0.3},
             },
+            {
+                # the int8 feature wire through the FULL template
+                # lifecycle: train → persist → load → serve on raw
+                # float queries (scales must never leak into serving)
+                "name": "logreg",
+                "params": {"iterations": 300, "learning_rate": 0.3,
+                           "input_dtype": "int8"},
+            },
         ],
-        ids=["naivebayes", "logreg"],
+        ids=["naivebayes", "logreg", "logreg-int8"],
     )
     def test_full_lifecycle(self, algo):
         app_id = Storage.get_meta_data_apps().insert(App(0, "cls-test"))
