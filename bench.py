@@ -140,6 +140,52 @@ def _probe_link_mb_s(n_bytes: int = 32 << 20) -> float:
     return n_bytes / best / 1e6
 
 
+def _probe_link_d2h_mb_s(n_bytes: int = 16 << 20) -> float:
+    """Device→host companion probe for READBACK-bound stages (the
+    upload probe measures the other direction, which an asymmetric
+    tunnel can decouple). The probed array must be a COMPUTATION
+    OUTPUT: ``device_get`` of a host-originated ``device_put`` array
+    returns jax's retained host copy without touching the wire
+    (measured "1.5 TB/s" on a ~20 MB/s tunnel). XOR with a nonzero
+    scalar keeps the bytes incompressible; ``device_get`` is
+    synchronous, so the upload probe's early-ack trap doesn't apply."""
+    import jax
+    import jax.numpy as jnp
+
+    buf_dev = jax.device_put(np.random.default_rng(1).integers(
+        0, 256, n_bytes, dtype=np.uint8
+    ))
+    scramble = jax.jit(lambda x, s: jnp.bitwise_xor(x, s))
+
+    def fresh(k):
+        # a NEW device-only result each time: jax caches the host copy
+        # on an Array after its first pull, so re-getting one array
+        # measures that cache, not the wire
+        dev = scramble(buf_dev, jnp.uint8(k))
+        jax.block_until_ready(dev)
+        return dev
+
+    jax.device_get(fresh(0))  # warm compile + path
+    best = float("inf")
+    for k in (1, 2):
+        dev = fresh(k)
+        t0 = time.perf_counter()
+        jax.device_get(dev)
+        best = min(best, time.perf_counter() - t0)
+    return n_bytes / best / 1e6
+
+
+def _link_meta(active: bool, d2h: bool = False) -> dict:
+    """Same-moment link metadata for a wire-bound stage — empty on the
+    CPU-anchor side, where a link probe is meaningless. One helper so
+    the probe/round/attach sequence cannot drift between stages."""
+    if not active:
+        return {}
+    if d2h:
+        return {"link_d2h_mb_s": round(_probe_link_d2h_mb_s(), 1)}
+    return {"link_mb_s": round(_probe_link_mb_s(), 1)}
+
+
 # --------------------------------------------------------------- headline
 def _time_train(ctx, u, i, r, n_users, n_items, cfg, repeats=5):
     """repeats=5 on the headline: the tunneled link's bandwidth swings
@@ -548,6 +594,10 @@ def _bench_classification(ctx, scale: float) -> dict:
         iterations=iters, learning_rate=0.05,
         input_dtype="float32" if plat == "cpu" else "int8",
     )
+    # the stage is h2d-wire-bound on a slow host link (the feature
+    # upload): the ratio tracks the link, so every recorded value
+    # carries its own same-moment probe
+    link = _link_meta(plat != "cpu")
     times, model = _timed_runs(
         lambda: train_logreg(ctx, X, y, c, cfg), repeats=5
     )
@@ -557,6 +607,7 @@ def _bench_classification(ctx, scale: float) -> dict:
         "train_acc": round(float((model.predict(X) == y).mean()), 4),
         "wire": cfg.input_dtype,
         "anchor_note": "median-of-5 each side, same program+depth",
+        **link,
     }
 
 
@@ -578,20 +629,16 @@ def _bench_similarproduct(ctx, scale: float) -> dict:
     r = np.ones(n_edges, np.float32)
     cfg = ALSConfig(rank=16, iterations=iters, reg=0.1, implicit=True,
                     alpha=40.0)
-    link = None
-    if _on_accelerator(ctx):
-        link = round(_probe_link_mb_s(), 1)
+    link = _link_meta(_on_accelerator(ctx))
     times, _ = _timed_runs(
         lambda: train_als(ctx, u, i, r, n_users, n_items, cfg), repeats=5
     )
     dt = times[len(times) // 2]
-    out = {
+    return {
         "value": n_edges * iters / dt,
         "anchor_note": "median-of-5 each side, same program+depth",
+        **link,
     }
-    if link is not None:
-        out["link_mb_s"] = link
-    return out
 
 
 def _on_accelerator(ctx) -> bool:
@@ -764,6 +811,9 @@ def _bench_twotower(ctx, scale: float) -> dict:
     mesh = build_mesh(  # the tower shardings need a model axis too
         MeshSpec(data=-1, model=1), devices=list(ctx.mesh.devices.flat)
     )
+    # table-READBACK-bound (see phases): probe the d2h direction, which
+    # an asymmetric tunnel can decouple from the upload direction
+    link = _link_meta(on_acc, d2h=True)
     times, _ = _timed_runs(
         lambda: train_two_tower(mesh, u, i, n_users, n_items, cfg),
         repeats=5 if on_acc else 3,
@@ -773,6 +823,7 @@ def _bench_twotower(ctx, scale: float) -> dict:
         "value": steps * batch / dt,
         "table_wire": cfg.table_wire,
         "anchor_note": "median each side, same program+depth",
+        **link,
     }
     if on_acc:
         st = {}
@@ -1170,6 +1221,7 @@ def build_summary(full: dict, full_path: str = "BENCH_FULL.json") -> dict:
             for src, dst in (("achieved_gflops", "gflops"),
                              ("anchor_note", "anchor"),
                              ("link_mb_s", "link"),
+                             ("link_d2h_mb_s", "link_d2h"),
                              ("train_acc", "acc"),
                              ("anchor_train_acc", "anchor_acc"),
                              ("wire", "wire")):
