@@ -301,7 +301,9 @@ def _bench_server_p50(factors, n_users: int, n_items: int,
         out["p50_ms"] = round(
             float(np.percentile(np.array(lat) * 1000.0, 50)), 3
         )
-        out["concurrent"] = _concurrent_stage(server.port, n_users)
+        out["concurrent"] = _with_metrics_delta(
+            server.port, lambda: _concurrent_stage(server.port, n_users)
+        )
     finally:
         post.close()
         server.stop()
@@ -313,8 +315,9 @@ def _bench_server_p50(factors, n_users: int, n_items: int,
             # timed stage measures the POST-decision steady state
             post({"user": "u1", "num": 10})
             _drive_until_decided(server.port, service, n_users)
-            out["concurrent_microbatch"] = _concurrent_stage(
-                server.port, n_users
+            out["concurrent_microbatch"] = _with_metrics_delta(
+                server.port,
+                lambda: _concurrent_stage(server.port, n_users),
             )
             mb = service._batcher.to_dict()
             out["concurrent_microbatch"]["mode"] = mb["mode"]
@@ -384,6 +387,73 @@ def _serve_single(variant, microbatch_us: int):
             os.environ["PIO_TPU_SERVE_MICROBATCH_US"] = prev
     server.start()
     return server, service, _KeepAliveClient(server.port)
+
+
+def _scrape_metrics(port: int):
+    """One ``GET /metrics`` scrape → ParsedMetrics (obs promparse)."""
+    import urllib.request
+
+    from pio_tpu.obs.promparse import parse_prometheus_text
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5.0
+    ) as r:
+        return parse_prometheus_text(r.read().decode("utf-8"))
+
+
+def _metrics_delta(before, after) -> dict:
+    """Server-side view of a bench stage: request/error counter deltas
+    plus per-stage mean latency between two /metrics snapshots. Embedded
+    in the artifact so a QPS regression can be localized (queue vs
+    execute vs serialize) without re-running under a profiler."""
+    fam_sum = lambda pm, name: sum(pm.family(name).values())
+    out = {
+        "queries": int(
+            fam_sum(after, "pio_queries_total")
+            - fam_sum(before, "pio_queries_total")
+        ),
+        "errors": int(
+            fam_sum(after, "pio_query_errors_total")
+            - fam_sum(before, "pio_query_errors_total")
+        ),
+    }
+    stages: dict = {}
+    for ls, cnt_after in after.family(
+        "pio_query_stage_seconds_count"
+    ).items():
+        d = dict(ls)
+        stage = d.pop("stage", "?")
+        d["stage"] = stage
+        dn = cnt_after - (
+            before.value("pio_query_stage_seconds_count", **d) or 0.0
+        )
+        ds = (after.value("pio_query_stage_seconds_sum", **d) or 0.0) - (
+            before.value("pio_query_stage_seconds_sum", **d) or 0.0
+        )
+        if dn > 0:  # aggregate across engine_id label values
+            prev_n, prev_s = stages.get(stage, (0.0, 0.0))
+            stages[stage] = (prev_n + dn, prev_s + ds)
+    out["stage_avg_ms"] = {
+        s: round(ds / dn * 1e3, 3) for s, (dn, ds) in sorted(stages.items())
+    }
+    return out
+
+
+def _with_metrics_delta(port: int, stage_fn):
+    """Run ``stage_fn()`` bracketed by /metrics snapshots; attach the
+    delta as ``server_metrics`` (best-effort — a scrape failure never
+    fails the bench stage)."""
+    try:
+        m0 = _scrape_metrics(port)
+    except Exception:
+        m0 = None
+    got = stage_fn()
+    if m0 is not None:
+        try:
+            got["server_metrics"] = _metrics_delta(m0, _scrape_metrics(port))
+        except Exception as exc:
+            print(f"# metrics delta scrape failed: {exc}", file=sys.stderr)
+    return got
 
 
 def _concurrent_stage(port: int, n_users: int, n_threads=16,
@@ -550,7 +620,11 @@ def _bench_pool_serving(factors, n_users: int, n_items: int) -> dict:
             warm.close()
             warm = _KeepAliveClient(pool.port)
         warm.close()
-        got = _concurrent_stage(pool.port, n_users)
+        # pool /metrics is pool-wide (shared-memory aggregation), so one
+        # scrape on whatever worker answers covers every sibling
+        got = _with_metrics_delta(
+            pool.port, lambda: _concurrent_stage(pool.port, n_users)
+        )
         got["workers"] = n_workers
         got["host_cores"] = cores
         return got

@@ -165,13 +165,13 @@ class Engine:
         answer to the reference's Spark-UI stage view (SURVEY.md §5
         tracing).
         """
-        import time as _time
+        from pio_tpu.obs import monotonic_s
 
         def _phase(name, fn):
-            t0 = _time.monotonic()
+            t0 = monotonic_s()
             out = fn()
             if timings is not None:
-                timings[name] = round(_time.monotonic() - t0, 3)
+                timings[name] = round(monotonic_s() - t0, 3)
             return out
 
         data_source = self.data_source_class(engine_params.data_source_params)

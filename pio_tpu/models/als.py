@@ -43,7 +43,7 @@ import dataclasses
 import functools
 import itertools
 import os
-import time
+from pio_tpu.obs import monotonic_s
 from typing import Optional, Tuple
 
 import numpy as np
@@ -782,16 +782,16 @@ def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
     if stats is not None:
         # profiling: pre-encode every chunk so host CPU time lands in
         # pack_s, not in the transfer phase it would otherwise pollute
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         encoded = [
             _encode_chunk(e0, e1, lc)
             for (e0, e1), lc in zip(spans, local_slices)
         ]
         stats["pack_s"] = stats.get("pack_s", 0.0) + (
-            time.perf_counter() - t0
+            monotonic_s() - t0
         )
 
-    t0 = time.perf_counter()
+    t0 = monotonic_s()
     wire_dev, lc_dev = [], []
     for c, ((e0, e1), lc) in enumerate(zip(spans, local_slices)):
         wire = encoded[c] if encoded else _encode_chunk(e0, e1, lc)
@@ -801,8 +801,8 @@ def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
     ci_dev = jax.device_put(np.ascontiguousarray(counts_i, np.int32))
     if stats is not None:
         jax.block_until_ready((wire_dev, lc_dev, cu_dev, ci_dev))
-        stats["h2d_s"] = time.perf_counter() - t0
-        t0 = time.perf_counter()
+        stats["h2d_s"] = monotonic_s() - t0
+        t0 = monotonic_s()
 
     Q0, A, b = init(seed)
     user_blocks = []
@@ -814,7 +814,7 @@ def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
                         tuple(lc_dev))
     if stats is not None:
         jax.block_until_ready((P_f, Q_f))
-        stats["device_s"] = time.perf_counter() - t0
+        stats["device_s"] = monotonic_s() - t0
     return P_f, Q_f
 
 
@@ -1046,7 +1046,7 @@ def _run_mesh_compact(config, mesh, axis, n_shards, user_idx, item_idx,
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    t0 = time.perf_counter()
+    t0 = monotonic_s()
     counts_u, chunk_user, S_u = counts_layout(user_idx, w_user, U_pad)
     counts_i, chunk_item, S_i = counts_layout(item_idx, w_item, I_pad)
     if S_u * w_user >= 2 ** 31 or S_i * w_item >= 2 ** 31:
@@ -1090,7 +1090,7 @@ def _run_mesh_compact(config, mesh, axis, n_shards, user_idx, item_idx,
     r_spans = spans_of(r_ship)
 
     if stats is not None:
-        stats["pack_s"] = time.perf_counter() - t0
+        stats["pack_s"] = monotonic_s() - t0
         stats["wire_bytes"] = (
             item_bytes + r_ship.nbytes + 4 * (U_pad + I_pad)
         )
@@ -1113,7 +1113,7 @@ def _run_mesh_compact(config, mesh, axis, n_shards, user_idx, item_idx,
         p = (-len(a)) % n_shards
         return np.concatenate([a, np.zeros(p, a.dtype)]) if p else a
 
-    t0 = time.perf_counter()
+    t0 = monotonic_s()
     small = (
         jax.device_put(counts_u.astype(np.int32), repl),
         jax.device_put(np.ascontiguousarray(counts_i, np.int32), repl),
@@ -1127,7 +1127,7 @@ def _run_mesh_compact(config, mesh, axis, n_shards, user_idx, item_idx,
     r_dev: list = []
     chunk_ts = []
     for parts in itertools.zip_longest(lo_spans, hi_spans, r_spans):
-        tc = time.perf_counter()
+        tc = monotonic_s()
         group = []
         for part, dev in zip(parts, (lo_dev, hi_dev, r_dev)):
             if part is not None:
@@ -1135,17 +1135,17 @@ def _run_mesh_compact(config, mesh, axis, n_shards, user_idx, item_idx,
                 group.append(dev[-1])
         if stats is not None:
             jax.block_until_ready(group)
-            chunk_ts.append(round(time.perf_counter() - tc, 3))
+            chunk_ts.append(round(monotonic_s() - tc, 3))
     args = (*small[:2], tuple(lo_dev), tuple(hi_dev), *small[2:],
             tuple(r_dev))
     if stats is not None:
         jax.block_until_ready(args)
-        stats["h2d_s"] = time.perf_counter() - t0
+        stats["h2d_s"] = monotonic_s() - t0
         stats["h2d_chunk_s"] = chunk_ts
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         P_f, Q_f = run(*args, seed)
         jax.block_until_ready((P_f, Q_f))
-        stats["device_s"] = time.perf_counter() - t0
+        stats["device_s"] = monotonic_s() - t0
     else:
         P_f, Q_f = run(*args, seed)
     return P_f, Q_f
@@ -1273,7 +1273,7 @@ def train_als(
                 _trainer, seed, stats,
             )
         else:
-            t0 = time.perf_counter()
+            t0 = monotonic_s()
             # canonical (user, item) edge order BEFORE packing: block
             # content becomes input-order-invariant and bit-identical to
             # the compact path's on-device construction (which composes
@@ -1302,20 +1302,20 @@ def train_als(
                 jax.device_put(t[2], blk2),
             )
             if stats is not None:
-                stats["pack_s"] = time.perf_counter() - t0
+                stats["pack_s"] = monotonic_s() - t0
                 stats["wire_bytes"] = sum(
                     a.nbytes for t in (by_user, by_item) for a in t
                 )
                 stats["encoding"] = "blocked-f32"
                 stats["n_stream"] = 1
-                t0 = time.perf_counter()
+                t0 = monotonic_s()
                 u_dev, i_dev = put_blocks(by_user), put_blocks(by_item)
                 jax.block_until_ready((u_dev, i_dev))
-                stats["h2d_s"] = time.perf_counter() - t0
-                t0 = time.perf_counter()
+                stats["h2d_s"] = monotonic_s() - t0
+                t0 = monotonic_s()
                 P_f, Q_f = run(u_dev, i_dev, seed)
                 jax.block_until_ready((P_f, Q_f))
-                stats["device_s"] = time.perf_counter() - t0
+                stats["device_s"] = monotonic_s() - t0
             else:
                 P_f, Q_f = run(
                     put_blocks(by_user), put_blocks(by_item), seed
@@ -1328,7 +1328,7 @@ def train_als(
         # process (the tunneled-TPU case). Above a wire-size threshold the
         # shipment is STREAMED in chunks overlapped with the chunk packs +
         # iteration-1 accumulation (_build_stream_trainer).
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         counts_u, chunk_user, S_u = _counts_layout(user_idx, w_user, U_pad)
         counts_i, chunk_item, S_i = _counts_layout(item_idx, w_item, I_pad)
         if S_u * w_user >= 2 ** 31 or S_i * w_item >= 2 ** 31:
@@ -1350,7 +1350,7 @@ def train_als(
         use_delta = item_wire == "delta12"
         edge_bytes = item_bytes + r_ship.nbytes
         if stats is not None:
-            stats["pack_s"] = time.perf_counter() - t0
+            stats["pack_s"] = monotonic_s() - t0
             stats["wire_bytes"] = (
                 edge_bytes + 4 * (U_pad + I_pad)  # + the two count arrays
             )
@@ -1392,14 +1392,14 @@ def train_als(
                 i_ship, i_hi, ovf_idx, ovf_val, r_ship,
             )
             if stats is not None:
-                t0 = time.perf_counter()
+                t0 = monotonic_s()
                 args = tuple(jax.device_put(a) for a in args)
                 jax.block_until_ready(args)
-                stats["h2d_s"] = time.perf_counter() - t0
-                t0 = time.perf_counter()
+                stats["h2d_s"] = monotonic_s() - t0
+                t0 = monotonic_s()
                 P_f, Q_f = run(*args, seed)
                 jax.block_until_ready((P_f, Q_f))
-                stats["device_s"] = time.perf_counter() - t0
+                stats["device_s"] = monotonic_s() - t0
             else:
                 P_f, Q_f = run(*args, seed)
 

@@ -1,0 +1,58 @@
+"""pio_tpu.obs — dependency-free observability subsystem.
+
+Three pillars (ISSUE 1; the reference exposes JSON request counts only —
+SURVEY.md §5 observability row):
+
+- **Metrics registry** (:mod:`pio_tpu.obs.metrics`): Counter, Gauge and
+  fixed-bucket Histogram types with labels and proper ``# HELP``/``# TYPE``
+  Prometheus text exposition, replacing the bespoke per-server stat
+  classes and hand-rolled exposition lines.
+- **Stage tracing** (:mod:`pio_tpu.obs.tracing`): a lightweight
+  context-manager tracer over the single monotonic clock, with a ring
+  buffer of recent traces surfaced as ``GET /traces.json``.
+- **Cross-worker aggregation** (:mod:`pio_tpu.obs.shm`): in
+  SO_REUSEPORT pool serving each worker mirrors its counters/histogram
+  buckets into a per-worker stripe of one mmapped segment, so a scrape
+  of ANY worker reports pool-wide totals.
+
+Plus :mod:`pio_tpu.obs.profile` (the opt-in ``PIO_TPU_PROFILE=dir`` JAX
+profiler hook) and :mod:`pio_tpu.obs.promparse` (a small text-format
+parser shared by tests, bench.py and the dashboard).
+
+``monotonic_s`` is THE process-wide monotonic clock for durations —
+serving paths used to mix ``time.monotonic()`` and
+``time.perf_counter()``; every timing site now goes through this one
+source (``perf_counter``: monotonic per the stdlib contract, and the
+highest-resolution clock CPython offers for intervals).
+"""
+
+from __future__ import annotations
+
+from pio_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    RequestWindow,
+    escape_help,
+    escape_label_value,
+    monotonic_s,
+)
+from pio_tpu.obs.tracing import Trace, Tracer
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "RequestWindow",
+    "Trace",
+    "Tracer",
+    "escape_help",
+    "escape_label_value",
+    "monotonic_s",
+]

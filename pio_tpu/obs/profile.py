@@ -1,0 +1,94 @@
+"""Opt-in JAX profiler hook for serving: ``PIO_TPU_PROFILE=dir``.
+
+Training already supports ``--profile-dir`` (a trace of the whole run);
+serving needs something narrower — profiling every query forever would
+drown the trace and tax the hot path. This hook captures ONE
+``jax.profiler`` trace covering the first N device executions after
+deploy (N from ``PIO_TPU_PROFILE_EXECUTIONS``, default 8: enough to see
+both the bucket-compile execution and warm steady-state dispatches),
+then gets out of the way permanently. View with tensorboard/xprof.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from contextlib import contextmanager
+
+log = logging.getLogger("pio_tpu.obs")
+
+ENV_DIR = "PIO_TPU_PROFILE"
+ENV_N = "PIO_TPU_PROFILE_EXECUTIONS"
+
+
+class DeviceProfileHook:
+    """Context manager factory wrapped around the device-execute stage.
+
+    Inert (zero overhead beyond one attribute check) unless constructed
+    with a directory — the serving services build it from the
+    environment via :func:`from_env`.
+    """
+
+    def __init__(self, directory: str = "", first_n: int = 8):
+        self.directory = directory
+        self.first_n = first_n
+        self._lock = threading.Lock()
+        self._seen = 0
+        self._active = False
+        self._done = not directory
+
+    @classmethod
+    def from_env(cls) -> "DeviceProfileHook":
+        directory = os.environ.get(ENV_DIR, "")
+        try:
+            first_n = int(os.environ.get(ENV_N, "8"))
+        except ValueError:
+            first_n = 8
+        return cls(directory, max(1, first_n))
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.directory) and not self._done
+
+    @contextmanager
+    def capture(self):
+        """Wrap one device execution; starts the trace on the first
+        call, stops it after ``first_n``. Any profiler failure disables
+        the hook rather than failing the query."""
+        if self._done:
+            yield
+            return
+        with self._lock:
+            start = not self._active and self._seen == 0
+            if start:
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(self.directory)
+                    self._active = True
+                    log.info(
+                        "profiling first %d device executions -> %s",
+                        self.first_n, self.directory,
+                    )
+                except Exception:
+                    log.exception("PIO_TPU_PROFILE start failed; disabled")
+                    self._done = True
+        try:
+            yield
+        finally:
+            with self._lock:
+                if self._active:
+                    self._seen += 1
+                    if self._seen >= self.first_n:
+                        try:
+                            import jax
+
+                            jax.profiler.stop_trace()
+                            log.info(
+                                "profile trace written to %s", self.directory
+                            )
+                        except Exception:
+                            log.exception("PIO_TPU_PROFILE stop failed")
+                        self._active = False
+                        self._done = True

@@ -1,0 +1,136 @@
+"""Small Prometheus text-format parser.
+
+Shared by the test suite (round-tripping every ``/metrics`` endpoint),
+``bench.py`` (server-side metric deltas embedded in the bench artifact)
+and the dashboard's serving view. Parses the subset the exposition
+spec defines for text format 0.0.4: ``# HELP``/``# TYPE`` comment lines
+and ``name{labels} value`` samples with escaped label values.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Optional, Tuple
+
+LabelSet = FrozenSet[Tuple[str, str]]
+
+
+class ParsedMetrics:
+    """Samples keyed by (metric name, frozenset of label pairs)."""
+
+    def __init__(self):
+        self.samples: Dict[Tuple[str, LabelSet], float] = {}
+        self.types: Dict[str, str] = {}
+        self.helps: Dict[str, str] = {}
+
+    def value(self, name: str, **labels) -> Optional[float]:
+        return self.samples.get((name, frozenset(
+            (k, str(v)) for k, v in labels.items()
+        )))
+
+    def family(self, name: str) -> Dict[LabelSet, float]:
+        """Every sample of one metric name, keyed by label set."""
+        return {
+            ls: v for (n, ls), v in self.samples.items() if n == name
+        }
+
+    def histogram_buckets(self, name: str, **labels):
+        """Sorted ``[(le_float, cumulative_count)]`` for one histogram
+        cell (``le`` excluded from the matching labels)."""
+        want = {(k, str(v)) for k, v in labels.items()}
+        out = []
+        for ls, v in self.family(name + "_bucket").items():
+            d = dict(ls)
+            le = d.pop("le", None)
+            if le is None or set(d.items()) != want:
+                continue
+            out.append((float("inf") if le == "+Inf" else float(le), v))
+        out.sort(key=lambda p: p[0])
+        return out
+
+    def histogram_quantile(self, name: str, q: float,
+                           **labels) -> Optional[float]:
+        """Bucket-interpolated quantile from an exposed histogram (the
+        PromQL ``histogram_quantile`` estimate)."""
+        buckets = self.histogram_buckets(name, **labels)
+        if not buckets or buckets[-1][1] <= 0:
+            return None
+        total = buckets[-1][1]
+        rank = q * total
+        prev_le, prev_cum = 0.0, 0.0
+        for le, cum in buckets:
+            if cum >= rank:
+                if le == float("inf"):
+                    return prev_le
+                c = cum - prev_cum
+                frac = (rank - prev_cum) / c if c > 0 else 1.0
+                return prev_le + (le - prev_le) * min(max(frac, 0.0), 1.0)
+            prev_le, prev_cum = le, cum
+        return prev_le
+
+
+def _unescape(s: str) -> str:
+    out = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(s: str) -> LabelSet:
+    """``a="b",c="d"`` (already stripped of braces) → label set."""
+    pairs = []
+    i = 0
+    while i < len(s):
+        eq = s.index("=", i)
+        name = s[i:eq].strip().lstrip(",").strip()
+        assert s[eq + 1] == '"', f"unquoted label value near {s[i:]!r}"
+        j = eq + 2
+        buf = []
+        while s[j] != '"':
+            if s[j] == "\\":
+                buf.append(s[j:j + 2])
+                j += 2
+            else:
+                buf.append(s[j])
+                j += 1
+        pairs.append((name, _unescape("".join(buf))))
+        i = j + 1
+    return frozenset(pairs)
+
+
+def parse_prometheus_text(text: str) -> ParsedMetrics:
+    out = ParsedMetrics()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "HELP":
+                out.helps[parts[2]] = _unescape(parts[3])
+            elif len(parts) >= 4 and parts[1] == "TYPE":
+                out.types[parts[2]] = parts[3]
+            continue
+        # sample: name[{labels}] value [timestamp]
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            labels_str, rest = rest.rsplit("}", 1)
+            labels = _parse_labels(labels_str)
+        else:
+            name, rest = line.split(None, 1)
+            labels = frozenset()
+        value_str = rest.split()[0]
+        value = (
+            float("inf") if value_str == "+Inf"
+            else float("-inf") if value_str == "-Inf"
+            else float(value_str)
+        )
+        out.samples[(name.strip(), labels)] = value
+    return out

@@ -1,0 +1,117 @@
+"""Fixed-layout mmapped metrics segment for SO_REUSEPORT pool serving.
+
+Problem: each pool worker is its own process, so a Prometheus scrape
+(kernel-balanced to ONE listener) used to see one worker's shard of the
+counters — silently underreporting QPS by the worker count.
+
+Solution: the pool supervisor creates one small file of fixed layout;
+every worker mmaps it and mirrors its counter/histogram-bucket cells
+into its OWN per-worker stripe (single writer per stripe — no cross-
+process locking needed; float64 slot writes are naturally aligned).
+Reading a pool-wide total sums the slot across stripes. Works with the
+``spawn`` multiprocessing context because workers reopen by path.
+
+Layout (little-endian)::
+
+    0   8s  magic  b"PIOMETR1"
+    8   I   n_workers
+    12  I   slots_per_worker
+    16  16x reserved
+    32  n_workers stripes of slots_per_worker float64 each
+
+Torn reads are possible in theory (a reader may catch a stripe between
+two writes of one histogram observe) — acceptable for monitoring: every
+individual slot is written atomically, so counters are never garbage,
+and bucket counts lag each other by at most one in-flight observation.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+from typing import List
+
+MAGIC = b"PIOMETR1"
+HEADER_BYTES = 32
+#: default stripe width — the query server's pool-bound families
+#: (request/error counters + four stage histogram cells + latency
+#: histogram) need ~120 slots; 256 leaves headroom for growth
+DEFAULT_SLOTS = 256
+
+
+class PoolMetricsSegment:
+    """One mmapped metrics file; create in the supervisor, open in
+    every worker (and in the supervisor for debugging)."""
+
+    def __init__(self, path: str, n_workers: int, slots_per_worker: int,
+                 _file=None, _map=None):
+        self.path = path
+        self.n_workers = n_workers
+        self.slots_per_worker = slots_per_worker
+        self._f = _file
+        self._m = _map
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    def create(cls, path: str, n_workers: int,
+               slots_per_worker: int = DEFAULT_SLOTS) -> "PoolMetricsSegment":
+        if n_workers < 1 or slots_per_worker < 1:
+            raise ValueError("n_workers and slots_per_worker must be >= 1")
+        size = HEADER_BYTES + n_workers * slots_per_worker * 8
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            f.write(struct.pack("<II", n_workers, slots_per_worker))
+            f.write(b"\0" * (size - 16))
+        return cls.open(path)
+
+    @classmethod
+    def open(cls, path: str) -> "PoolMetricsSegment":
+        f = open(path, "r+b")
+        try:
+            head = f.read(HEADER_BYTES)
+            if len(head) < HEADER_BYTES or head[:8] != MAGIC:
+                raise ValueError(f"{path}: not a pool metrics segment")
+            n_workers, slots = struct.unpack_from("<II", head, 8)
+            size = HEADER_BYTES + n_workers * slots * 8
+            m = mmap.mmap(f.fileno(), size)
+        except BaseException:
+            f.close()
+            raise
+        return cls(path, n_workers, slots, _file=f, _map=m)
+
+    def close(self) -> None:
+        if self._m is not None:
+            self._m.close()
+            self._m = None
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def unlink(self) -> None:
+        self.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # -- slots -------------------------------------------------------------
+    def _off(self, worker_idx: int, slot: int) -> int:
+        if not (0 <= worker_idx < self.n_workers):
+            raise IndexError(f"worker {worker_idx} of {self.n_workers}")
+        if not (0 <= slot < self.slots_per_worker):
+            raise IndexError(f"slot {slot} of {self.slots_per_worker}")
+        return HEADER_BYTES + (worker_idx * self.slots_per_worker + slot) * 8
+
+    def set(self, worker_idx: int, slot: int, v: float) -> None:
+        struct.pack_into("<d", self._m, self._off(worker_idx, slot), v)
+
+    def read(self, worker_idx: int, slot: int) -> float:
+        return struct.unpack_from("<d", self._m, self._off(worker_idx, slot))[0]
+
+    def sum_slot(self, slot: int) -> float:
+        """Pool-wide total: the slot summed over every worker stripe."""
+        return sum(self.read(w, slot) for w in range(self.n_workers))
+
+    def read_all(self, slot: int) -> List[float]:
+        return [self.read(w, slot) for w in range(self.n_workers)]

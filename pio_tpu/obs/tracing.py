@@ -1,0 +1,192 @@
+"""Per-request stage tracing: context-manager spans over the monotonic
+clock, a ring buffer of recent traces, and per-stage histograms.
+
+One :class:`Tracer` per instrumented path (query serving, event ingest,
+training). Usage::
+
+    tracer = Tracer("query", registry=reg, stages=("parse", "execute"))
+    with tracer.trace("query") as tr:
+        with tr.span("parse"):
+            ...
+        tr.add_span("queue", measured_elsewhere_s)   # injected timing
+
+Every finished span feeds the ``<name>_stage_seconds{stage=...}``
+histogram; every finished trace lands in a bounded ring surfaced as
+``GET /traces.json`` (slowest-first), so "where did this query's
+milliseconds go" has a first-class answer instead of ad-hoc prints.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from pio_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    monotonic_s,
+)
+
+
+class Trace:
+    """One finished (or in-flight) request: ordered spans + metadata."""
+
+    __slots__ = ("trace_id", "kind", "wall_time", "t0", "total_s",
+                 "spans", "meta", "error")
+
+    def __init__(self, trace_id: str, kind: str):
+        self.trace_id = trace_id
+        self.kind = kind
+        self.wall_time = time.time()
+        self.t0 = monotonic_s()
+        self.total_s: Optional[float] = None
+        self.spans: List[Tuple[str, float, float]] = []  # (stage, rel_s, dur)
+        self.meta: Dict[str, object] = {}
+        self.error = False
+
+    def add_span(self, stage: str, dur_s: float,
+                 rel_start_s: Optional[float] = None) -> None:
+        if rel_start_s is None:
+            rel_start_s = monotonic_s() - self.t0 - dur_s
+        self.spans.append((stage, max(rel_start_s, 0.0), dur_s))
+
+    def note(self, **meta) -> None:
+        self.meta.update(meta)
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.trace_id,
+            "kind": self.kind,
+            "wallTime": self.wall_time,
+            "totalMs": (
+                round(self.total_s * 1e3, 3)
+                if self.total_s is not None else None
+            ),
+            "error": self.error,
+            "spans": [
+                {
+                    "stage": stage,
+                    "startMs": round(rel * 1e3, 3),
+                    "durMs": round(dur * 1e3, 3),
+                }
+                for stage, rel, dur in self.spans
+            ],
+            **({"meta": self.meta} if self.meta else {}),
+        }
+
+
+class _TraceHandle:
+    """What ``tracer.trace(...)`` yields: span recording for one request."""
+
+    __slots__ = ("_tracer", "_trace")
+
+    def __init__(self, tracer: "Tracer", trace: Trace):
+        self._tracer = tracer
+        self._trace = trace
+
+    @contextmanager
+    def span(self, stage: str):
+        t0 = monotonic_s()
+        try:
+            yield
+        finally:
+            dur = monotonic_s() - t0
+            self.add_span(stage, dur, rel_start_s=t0 - self._trace.t0)
+
+    def add_span(self, stage: str, dur_s: float,
+                 rel_start_s: Optional[float] = None) -> None:
+        """Record a span measured elsewhere (e.g. queue wait computed by
+        the micro-batch worker thread)."""
+        self._trace.add_span(stage, dur_s, rel_start_s)
+        self._tracer._observe(stage, dur_s)
+
+    def note(self, **meta) -> None:
+        self._trace.note(**meta)
+
+    def mark_error(self) -> None:
+        self._trace.error = True
+
+
+class Tracer:
+    """Stage tracer for one instrumented path."""
+
+    def __init__(self, name: str,
+                 registry: Optional[MetricsRegistry] = None,
+                 stages: Sequence[str] = (),
+                 extra_labels: Optional[Dict[str, str]] = None,
+                 ring: int = 128,
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        self.name = name
+        self._lock = threading.Lock()
+        self._ring_cap = ring
+        self._ring: List[Trace] = []
+        self._pos = 0
+        self._n = 0
+        self._extra = dict(extra_labels or {})
+        self._hist = None
+        if registry is not None:
+            labelnames = tuple(self._extra) + ("stage",)
+            self._hist = registry.histogram(
+                f"pio_{name}_stage_seconds",
+                f"Per-stage wall seconds of the {name} path",
+                labelnames,
+                buckets=buckets,
+            )
+            # pre-create the declared stage cells so pool-mode binding
+            # (registration-order slot layout) sees them at init time
+            for stage in stages:
+                self._hist.labels(*(tuple(self._extra.values()) + (stage,)))
+
+    def _observe(self, stage: str, dur_s: float) -> None:
+        if self._hist is not None:
+            self._hist.labels(
+                *(tuple(self._extra.values()) + (stage,))
+            ).observe(dur_s)
+
+    @contextmanager
+    def trace(self, kind: Optional[str] = None, **meta):
+        with self._lock:
+            self._n += 1
+            trace_id = f"{self.name}-{self._n}"
+        t = Trace(trace_id, kind or self.name)
+        if meta:
+            t.meta.update(meta)
+        handle = _TraceHandle(self, t)
+        try:
+            yield handle
+        except BaseException:
+            t.error = True
+            raise
+        finally:
+            t.total_s = monotonic_s() - t.t0
+            with self._lock:
+                if len(self._ring) < self._ring_cap:
+                    self._ring.append(t)
+                else:
+                    self._ring[self._pos] = t
+                    self._pos = (self._pos + 1) % self._ring_cap
+
+    # -- inspection --------------------------------------------------------
+    @property
+    def stage_histogram(self):
+        """The ``pio_<name>_stage_seconds`` histogram (None when the
+        tracer was built without a registry)."""
+        return self._hist
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def recent(self, n: int = 20, slowest: bool = True) -> List[dict]:
+        """The ring's traces as dicts — slowest-first by default (the
+        debugging question is "what were the worst recent requests")."""
+        with self._lock:
+            traces = [t for t in self._ring if t.total_s is not None]
+        traces.sort(
+            key=(lambda t: t.total_s) if slowest
+            else (lambda t: t.wall_time),
+            reverse=True,
+        )
+        return [t.to_dict() for t in traces[:n]]

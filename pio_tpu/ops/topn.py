@@ -32,7 +32,7 @@ from __future__ import annotations
 import ctypes
 import functools
 import os
-import time
+from pio_tpu.obs import monotonic_s
 from typing import Optional, Tuple
 
 import numpy as np
@@ -133,9 +133,9 @@ def _probe_link_rtt_s() -> float:
     jax.device_get(jax.device_put(x))  # warm the path
     best = float("inf")
     for _ in range(3):
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         jax.device_get(jax.device_put(x))
-        best = min(best, time.perf_counter() - t0)
+        best = min(best, monotonic_s() - t0)
     return best
 
 
@@ -233,9 +233,9 @@ class DeviceTopNScorer:
     def _probe_host_row_s(self) -> float:
         best = float("inf")
         for _ in range(3):
-            t0 = time.perf_counter()
+            t0 = monotonic_s()
             self._rows_np[0] @ self._cols_np.T
-            best = min(best, time.perf_counter() - t0)
+            best = min(best, monotonic_s() - t0)
         return max(best, 1e-7)
 
     def _route_to_device(self, batch: int) -> bool:

@@ -10,7 +10,11 @@ Routes:
 - ``GET /``                      — HTML table of completed evaluations;
 - ``GET /instances.json``        — same data as JSON;
 - ``GET /instances/<id>.json``   — one instance incl. full evaluator results;
-- ``GET /instances/<id>.html``   — the instance's stored HTML report.
+- ``GET /instances/<id>.html``   — the instance's stored HTML report;
+- ``GET /serving.html``          — live serving view: pool-wide request
+  totals + per-stage latency table scraped from a query server's
+  ``/metrics`` (ISSUE 1 observability surface);
+- ``GET /metrics``               — the dashboard's own scrape endpoint.
 
 All responses carry ``Access-Control-Allow-Origin: *`` (reference
 ``CorsSupport``).
@@ -20,8 +24,10 @@ from __future__ import annotations
 
 import html as _html
 import json
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
+from pio_tpu.obs import MetricsRegistry
+from pio_tpu.obs.promparse import ParsedMetrics, parse_prometheus_text
 from pio_tpu.server.http import JsonHTTPServer, RawResponse, Request, Router
 from pio_tpu.storage import RunStatus, Storage
 
@@ -46,19 +52,32 @@ def _instance_summary(inst) -> dict:
 
 
 class DashboardService:
-    """≙ reference ``DashboardService`` routes."""
+    """≙ reference ``DashboardService`` routes (+ the serving view)."""
 
-    def __init__(self):
+    def __init__(self, query_url: str = "http://127.0.0.1:8000"):
+        #: base URL of the query server (or any pool worker — in pool
+        #: mode every worker's /metrics reports pool-wide totals) whose
+        #: serving metrics /serving.html renders
+        self.query_url = query_url.rstrip("/")
+        self.obs = MetricsRegistry()
+        self._pageviews = self.obs.counter(
+            "pio_dashboard_pageviews_total",
+            "Dashboard page renders",
+            ("page",),
+        )
         self.router = Router()
         self.router.add("GET", "/", self.index)
         self.router.add("GET", "/instances\\.json", self.list_json)
         self.router.add("GET", "/instances/([^/]+)\\.json", self.get_json)
         self.router.add("GET", "/instances/([^/]+)\\.html", self.get_html)
+        self.router.add("GET", "/serving\\.html", self.serving)
+        self.router.add("GET", "/metrics", self.get_metrics)
 
     def _completed(self):
         return Storage.get_meta_data_evaluation_instances().get_completed()
 
     def index(self, req: Request) -> Tuple[int, Any]:
+        self._pageviews.inc(page="index")
         rows = []
         for i in self._completed():
             rows.append(
@@ -77,6 +96,7 @@ class DashboardService:
             "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
             "padding:.4em .8em;text-align:left}</style></head><body>"
             "<h1>Evaluation Dashboard</h1>"
+            "<p><a href='/serving.html'>serving metrics</a></p>"
             "<table><tr><th>Instance</th><th>Evaluation</th><th>Start</th>"
             "<th>End</th><th>Result</th></tr>"
             + "".join(rows)
@@ -112,10 +132,109 @@ class DashboardService:
         )
         return 200, _html_response(body)
 
+    # -- serving observability (ISSUE 1) ------------------------------------
+    def get_metrics(self, req: Request) -> Tuple[int, Any]:
+        from pio_tpu.server.metrics import render
+
+        return 200, render(self.obs.render())
+
+    def _scrape_query_server(self) -> Tuple[Optional[ParsedMetrics],
+                                            Optional[dict], str]:
+        """(parsed /metrics, / status JSON, error message)."""
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                self.query_url + "/metrics", timeout=3.0
+            ) as r:
+                pm = parse_prometheus_text(r.read().decode("utf-8"))
+            with urllib.request.urlopen(self.query_url + "/", timeout=3.0) as r:
+                status = json.loads(r.read().decode("utf-8"))
+            return pm, status, ""
+        except Exception as e:
+            return None, None, f"{type(e).__name__}: {e}"
+
+    def serving(self, req: Request) -> Tuple[int, Any]:
+        """Live serving view: pool-wide request totals + avg QPS since
+        deploy and a per-stage latency table, from one scrape of the
+        query server (any pool worker answers with pool-wide sums)."""
+        self._pageviews.inc(page="serving")
+        url = req.params.get("url") or self.query_url
+        if url != self.query_url:
+            self.query_url = url.rstrip("/")
+        pm, status, err = self._scrape_query_server()
+        head = (
+            "<!doctype html><html><head><title>pio-tpu serving</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+            "padding:.4em .8em;text-align:right}th,td:first-child"
+            "{text-align:left}</style></head><body>"
+            "<h1>Serving</h1>"
+            f"<p>scraping <code>{_html.escape(self.query_url)}"
+            "/metrics</code> (override with ?url=)</p>"
+        )
+        if pm is None:
+            return 200, _html_response(
+                head + f"<p>scrape failed: {_html.escape(err)}</p>"
+                "</body></html>"
+            )
+        total = sum(pm.family("pio_queries_total").values())
+        errors = sum(pm.family("pio_query_errors_total").values())
+        qps = None
+        if status and status.get("startTime"):
+            import datetime as _dt
+
+            try:
+                t0 = _dt.datetime.fromisoformat(status["startTime"])
+                up = (_dt.datetime.now(_dt.timezone.utc) - t0).total_seconds()
+                if up > 0:
+                    qps = total / up
+            except ValueError:
+                pass
+        summary = (
+            "<table><tr><th>requests</th><th>errors</th>"
+            "<th>avg QPS since deploy</th></tr>"
+            f"<tr><td>{int(total)}</td><td>{int(errors)}</td>"
+            f"<td>{f'{qps:.2f}' if qps is not None else 'n/a'}</td></tr>"
+            "</table>"
+        )
+        # per-stage latency table from the stage histograms (pool-wide)
+        stages: dict = {}
+        for ls, count in pm.family("pio_query_stage_seconds_count").items():
+            d = dict(ls)
+            stage = d.get("stage", "?")
+            total_s = pm.value("pio_query_stage_seconds_sum", **d) or 0.0
+            row = {
+                "count": int(count),
+                "avgMs": (total_s / count * 1e3) if count else None,
+            }
+            for col, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+                v = pm.histogram_quantile("pio_query_stage_seconds", q, **d)
+                row[col] = v * 1e3 if v is not None else None
+            stages[stage] = row
+        fmt = lambda v: f"{v:.3f}" if v is not None else "n/a"
+        stage_rows = "".join(
+            f"<tr><td>{_html.escape(stage)}</td><td>{r['count']}</td>"
+            f"<td>{fmt(r['avgMs'])}</td><td>{fmt(r['p50'])}</td>"
+            f"<td>{fmt(r['p95'])}</td><td>{fmt(r['p99'])}</td></tr>"
+            for stage, r in sorted(stages.items())
+        )
+        stage_table = (
+            "<h2>Per-stage latency (ms)</h2>"
+            "<table><tr><th>stage</th><th>count</th><th>avg</th>"
+            "<th>p50</th><th>p95</th><th>p99</th></tr>"
+            + (stage_rows or "<tr><td colspan='6'>no observations</td></tr>")
+            + "</table>"
+        )
+        return 200, _html_response(
+            head + summary + stage_table + "</body></html>"
+        )
+
 
 def create_dashboard(
-    host: str = "0.0.0.0", port: int = 9000
+    host: str = "0.0.0.0", port: int = 9000,
+    query_url: str = "http://127.0.0.1:8000",
 ) -> JsonHTTPServer:
     """Build (unstarted) dashboard — reference ``Dashboard.main``."""
-    service = DashboardService()
+    service = DashboardService(query_url=query_url)
     return JsonHTTPServer(service.router, host, port, name="pio-tpu-dashboard")

@@ -23,10 +23,11 @@ from __future__ import annotations
 import datetime as _dt
 import logging
 import threading
-import time
+from contextlib import nullcontext
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from pio_tpu.data.event import Event, EventValidationError
+from pio_tpu.obs import MetricsRegistry, RequestWindow, Tracer, monotonic_s
 from pio_tpu.server.http import HTTPError, JsonHTTPServer, Request, Router
 from pio_tpu.server.webhooks import (
     FORM_CONNECTORS,
@@ -45,12 +46,24 @@ MAX_BATCH = 50
 INPUT_BLOCKERS: List[Callable] = []
 INPUT_SNIFFERS: List[Callable] = []
 
+#: ingest-path trace stages, in request order (ISSUE 1): JSON → Event
+#: binding, whitelist + input blockers, storage insert/group-commit
+EVENT_STAGES = ("parse", "validate", "store")
+
+
+def _ms(v):
+    """Seconds → rounded milliseconds (None passes through)."""
+    return round(v * 1e3, 3) if v is not None else None
+
 
 class _Stats:
-    """Rolling per-app counters (reference ``Stats``/``StatsActor``)."""
+    """Rolling per-app counters (reference ``Stats``/``StatsActor``),
+    optionally mirrored into an obs Counter so ``/metrics`` exposition
+    and the JSON stats can never disagree."""
 
-    def __init__(self):
+    def __init__(self, counter=None):
         self._lock = threading.Lock()
+        self._counter = counter
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
         # (app_id, event, entity_type, status) -> count
         self.counts: Dict[Tuple[int, str, str, int], int] = {}
@@ -59,6 +72,11 @@ class _Stats:
         with self._lock:
             key = (app_id, event, entity_type, status)
             self.counts[key] = self.counts.get(key, 0) + 1
+        if self._counter is not None:
+            self._counter.inc(
+                app_id=str(app_id), event=event,
+                entity_type=entity_type, status=str(status),
+            )
 
     def to_dict(self) -> dict:
         with self._lock:
@@ -79,26 +97,6 @@ class _Stats:
                 for app_id, counts in by_app.items()
             ],
         }
-
-    def to_prometheus(self) -> list:
-        """Prometheus exposition lines for the ingest counters (scrapeable
-        observability — an upgrade over the reference's JSON-only stats)."""
-        from pio_tpu.server.metrics import escape_label
-
-        lines = [
-            "# HELP pio_events_ingested_total Events by app/event/status",
-            "# TYPE pio_events_ingested_total counter",
-        ]
-        with self._lock:
-            items = sorted(self.counts.items())
-        for (app_id, event, etype, status), n in items:
-            lines.append(
-                "pio_events_ingested_total{"
-                f'app_id="{app_id}",event="{escape_label(event)}",'
-                f'entity_type="{escape_label(etype)}",status="{status}"'
-                f"}} {n}"
-            )
-        return lines
 
 
 def _parse_limit(params) -> Optional[int]:
@@ -126,7 +124,17 @@ class EventServerService:
     AUTH_CACHE_TTL_S = 2.0
 
     def __init__(self):
-        self.stats = _Stats()
+        #: per-instance registry — see query_server (test servers must
+        #: not cross-pollinate scrapes through a process global)
+        self.obs = MetricsRegistry()
+        self._events_counter = self.obs.counter(
+            "pio_events_ingested_total",
+            "Events by app/event/status",
+            ("app_id", "event", "entity_type", "status"),
+        )
+        self.tracer = Tracer("event", registry=self.obs, stages=EVENT_STAGES)
+        self.req_window = RequestWindow()
+        self.stats = _Stats(counter=self._events_counter)
         self._auth_cache: dict = {}
         self._auth_gen = 0  # bumped by invalidation; fences re-caching
         self._auth_cache_lock = threading.Lock()
@@ -144,6 +152,7 @@ class EventServerService:
         r.add("POST", "/batch/events\\.json", self.batch_events)
         r.add("GET", "/stats\\.json", self.get_stats)
         r.add("GET", "/metrics", self.get_metrics)
+        r.add("GET", "/traces\\.json", self.get_traces)
         r.add("POST", "/webhooks/([^/]+)\\.json", self.webhook_json)
         r.add("POST", "/webhooks/([^/]+)\\.form", self.webhook_form)
         r.add("GET", "/plugins\\.json", self.list_plugins)
@@ -165,7 +174,7 @@ class EventServerService:
         key = req.bearer_key()
         if not key:
             raise HTTPError(401, "missing accessKey")
-        now = time.monotonic()
+        now = monotonic_s()
         with self._auth_cache_lock:
             hit = self._auth_cache.get(key)
             gen = self._auth_gen
@@ -202,18 +211,22 @@ class EventServerService:
     def alive(self, req: Request):
         return 200, {"status": "alive"}
 
-    def _validate_one(self, d: Any, app_id: int, channel_id, whitelist):
+    def _validate_one(self, d: Any, app_id: int, channel_id, whitelist,
+                      tr=None):
         """JSON → validated Event (whitelist + input blockers applied)."""
-        if not isinstance(d, dict):
-            raise EventValidationError("event must be a JSON object")
-        event = Event.from_api_dict(d)
-        self._check_whitelist(event.event, whitelist)
-        for blocker in INPUT_BLOCKERS:
-            try:
-                blocker(app_id, channel_id, d)
-            except ValueError as e:
-                # input blockers veto with ValueError → client 400
-                raise EventValidationError(str(e))
+        sp = tr.span if tr is not None else (lambda stage: nullcontext())
+        with sp("parse"):
+            if not isinstance(d, dict):
+                raise EventValidationError("event must be a JSON object")
+            event = Event.from_api_dict(d)
+        with sp("validate"):
+            self._check_whitelist(event.event, whitelist)
+            for blocker in INPUT_BLOCKERS:
+                try:
+                    blocker(app_id, channel_id, d)
+                except ValueError as e:
+                    # input blockers veto with ValueError → client 400
+                    raise EventValidationError(str(e))
         return event
 
     def _post_ingest(self, d: Any, event: Event, app_id: int, channel_id):
@@ -224,20 +237,33 @@ class EventServerService:
                 log.exception("input sniffer failed")
         self.stats.tick(app_id, event.event, event.entity_type, 201)
 
-    def _ingest_one(self, d: Any, app_id: int, channel_id, whitelist) -> str:
-        event = self._validate_one(d, app_id, channel_id, whitelist)
-        event_id = Storage.get_levents().insert(event, app_id, channel_id)
+    def _ingest_one(self, d: Any, app_id: int, channel_id, whitelist,
+                    tr=None) -> str:
+        event = self._validate_one(d, app_id, channel_id, whitelist, tr)
+        sp = tr.span if tr is not None else (lambda stage: nullcontext())
+        with sp("store"):
+            event_id = Storage.get_levents().insert(event, app_id, channel_id)
         self._post_ingest(d, event, app_id, channel_id)
         return event_id
 
     def create_event(self, req: Request):
         app_id, channel_id, whitelist = self._auth(req)
+        t0 = monotonic_s()
+        error = True
         try:
-            event_id = self._ingest_one(req.body, app_id, channel_id, whitelist)
-        except EventValidationError as e:
-            self.stats.tick(app_id, "<invalid>", "<invalid>", 400)
-            return 400, {"message": str(e)}
-        return 201, {"eventId": event_id}
+            with self.tracer.trace("event") as tr:
+                try:
+                    event_id = self._ingest_one(
+                        req.body, app_id, channel_id, whitelist, tr
+                    )
+                except EventValidationError as e:
+                    tr.mark_error()
+                    self.stats.tick(app_id, "<invalid>", "<invalid>", 400)
+                    return 400, {"message": str(e)}
+                error = False
+                return 201, {"eventId": event_id}
+        finally:
+            self.req_window.record((monotonic_s() - t0) * 1e3, error)
 
     def batch_events(self, req: Request):
         app_id, channel_id, whitelist = self._auth(req)
@@ -247,22 +273,39 @@ class EventServerService:
             return 400, {
                 "message": f"batch size {len(req.body)} exceeds {MAX_BATCH}"
             }
+        t0 = monotonic_s()
+        error = True
+        try:
+            with self.tracer.trace("batch", batchSize=len(req.body)) as tr:
+                out = self._batch_events(
+                    req, app_id, channel_id, whitelist, tr
+                )
+                error = False
+                return out
+        finally:
+            self.req_window.record((monotonic_s() - t0) * 1e3, error)
+
+    def _batch_events(self, req, app_id, channel_id, whitelist, tr):
         # validate every item first (per-item status contract), then land
         # the valid ones in ONE bulk storage write (insert_batch — a
         # single transaction/commit on backends that support it)
         results: list = [None] * len(req.body)
         valid = []
-        for k, d in enumerate(req.body):
-            try:
-                event = self._validate_one(d, app_id, channel_id, whitelist)
-                valid.append((k, d, event))
-            except (EventValidationError, HTTPError) as e:
-                status = e.status if isinstance(e, HTTPError) else 400
-                results[k] = {"status": status, "message": str(e)}
+        with tr.span("validate"):
+            for k, d in enumerate(req.body):
+                try:
+                    event = self._validate_one(
+                        d, app_id, channel_id, whitelist
+                    )
+                    valid.append((k, d, event))
+                except (EventValidationError, HTTPError) as e:
+                    status = e.status if isinstance(e, HTTPError) else 400
+                    results[k] = {"status": status, "message": str(e)}
         if valid:
-            ids = Storage.get_levents().insert_batch(
-                [e for _, _, e in valid], app_id, channel_id
-            )
+            with tr.span("store"):
+                ids = Storage.get_levents().insert_batch(
+                    [e for _, _, e in valid], app_id, channel_id
+                )
             if len(ids) != len(valid):  # a broken backend override must
                 # surface as per-item errors, not nulls in the response
                 log.error(
@@ -353,12 +396,57 @@ class EventServerService:
         return 200, installed_plugins()
 
     def get_stats(self, req: Request):
-        return 200, self.stats.to_dict()
+        """Per-app counters (reference shape) PLUS the query-server
+        parity block: request count/errors and latency percentiles for
+        the ingest write path; ``?window=SECONDS`` narrows to the
+        trailing window (reservoir-backed, like the query server)."""
+        try:
+            window_s = float(req.params.get("window", "0"))
+        except (TypeError, ValueError):
+            window_s = 0.0
+        if window_s > 0:
+            return 200, self.req_window.window(window_s)
+        out = self.stats.to_dict()
+        out.update(self.req_window.to_dict())
+        stages = self._stage_summary()
+        if stages:
+            out["stages"] = stages
+        return 200, out
+
+    def _stage_summary(self) -> dict:
+        hist = self.tracer.stage_histogram
+        out = {}
+        if hist is None:
+            return out
+        for stage in EVENT_STAGES:
+            cell = hist.labels(stage)
+            n = cell.count
+            if n <= 0:
+                continue
+            q = lambda f: cell.quantile(f)
+            out[stage] = {
+                "count": int(n),
+                "avgMs": round(cell.sum / n * 1e3, 3),
+                "p50Ms": _ms(q(0.5)),
+                "p95Ms": _ms(q(0.95)),
+                "p99Ms": _ms(q(0.99)),
+            }
+        return out
 
     def get_metrics(self, req: Request):
         from pio_tpu.server.metrics import render
 
-        return 200, render(self.stats.to_prometheus())
+        return 200, render(self.obs.render())
+
+    def get_traces(self, req: Request):
+        try:
+            n = int(req.params.get("n", "20"))
+        except (TypeError, ValueError):
+            n = 20
+        order = req.params.get("order", "slowest")
+        return 200, {
+            "traces": self.tracer.recent(n, slowest=(order != "recent")),
+        }
 
     def webhook_json(self, req: Request):
         app_id, channel_id, whitelist = self._auth(req)
@@ -367,12 +455,22 @@ class EventServerService:
             return 404, {"message": f"no JSON connector {req.path_args[0]!r}"}
         if req.body is not None and not isinstance(req.body, dict):
             return 400, {"message": "webhook payload must be a JSON object"}
+        t0 = monotonic_s()
+        error = True
         try:
-            d = connector.to_event_dict(req.body or {})
-            event_id = self._ingest_one(d, app_id, channel_id, whitelist)
-        except (ConnectorError, EventValidationError) as e:
-            return 400, {"message": str(e)}
-        return 201, {"eventId": event_id}
+            with self.tracer.trace("webhook") as tr:
+                try:
+                    d = connector.to_event_dict(req.body or {})
+                    event_id = self._ingest_one(
+                        d, app_id, channel_id, whitelist, tr
+                    )
+                except (ConnectorError, EventValidationError) as e:
+                    tr.mark_error()
+                    return 400, {"message": str(e)}
+                error = False
+                return 201, {"eventId": event_id}
+        finally:
+            self.req_window.record((monotonic_s() - t0) * 1e3, error)
 
     def webhook_form(self, req: Request):
         app_id, channel_id, whitelist = self._auth(req)
@@ -384,12 +482,22 @@ class EventServerService:
             if req.raw_body
             else ""
         )
+        t0 = monotonic_s()
+        error = True
         try:
-            d = connector.to_event_dict(form)
-            event_id = self._ingest_one(d, app_id, channel_id, whitelist)
-        except (ConnectorError, EventValidationError) as e:
-            return 400, {"message": str(e)}
-        return 201, {"eventId": event_id}
+            with self.tracer.trace("webhook") as tr:
+                try:
+                    d = connector.to_event_dict(form)
+                    event_id = self._ingest_one(
+                        d, app_id, channel_id, whitelist, tr
+                    )
+                except (ConnectorError, EventValidationError) as e:
+                    tr.mark_error()
+                    return 400, {"message": str(e)}
+                error = False
+                return 201, {"eventId": event_id}
+        finally:
+            self.req_window.record((monotonic_s() - t0) * 1e3, error)
 
 
 def create_event_server(

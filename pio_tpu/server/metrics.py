@@ -1,25 +1,26 @@
 """Prometheus text-exposition helpers for the HTTP servers.
 
-The reference exposes operational state as JSON only (`/stats.json` on the
-Event and Query servers — `data/api/Stats.scala`, `CreateServer.scala`,
-UNVERIFIED paths; SURVEY.md §5 observability row). This module adds the
-de-facto standard scrape format on top — ``GET /metrics`` on both servers —
-so the rebuild drops into Prometheus/Grafana stacks without an exporter
-sidecar. Counters only (no client library dependency); the text format is
-simple enough to emit directly.
+Since ISSUE 1 the real machinery lives in :mod:`pio_tpu.obs` — typed
+Counter/Gauge/Histogram families with ``# HELP``/``# TYPE`` exposition,
+per-stage histograms and pool-wide shared-memory aggregation. This
+module remains as the thin HTTP-facing shim: ``render`` wraps exposition
+lines in the proper scrape content type, and ``escape_label`` stays as a
+compatibility wrapper over the obs escaping helpers (existing plugins
+import it from here).
 """
 
 from __future__ import annotations
 
+from pio_tpu.obs.metrics import escape_help, escape_label_value
+
+#: Prometheus scrape content type (text format 0.0.4).
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
 
 def escape_label(value: str) -> str:
-    """Escape a label value per the Prometheus text format."""
-    return (
-        str(value)
-        .replace("\\", "\\\\")
-        .replace('"', '\\"')
-        .replace("\n", "\\n")
-    )
+    """Escape a label value per the Prometheus text format
+    (compatibility wrapper over :func:`pio_tpu.obs.escape_label_value`)."""
+    return escape_label_value(value)
 
 
 def render(lines: list) -> "object":
@@ -27,7 +28,7 @@ def render(lines: list) -> "object":
     uses) in the proper content type."""
     from pio_tpu.server.http import RawResponse
 
-    return RawResponse(
-        "\n".join(lines) + "\n",
-        content_type="text/plain; version=0.0.4; charset=utf-8",
-    )
+    return RawResponse("\n".join(lines) + "\n", content_type=CONTENT_TYPE)
+
+
+__all__ = ["CONTENT_TYPE", "escape_help", "escape_label", "render"]

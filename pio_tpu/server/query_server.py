@@ -30,6 +30,8 @@ from typing import Any, List, Optional, Tuple
 from pio_tpu.controller.engine import Engine, EngineParams
 from pio_tpu.controller.params import ParamsError, params_from_dict
 from pio_tpu.data.event import Event
+from pio_tpu.obs import MetricsRegistry, RequestWindow, Tracer, monotonic_s
+from pio_tpu.obs.profile import DeviceProfileHook
 from pio_tpu.parallel.context import ComputeContext
 from pio_tpu.server.http import (
     HTTPError, JsonHTTPServer, Request, Router, keys_equal,
@@ -53,40 +55,16 @@ QUERY_SNIFFERS: List = []
 #: runs the per-query fallback itself (see _MicroBatcher.submit)
 _BATCH_FAILED = object()
 
+#: query-path trace stages, in request order (ISSUE 1): JSON binding +
+#: serving.supplement, micro-batch queue wait, device/model execute,
+#: response serialization (to_jsonable + hooks + feedback)
+QUERY_STAGES = ("parse", "queue", "execute", "serialize")
 
 
-
-class _LatencyStats:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.count = 0
-        self.errors = 0
-        self.total_ms = 0.0
-        self.samples: List[float] = []  # bounded reservoir
-
-    def record(self, ms: float, error: bool):
-        with self._lock:
-            self.count += 1
-            if error:
-                self.errors += 1
-            self.total_ms += ms
-            if len(self.samples) < 10000:
-                self.samples.append(ms)
-            else:  # reservoir-ish: overwrite cyclically
-                self.samples[self.count % 10000] = ms
-
-    def to_dict(self) -> dict:
-        with self._lock:
-            xs = sorted(self.samples)
-            q = lambda f: xs[min(int(f * len(xs)), len(xs) - 1)] if xs else None
-            return {
-                "requestCount": self.count,
-                "errorCount": self.errors,
-                "avgMs": self.total_ms / self.count if self.count else None,
-                "p50Ms": q(0.50),
-                "p95Ms": q(0.95),
-                "p99Ms": q(0.99),
-            }
+def _q_ms(cell, q: float):
+    """Histogram-cell quantile in milliseconds (None when empty)."""
+    v = cell.quantile(q)
+    return round(v * 1e3, 3) if v is not None else None
 
 
 class _MicroBatcher:
@@ -151,21 +129,31 @@ class _MicroBatcher:
         )
         self._thread.start()
 
-    def submit(self, query):
+    def submit(self, query, span_sink=None):
         """Serve one query through the current regime; blocks until done.
         If the batch dispatch failed, the fallback per-query predict runs
         HERE — in the request's own thread — so one poisoned query
         degrades its batch-mates to ordinary concurrent serving, not to a
-        serial queue behind the single worker."""
+        serial queue behind the single worker.
+
+        ``span_sink`` (a trace handle with ``add_span``) receives the
+        queue-wait and execute stage timings measured where they actually
+        happen — the worker thread computes per-member queue wait at
+        drain time and the shared batch dispatch duration."""
         mode = self._mode
         if mode == "off" or mode == "probe_solo":
-            t0 = time.monotonic()
+            t0 = monotonic_s()
             out = self._service._predict_one(query)
+            dt = monotonic_s() - t0
+            if span_sink is not None:
+                span_sink.add_span("queue", 0.0)
+                span_sink.add_span("execute", dt)
             if mode == "probe_solo":
-                self._note_probe("solo", time.monotonic() - t0)
+                self._note_probe("solo", dt)
             return out
-        t0 = time.monotonic()
-        pend = [query, None, None, threading.Event()]  # q, result, exc, done
+        t0 = monotonic_s()
+        # q, result, exc, done, enqueue_t, stage timings (worker-filled)
+        pend = [query, None, None, threading.Event(), t0, {}]
         with self._cv:
             if self._stopped:
                 raise HTTPError(503, "undeployed")
@@ -173,9 +161,17 @@ class _MicroBatcher:
             self._cv.notify()
         pend[3].wait()
         if mode == "probe_batch":
-            self._note_probe("batch", time.monotonic() - t0)
+            self._note_probe("batch", monotonic_s() - t0)
+        if span_sink is not None and "queue_s" in pend[5]:
+            span_sink.add_span("queue", pend[5]["queue_s"])
         if pend[2] is _BATCH_FAILED:
-            return self._service._predict_one(pend[0])
+            t1 = monotonic_s()
+            out = self._service._predict_one(pend[0])
+            if span_sink is not None:
+                span_sink.add_span("execute", monotonic_s() - t1)
+            return out
+        if span_sink is not None and "execute_s" in pend[5]:
+            span_sink.add_span("execute", pend[5]["execute_s"])
         if pend[2] is not None:
             raise pend[2]
         return pend[1]
@@ -256,12 +252,20 @@ class _MicroBatcher:
             self.batches += 1
             self.batched_queries += len(batch)
             self.max_batch = max(self.max_batch, len(batch))
+            # stage attribution: everything before the drain is queue
+            # wait (per member — each enqueued at its own time), the
+            # shared dispatch below is each member's execute time
+            t_drain = monotonic_s()
+            for p in batch:
+                p[5]["queue_s"] = max(t_drain - p[4], 0.0)
             try:
                 results = self._service._predict_batch(
                     [p[0] for p in batch]
                 )
+                exec_s = monotonic_s() - t_drain
                 for p, r in zip(batch, results):
                     p[1] = r
+                    p[5]["execute_s"] = exec_s
             except Exception:
                 log.exception(
                     "micro-batch dispatch failed; per-query fallback "
@@ -293,7 +297,26 @@ class QueryServerService:
         #: may call them (the default bind is 0.0.0.0)
         self.admin_key = admin_key
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
-        self.stats = _LatencyStats()
+        #: per-instance registry (not the process-global one) so embedded
+        #: test servers never cross-pollinate each other's scrapes
+        self.obs = MetricsRegistry()
+        eng = variant.engine_id
+        self._queries_total = self.obs.counter(
+            "pio_queries_total", "Queries served", ("engine_id",)
+        )
+        self._query_errors_total = self.obs.counter(
+            "pio_query_errors_total", "Queries that errored", ("engine_id",)
+        )
+        # pre-create the cells so pool-mode slot layout sees them at init
+        self._queries_total.labels(eng)
+        self._query_errors_total.labels(eng)
+        self.tracer = Tracer(
+            "query", registry=self.obs, stages=QUERY_STAGES,
+            extra_labels={"engine_id": eng},
+        )
+        self.stats = RequestWindow()
+        self.obs.add_collector(self._compat_metric_lines)
+        self.profile_hook = DeviceProfileHook.from_env()
         self._swap_lock = threading.Lock()
         self._deployed = True
         #: pool mode (see server/worker_pool.py): shared reload generation
@@ -323,6 +346,7 @@ class QueryServerService:
         r.add("POST", "/queries\\.json", self.query)
         r.add("GET", "/stats\\.json", self.get_stats)
         r.add("GET", "/metrics", self.get_metrics)
+        r.add("GET", "/traces\\.json", self.get_traces)
         r.add("POST", "/reload", self.reload)
         r.add("POST", "/undeploy", self.undeploy)
         r.add("GET", "/plugins\\.json", self.list_plugins)
@@ -375,17 +399,35 @@ class QueryServerService:
 
         return 200, installed_plugins()
 
-    def enable_pool(self, idx: int, size: int, gen, shutdown_evt) -> None:
+    def enable_pool(self, idx: int, size: int, gen, shutdown_evt,
+                    metrics_path: Optional[str] = None) -> None:
         """Wire this worker into a serving pool: ``gen`` is a shared
         multiprocessing generation counter (a /reload on ANY worker bumps
         it; the others lazily reload before their next query), and
         ``shutdown_evt`` a shared event that /undeploy sets so the
-        supervisor brings the whole pool down."""
+        supervisor brings the whole pool down.
+
+        ``metrics_path`` points at the supervisor-created shared-memory
+        metrics segment; binding it makes ``GET /metrics`` on THIS worker
+        report pool-wide sums (the kernel balances scrape connections
+        across workers just like queries — without aggregation every
+        scrape would see 1/size of the traffic)."""
         self._pool_idx = idx
         self._pool_size = size
         self._pool_gen = gen
         self._pool_shutdown = shutdown_evt
         self._seen_gen = gen.value
+        if metrics_path:
+            from pio_tpu.obs.shm import PoolMetricsSegment
+
+            try:
+                seg = PoolMetricsSegment.open(metrics_path)
+                self.obs.bind_pool_segment(seg, idx)
+            except Exception:
+                log.exception(
+                    "pool metrics segment bind failed; this worker "
+                    "exposes local-only metrics"
+                )
 
     def _pool_sync(self) -> None:
         gen = self._pool_gen
@@ -401,45 +443,60 @@ class QueryServerService:
         if not self._deployed:
             raise HTTPError(503, "undeployed")
         self._pool_sync()
-        t0 = time.monotonic()
+        t0 = monotonic_s()
         error = True
+        eng = self.variant.engine_id
         try:
-            # one consistent snapshot — a concurrent /reload must not mix
-            # the old engine's query class with the new engine's models.
-            # (The micro-batch path re-snapshots in the worker; the batch
-            # is served from the worker-time snapshot.)
-            with self._swap_lock:
-                pairs, serving, qc = self.pairs, self.serving, self.query_class
-            query = self._parse_query(req.body, qc)
-            query = serving.supplement(query)
-            if self._batcher is not None and not self._batcher.bypassed:
-                result = self._batcher.submit(query)
-            else:
-                predictions = [algo.predict(m, query) for algo, m in pairs]
-                result = serving.serve(query, predictions)
-            out = _to_jsonable(result)
-            for blocker in QUERY_BLOCKERS:
-                try:
-                    # output blockers see (query, prediction) and veto the
-                    # response with ValueError → client 400
-                    blocker(req.body, out)
-                except ValueError as e:
-                    raise HTTPError(400, str(e))
-            pr_id = None
-            if self.feedback:
-                pr_id = uuid.uuid4().hex
-                if isinstance(out, dict):
-                    out = {**out, "prId": pr_id}
-                self._log_feedback(req.body, out, pr_id)
-            for sniffer in QUERY_SNIFFERS:
-                try:
-                    sniffer(req.body, out)
-                except Exception:
-                    log.exception("query sniffer failed")
-            error = False
-            return 200, out
+            with self.tracer.trace("query") as tr:
+                # one consistent snapshot — a concurrent /reload must not
+                # mix the old engine's query class with the new engine's
+                # models. (The micro-batch path re-snapshots in the
+                # worker; the batch is served from that snapshot.)
+                with self._swap_lock:
+                    pairs, serving, qc = (
+                        self.pairs, self.serving, self.query_class
+                    )
+                with tr.span("parse"):
+                    query = self._parse_query(req.body, qc)
+                    query = serving.supplement(query)
+                if self._batcher is not None and not self._batcher.bypassed:
+                    result = self._batcher.submit(query, span_sink=tr)
+                else:
+                    tr.add_span("queue", 0.0)
+                    with tr.span("execute"):
+                        with self.profile_hook.capture():
+                            predictions = [
+                                algo.predict(m, query) for algo, m in pairs
+                            ]
+                        result = serving.serve(query, predictions)
+                with tr.span("serialize"):
+                    out = _to_jsonable(result)
+                    for blocker in QUERY_BLOCKERS:
+                        try:
+                            # output blockers see (query, prediction) and
+                            # veto the response with ValueError → client 400
+                            blocker(req.body, out)
+                        except ValueError as e:
+                            raise HTTPError(400, str(e))
+                    pr_id = None
+                    if self.feedback:
+                        pr_id = uuid.uuid4().hex
+                        if isinstance(out, dict):
+                            out = {**out, "prId": pr_id}
+                        self._log_feedback(req.body, out, pr_id)
+                    for sniffer in QUERY_SNIFFERS:
+                        try:
+                            sniffer(req.body, out)
+                        except Exception:
+                            log.exception("query sniffer failed")
+                error = False
+                return 200, out
         finally:
-            self.stats.record((time.monotonic() - t0) * 1e3, error)
+            dur_s = monotonic_s() - t0
+            self.stats.record(dur_s * 1e3, error)
+            self._queries_total.inc(engine_id=eng)
+            if error:
+                self._query_errors_total.inc(engine_id=eng)
 
     def _log_feedback(self, query_body, result, pr_id: str):
         """Reference: query server POSTs back to the Event Server with prId;
@@ -464,7 +521,8 @@ class QueryServerService:
         """Per-query predict + serve from one consistent snapshot."""
         with self._swap_lock:
             pairs, serving = self.pairs, self.serving
-        predictions = [algo.predict(m, query) for algo, m in pairs]
+        with self.profile_hook.capture():
+            predictions = [algo.predict(m, query) for algo, m in pairs]
         return serving.serve(query, predictions)
 
     def _predict_batch(self, queries: list):
@@ -473,41 +531,77 @@ class QueryServerService:
         with self._swap_lock:
             pairs, serving = self.pairs, self.serving
         per_algo = []
-        for algo, m in pairs:
-            got = dict(algo.batch_predict(m, list(enumerate(queries))))
-            per_algo.append([got[i] for i in range(len(queries))])
+        with self.profile_hook.capture():
+            for algo, m in pairs:
+                got = dict(algo.batch_predict(m, list(enumerate(queries))))
+                per_algo.append([got[i] for i in range(len(queries))])
         return [
             serving.serve(q, [pa[i] for pa in per_algo])
             for i, q in enumerate(queries)
         ]
 
     def get_stats(self, req: Request):
-        out = self.stats.to_dict()
+        try:
+            window_s = float(req.params.get("window", "0"))
+        except (TypeError, ValueError):
+            window_s = 0.0
+        if window_s > 0:
+            out = self.stats.window(window_s)
+        else:
+            out = self.stats.to_dict()
+            stages = self.stage_summary()
+            if stages:
+                out["stages"] = stages
         if self._batcher is not None:
             out["microbatch"] = self._batcher.to_dict()
         if self._pool_idx is not None:
             # pool mode: these are ONE worker's numbers (the kernel
-            # balanced this connection here); aggregate client-side
+            # balanced this connection here); pool-wide totals live on
+            # /metrics (shared-memory aggregation)
             out["worker"] = self._pool_idx
             out["poolSize"] = self._pool_size
+            if self.obs.pool_bound:
+                out["pool"] = {
+                    "requestCount": int(
+                        self._queries_total.value(self.variant.engine_id)
+                    ),
+                    "errorCount": int(
+                        self._query_errors_total.value(self.variant.engine_id)
+                    ),
+                }
         return 200, out
 
-    def get_metrics(self, req: Request):
-        """Prometheus text exposition: request/error counters, latency
-        quantiles from the reservoir, micro-batch counters when on."""
-        from pio_tpu.server.metrics import escape_label, render
+    def stage_summary(self) -> dict:
+        """Per-stage latency summary from the stage histograms: count,
+        mean and interpolated p50/p95/p99 in milliseconds."""
+        hist = self.tracer.stage_histogram
+        out = {}
+        if hist is None:
+            return out
+        for stage in QUERY_STAGES:
+            cell = hist.labels(self.variant.engine_id, stage)
+            n = cell.count
+            if n <= 0:
+                continue
+            out[stage] = {
+                "count": int(n),
+                "avgMs": round(cell.sum / n * 1e3, 3),
+                "p50Ms": _q_ms(cell, 0.5),
+                "p95Ms": _q_ms(cell, 0.95),
+                "p99Ms": _q_ms(cell, 0.99),
+            }
+        return out
+
+    def _compat_metric_lines(self) -> list:
+        """Extra exposition lines kept from the pre-obs server: the
+        latency summary (quantile convention) and micro-batch counters —
+        existing scrapes and the bench parse these."""
+        from pio_tpu.server.metrics import escape_label
 
         s = self.stats.to_dict()
         eng = escape_label(self.variant.engine_id)
         lab = f'engine_id="{eng}"'
-        lines = [
-            "# HELP pio_queries_total Queries served",
-            "# TYPE pio_queries_total counter",
-            f"pio_queries_total{{{lab}}} {s['requestCount']}",
-            "# HELP pio_query_errors_total Queries that errored",
-            "# TYPE pio_query_errors_total counter",
-            f"pio_query_errors_total{{{lab}}} {s['errorCount']}",
-        ]
+        lines = []
         if s["avgMs"] is not None:
             lines += [
                 "# TYPE pio_query_latency_ms summary",
@@ -533,7 +627,27 @@ class QueryServerService:
                 f"pio_microbatch_queries_total{{{lab}}} "
                 f"{mb['batchedQueries']}",
             ]
-        return 200, render(lines)
+        return lines
+
+    def get_metrics(self, req: Request):
+        """Prometheus text exposition from the obs registry: request and
+        error counters, per-stage latency histograms, plus the legacy
+        summary + micro-batch lines via the compat collector. In pool
+        mode counters/histograms are POOL-WIDE (shared-memory sums)."""
+        from pio_tpu.server.metrics import render
+
+        return 200, render(self.obs.render())
+
+    def get_traces(self, req: Request):
+        """Recent request traces (ring buffer), slowest first."""
+        try:
+            n = int(req.params.get("n", "20"))
+        except (TypeError, ValueError):
+            n = 20
+        order = req.params.get("order", "slowest")
+        return 200, {
+            "traces": self.tracer.recent(n, slowest=(order != "recent")),
+        }
 
     def _check_admin(self, req: Request):
         if self.admin_key is not None:

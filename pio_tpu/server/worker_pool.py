@@ -42,6 +42,7 @@ import logging
 import multiprocessing as mp
 import os
 import socket
+import tempfile
 import time
 from typing import Optional
 
@@ -85,7 +86,10 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt) -> None:
         admin_key=spec.get("admin_key"),
         reuse_port=True,
     )
-    service.enable_pool(idx, spec["n_workers"], gen, shutdown_evt)
+    service.enable_pool(
+        idx, spec["n_workers"], gen, shutdown_evt,
+        metrics_path=spec.get("metrics_path"),
+    )
     service.attach_server(server)
     server.start()
     log.info("pool worker %d serving on :%d", idx, server.port)
@@ -157,6 +161,28 @@ class ServingPool:
         self.n_workers = n_workers
         self._procs: list = []
         self._respawns = [0] * n_workers
+        # cross-worker metrics: the supervisor owns a fixed-layout
+        # shared-memory segment; every worker mmaps its own stripe, so a
+        # /metrics scrape on ANY worker can sum pool-wide totals
+        # (pio_tpu/obs/shm.py). Creation failure degrades to per-worker
+        # metrics rather than blocking serving.
+        self._metrics_seg = None
+        try:
+            from pio_tpu.obs.shm import PoolMetricsSegment
+
+            fd, seg_path = tempfile.mkstemp(
+                prefix="pio-tpu-pool-metrics-", suffix=".shm"
+            )
+            os.close(fd)
+            self._metrics_seg = PoolMetricsSegment.create(
+                seg_path, n_workers
+            )
+            self._spec["metrics_path"] = seg_path
+        except Exception:
+            log.exception(
+                "pool metrics segment creation failed; workers expose "
+                "per-worker metrics only"
+            )
 
     def _spawn(self, idx: int):
         p = self._ctx.Process(
@@ -240,3 +266,10 @@ class ServingPool:
         if self._anchor is not None:
             self._anchor.close()
             self._anchor = None
+        if self._metrics_seg is not None:
+            try:
+                self._metrics_seg.close()
+                self._metrics_seg.unlink()
+            except OSError:
+                pass
+            self._metrics_seg = None

@@ -107,7 +107,7 @@ class EventLogEvents(base.LEvents, base.PEvents):
         self._repaired: set = set()  # paths torn-tail-checked this handle
         # instance is registry-cached per root, so this coalesces across
         # concurrent requests (see insert())
-        self._gc = GroupCommitter(self._flush_appends)
+        self._gc = GroupCommitter(self._flush_appends, store="eventlog")
 
     # -- files --------------------------------------------------------------
     def _path(self, app_id: int, channel_id=None) -> str:

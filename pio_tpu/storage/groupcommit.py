@@ -25,6 +25,23 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, List, Sequence
 
+from pio_tpu.obs import REGISTRY, monotonic_s
+
+#: leader flush duration + coalescing effectiveness, labelled by the
+#: owning store (process-global registry: storage has no HTTP surface of
+#: its own — the training workflow and event server re-expose these)
+_FLUSH_SECONDS = REGISTRY.histogram(
+    "pio_groupcommit_flush_seconds",
+    "Group-commit leader flush duration",
+    ("store",),
+)
+_BATCH_SIZE = REGISTRY.histogram(
+    "pio_groupcommit_batch_size",
+    "Payloads coalesced per group-commit flush",
+    ("store",),
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+)
+
 
 class PartialFlushOutcome(Exception):
     """Raised BY a flush callable whose backend cannot make a multi-
@@ -76,8 +93,10 @@ class GroupCommitter:
     retry would duplicate the payloads that already landed).
     """
 
-    def __init__(self, flush: Callable[[Sequence[Any]], List[Any]]):
+    def __init__(self, flush: Callable[[Sequence[Any]], List[Any]],
+                 store: str = "unnamed"):
         self._flush = flush
+        self._store = store
         self._q: List[_Item] = []
         self._qlock = threading.Lock()
         self._commit_lock = threading.Lock()
@@ -97,6 +116,8 @@ class GroupCommitter:
                 with self._qlock:
                     batch = self._q
                     self._q = []
+                t_flush = monotonic_s()
+                _BATCH_SIZE.observe(len(batch), store=self._store)
                 try:
                     # list() BEFORE the length check: a generator return
                     # would raise TypeError on len() after the flush
@@ -131,6 +152,9 @@ class GroupCommitter:
                             i.result = self._flush([i.payload])[0]
                         except Exception as exc:
                             i.exc = exc
+                _FLUSH_SECONDS.observe(
+                    monotonic_s() - t_flush, store=self._store
+                )
                 for i in batch:
                     i.done.set()
             finally:

@@ -273,7 +273,7 @@ class SQLiteEvents(base.LEvents, base.PEvents):
                             raise
                         return [p[0] for p in payloads]
 
-                    gc = GroupCommitter(flush)
+                    gc = GroupCommitter(flush, store="sqlite")
                     client._events_gc = gc
         self._gc = gc
 

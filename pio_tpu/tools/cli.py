@@ -301,7 +301,9 @@ def cmd_blobserver(args) -> int:
 def cmd_dashboard(args) -> int:
     from pio_tpu.server import create_dashboard
 
-    server = create_dashboard(host=args.ip, port=args.port)
+    server = create_dashboard(
+        host=args.ip, port=args.port, query_url=args.query_url
+    )
     _out(f"Dashboard listening on {args.ip}:{server.port}")
     try:
         server.serve_forever()
@@ -325,7 +327,14 @@ def cmd_adminserver(args) -> int:
 
 
 def cmd_deploy(args) -> int:
+    import os
+
     from pio_tpu.server import create_query_server
+
+    if getattr(args, "profile_dir", ""):
+        # serving profile hook (pio_tpu/obs/profile.py): capture a
+        # jax.profiler trace of the first N device executions
+        os.environ["PIO_TPU_PROFILE"] = args.profile_dir
 
     variant = _load_variant(args.engine_json)
     feedback_app_id = None
@@ -716,6 +725,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="with --workers>1: let worker 0 own the accelerator scorer "
              "(libtpu single-owner); others stay on the host mirror",
     )
+    a.add_argument(
+        "--profile-dir", default="",
+        help="capture a jax.profiler trace of the first N device "
+             "executions into this dir (sets PIO_TPU_PROFILE; N from "
+             "PIO_TPU_PROFILE_EXECUTIONS, default 8)",
+    )
     a.set_defaults(fn=cmd_deploy)
 
     a = sub.add_parser("undeploy", help="stop a running query server")
@@ -753,6 +768,11 @@ def build_parser() -> argparse.ArgumentParser:
     a = sub.add_parser("dashboard", help="run the evaluation dashboard")
     a.add_argument("--ip", default="0.0.0.0")
     a.add_argument("--port", type=int, default=9000)
+    a.add_argument(
+        "--query-url", default="http://127.0.0.1:8000",
+        help="query server (or any pool worker) whose /metrics the "
+             "/serving.html view scrapes",
+    )
     a.set_defaults(fn=cmd_dashboard)
 
     a = sub.add_parser("adminserver", help="run the admin REST API")

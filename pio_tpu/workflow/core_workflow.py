@@ -19,13 +19,13 @@ import datetime as _dt
 import json as _json
 import logging
 import pickle
-import time
 import traceback
 from typing import Any, List, Optional, Sequence
 
 import numpy as np
 
 from pio_tpu.controller.components import PersistentModel
+from pio_tpu.obs import REGISTRY, Tracer, monotonic_s
 from pio_tpu.controller.engine import Engine, EngineParams
 from pio_tpu.controller.evaluation import (
     EngineParamsGenerator,
@@ -46,6 +46,18 @@ from pio_tpu.workflow.engine_json import EngineVariant
 from pio_tpu.workflow.params import WorkflowParams
 
 log = logging.getLogger("pio_tpu.workflow")
+
+#: training-run tracer (process-global registry): every run lands in the
+#: ring (inspectable in-process) and feeds pio_train_stage_seconds
+#: histograms — stage labels are the engine.train timing keys
+#: (read / prepare / train:<algo>) plus "persist". Wide buckets: reads
+#: are milliseconds, ALS on a real corpus is minutes.
+TRAIN_TRACER = Tracer(
+    "train", registry=REGISTRY,
+    stages=("read", "prepare", "persist"),
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+             300.0, 1800.0, 7200.0),
+)
 
 
 def _utcnow() -> _dt.datetime:
@@ -141,66 +153,79 @@ def run_train(
             checkpoint_every=workflow_params.checkpoint_every,
         )
 
-    t0 = time.monotonic()
+    t0 = monotonic_s()
     timings: dict = {}
     try:
-        with contextlib.ExitStack() as stack:
-            if workflow_params.profile_dir:
-                # jax.profiler trace of the whole train — the rebuild's
-                # Spark UI equivalent; view with tensorboard/xprof
-                import jax as _jax
+        with TRAIN_TRACER.trace(
+            "train", instanceId=instance_id, engineId=variant.engine_id
+        ) as tr:
+            with contextlib.ExitStack() as stack:
+                if workflow_params.profile_dir:
+                    # jax.profiler trace of the whole train — the rebuild's
+                    # Spark UI equivalent; view with tensorboard/xprof
+                    import jax as _jax
 
-                stack.enter_context(
-                    _jax.profiler.trace(workflow_params.profile_dir)
+                    stack.enter_context(
+                        _jax.profiler.trace(workflow_params.profile_dir)
+                    )
+                models = engine.train(
+                    ctx,
+                    engine_params,
+                    skip_sanity_check=workflow_params.skip_sanity_check,
+                    stop_after_read=workflow_params.stop_after_read,
+                    stop_after_prepare=workflow_params.stop_after_prepare,
+                    timings=timings,
                 )
-            models = engine.train(
-                ctx,
-                engine_params,
-                skip_sanity_check=workflow_params.skip_sanity_check,
-                stop_after_read=workflow_params.stop_after_read,
-                stop_after_prepare=workflow_params.stop_after_prepare,
-                timings=timings,
+            train_s = monotonic_s() - t0
+            # engine.train measured the phases; turn them into spans so
+            # the run shows up in the trace ring AND the per-stage
+            # training histograms (pio_train_stage_seconds)
+            for phase, dur in timings.items():
+                tr.add_span(phase, float(dur))
+            if (workflow_params.stop_after_read
+                    or workflow_params.stop_after_prepare):
+                instances.update(instance.with_status(RunStatus.ABORTED))
+                log.info(
+                    "run %s aborted early by stop-after flag", instance_id
+                )
+                return instance_id
+
+            # Persist: PersistentModel handles itself; everything else goes
+            # into the Models store as one pickled blob.
+            with tr.span("persist"):
+                persisted_externally = []
+                for (name, algo_params), model in zip(
+                    engine_params.algorithm_params_list, models
+                ):
+                    if isinstance(model, PersistentModel):
+                        persisted_externally.append(
+                            model.save(instance_id, algo_params, ctx)
+                        )
+                    else:
+                        persisted_externally.append(False)
+                blob_models = [
+                    None if ext else m
+                    for ext, m in zip(persisted_externally, models)
+                ]
+                Storage.get_model_data_models().insert(
+                    Model(id=instance_id, models=serialize_models(blob_models))
+                )
+
+            done = dataclasses.replace(
+                instance.with_status(RunStatus.COMPLETED),
+                env={
+                    "train_seconds": f"{train_s:.3f}",
+                    "num_devices": str(ctx.num_devices),
+                    # per-phase wall seconds (read / prepare / train:<algo>)
+                    **{f"phase_{k}": str(v) for k, v in timings.items()},
+                },
             )
-        train_s = time.monotonic() - t0
-        if workflow_params.stop_after_read or workflow_params.stop_after_prepare:
-            instances.update(instance.with_status(RunStatus.ABORTED))
-            log.info("run %s aborted early by stop-after flag", instance_id)
+            instances.update(done)
+            log.info(
+                "training finished: instance %s (%.2fs, %d model(s))",
+                instance_id, train_s, len(models),
+            )
             return instance_id
-
-        # Persist: PersistentModel handles itself; everything else goes into
-        # the Models store as one pickled blob.
-        persisted_externally = []
-        for (name, algo_params), model in zip(
-            engine_params.algorithm_params_list, models
-        ):
-            if isinstance(model, PersistentModel):
-                persisted_externally.append(
-                    model.save(instance_id, algo_params, ctx)
-                )
-            else:
-                persisted_externally.append(False)
-        blob_models = [
-            None if ext else m for ext, m in zip(persisted_externally, models)
-        ]
-        Storage.get_model_data_models().insert(
-            Model(id=instance_id, models=serialize_models(blob_models))
-        )
-
-        done = dataclasses.replace(
-            instance.with_status(RunStatus.COMPLETED),
-            env={
-                "train_seconds": f"{train_s:.3f}",
-                "num_devices": str(ctx.num_devices),
-                # per-phase wall seconds (read / prepare / train:<algo>)
-                **{f"phase_{k}": str(v) for k, v in timings.items()},
-            },
-        )
-        instances.update(done)
-        log.info(
-            "training finished: instance %s (%.2fs, %d model(s))",
-            instance_id, train_s, len(models),
-        )
-        return instance_id
     except Exception:
         err = traceback.format_exc()
         failed = dataclasses.replace(

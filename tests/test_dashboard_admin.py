@@ -98,6 +98,53 @@ class TestDashboard:
         status, _, _ = http("GET", dashboard + "/instances/nope.json")
         assert status == 404
 
+    def test_metrics_round_trip(self, dashboard):
+        from pio_tpu.obs.promparse import parse_prometheus_text
+
+        http("GET", dashboard + "/")  # one pageview
+        status, text, headers = http("GET", dashboard + "/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        pm = parse_prometheus_text(text)
+        assert pm.types["pio_dashboard_pageviews_total"] == "counter"
+        assert pm.value("pio_dashboard_pageviews_total", page="index") == 1
+
+    def test_serving_view_unreachable_upstream(self, dashboard):
+        """/serving.html degrades gracefully when no query server is up:
+        still a 200 HTML page, with the scrape error surfaced."""
+        status, page, headers = http(
+            "GET", dashboard + "/serving.html?url=http://127.0.0.1:1"
+        )
+        assert status == 200
+        assert "text/html" in headers["Content-Type"]
+        assert "Serving" in page
+
+    def test_serving_view_renders_stage_table(self, dashboard, tmp_home):
+        """Point the dashboard at a live query server and check the
+        pool-wide totals + per-stage latency table are rendered."""
+        import pio_tpu.templates  # noqa: F401
+        from tests.test_servers import VARIANT, _train
+        from pio_tpu.server import create_query_server
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "srv-test"))
+        variant, ctx, _ = _train(app_id)
+        server, _ = create_query_server(
+            variant, host="127.0.0.1", port=0, ctx=ctx
+        )
+        server.start()
+        try:
+            qurl = f"http://127.0.0.1:{server.port}"
+            for _ in range(2):
+                http("POST", qurl + "/queries.json", {"user": "u1", "num": 2})
+            status, page, _ = http(
+                "GET", dashboard + f"/serving.html?url={qurl}"
+            )
+            assert status == 200
+            assert "execute" in page and "serialize" in page
+            assert "queue" in page
+        finally:
+            server.stop()
+
 
 class TestAdmin:
     def test_alive(self, admin):

@@ -1,0 +1,391 @@
+"""Unit tests for pio_tpu/obs — the metrics registry, text exposition
+(round-tripped through the promparse parser the way a real scraper
+would), stage tracing, and cross-worker shared-memory aggregation."""
+
+import os
+import tempfile
+import threading
+
+import pytest
+
+from pio_tpu.obs import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    RequestWindow,
+    Tracer,
+    escape_help,
+    escape_label_value,
+    monotonic_s,
+)
+from pio_tpu.obs.promparse import parse_prometheus_text
+from pio_tpu.obs.shm import PoolMetricsSegment
+
+
+def render_parse(reg, pool=True):
+    return parse_prometheus_text("\n".join(reg.render(pool=pool)))
+
+
+class TestRegistry:
+    def test_counter_inc_and_render(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_total", "things", ("kind",))
+        c.inc(kind="a")
+        c.inc(2, kind="a")
+        c.inc(kind="b")
+        pm = render_parse(reg)
+        assert pm.value("t_total", kind="a") == 3
+        assert pm.value("t_total", kind="b") == 1
+        assert pm.types["t_total"] == "counter"
+        assert pm.helps["t_total"] == "things"
+
+    def test_counter_rejects_negative(self):
+        c = MetricsRegistry().counter("n_total", "n")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_set(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("g", "a gauge")
+        g.set(4.5)
+        g.inc(0.5)
+        assert render_parse(reg).value("g") == 5.0
+
+    def test_registration_idempotent(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x_total", "x", ("l",))
+        b = reg.counter("x_total", "x", ("l",))
+        assert a is b
+
+    def test_registration_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total", "x", ("l",))
+        with pytest.raises(ValueError):
+            reg.counter("x_total", "x", ("other",))
+        with pytest.raises(ValueError):
+            reg.gauge("x_total", "x", ("l",))
+
+    def test_help_and_label_escaping_round_trip(self):
+        reg = MetricsRegistry()
+        nasty = 'sla\\sh "quote"\nnewline'
+        c = reg.counter("esc_total", 'help with \\ and\nnewline', ("path",))
+        c.inc(path=nasty)
+        text = "\n".join(reg.render())
+        # escaped on the wire: no raw newline inside any sample line
+        assert '\\n' in text
+        pm = parse_prometheus_text(text)
+        assert pm.value("esc_total", path=nasty) == 1
+        assert pm.helps["esc_total"] == 'help with \\ and\nnewline'
+
+    def test_escape_helpers(self):
+        assert escape_help("a\\b\nc") == "a\\\\b\\nc"
+        assert escape_label_value('a"b\nc\\d') == 'a\\"b\\nc\\\\d'
+
+    def test_collector_lines_appended_and_errors_swallowed(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total", "a").inc()
+        reg.add_collector(lambda: ["extra_metric 42"])
+        reg.add_collector(lambda: 1 / 0)  # must not kill /metrics
+        pm = render_parse(reg)
+        assert pm.value("a_total") == 1
+        assert pm.value("extra_metric") == 42
+
+
+class TestHistogram:
+    def test_bucket_monotonicity_and_count(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "lat", buckets=(0.001, 0.01, 0.1))
+        for v in (0.0005, 0.005, 0.05, 0.5, 0.0009):
+            h.observe(v)
+        pm = render_parse(reg)
+        buckets = pm.histogram_buckets("lat_seconds")
+        assert [le for le, _ in buckets] == [0.001, 0.01, 0.1, float("inf")]
+        cums = [c for _, c in buckets]
+        assert cums == sorted(cums)  # cumulative => monotone
+        assert cums[-1] == 5
+        assert cums[0] == 2  # 0.0005 and 0.0009
+        assert pm.value("lat_seconds_count") == 5
+        assert pm.value("lat_seconds_sum") == pytest.approx(0.5564)
+
+    def test_observation_on_edge_goes_to_that_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("e_seconds", "e", buckets=(1.0, 2.0))
+        h.observe(1.0)  # le="1" is inclusive per Prometheus semantics
+        pm = render_parse(reg)
+        assert dict(
+            (le, c) for le, c in pm.histogram_buckets("e_seconds")
+        )[1.0] == 1
+
+    def test_quantile_interpolation(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("q_seconds", "q", buckets=(0.1, 0.2, 0.4))
+        cell = h.labels()
+        for _ in range(100):
+            cell.observe(0.15)  # all in the (0.1, 0.2] bucket
+        q50 = cell.quantile(0.5)
+        assert 0.1 <= q50 <= 0.2
+        assert cell.quantile(0.99) <= 0.2
+
+    def test_quantile_empty_is_none(self):
+        h = MetricsRegistry().histogram("z_seconds", "z")
+        assert h.labels().quantile(0.5) is None
+
+    def test_default_buckets_sorted(self):
+        assert list(DEFAULT_LATENCY_BUCKETS) == sorted(DEFAULT_LATENCY_BUCKETS)
+
+
+class TestRequestWindow:
+    def test_cumulative_and_percentiles(self):
+        w = RequestWindow()
+        for ms in (1.0, 2.0, 3.0, 4.0, 100.0):
+            w.record(ms)
+        w.record(5.0, error=True)
+        d = w.to_dict()
+        assert d["requestCount"] == 6
+        assert d["errorCount"] == 1
+        assert d["avgMs"] == pytest.approx(115.0 / 6, abs=0.01)
+        assert d["p50Ms"] <= d["p95Ms"] <= d["p99Ms"]
+
+    def test_window_view(self):
+        w = RequestWindow()
+        w.record(7.0)
+        d = w.window(60.0)
+        assert d["windowSeconds"] == 60.0
+        assert d["requestCount"] == 1
+        assert d["p50Ms"] == 7.0
+        # nothing recorded in a zero-width recent window
+        assert w.window(0.0)["requestCount"] == 0
+
+    def test_ring_bounded(self):
+        w = RequestWindow(cap=8)
+        for i in range(100):
+            w.record(float(i))
+        assert w.count == 100
+        assert len(w._ring) == 8
+
+
+class TestTracer:
+    def test_spans_feed_histogram_and_ring(self):
+        reg = MetricsRegistry()
+        tracer = Tracer("demo", registry=reg, stages=("a", "b"))
+        with tracer.trace("req", user="u1") as tr:
+            with tr.span("a"):
+                pass
+            tr.add_span("b", 0.25)
+        pm = render_parse(reg)
+        assert pm.value("pio_demo_stage_seconds_count", stage="a") == 1
+        assert pm.value("pio_demo_stage_seconds_sum", stage="b") == 0.25
+        recent = tracer.recent()
+        assert len(recent) == 1
+        t = recent[0]
+        assert t["kind"] == "req" and t["meta"] == {"user": "u1"}
+        assert [s["stage"] for s in t["spans"]] == ["a", "b"]
+        assert t["spans"][1]["durMs"] == 250.0
+        assert not t["error"]
+
+    def test_exception_marks_error_and_still_records(self):
+        tracer = Tracer("err")
+        with pytest.raises(RuntimeError):
+            with tracer.trace("boom"):
+                raise RuntimeError("x")
+        assert tracer.recent()[0]["error"] is True
+
+    def test_ring_bounded_and_slowest_first(self):
+        tracer = Tracer("ring", ring=4)
+        for i in range(10):
+            with tracer.trace(f"k{i}") as tr:
+                tr.add_span("s", 0.0)
+        assert len(tracer.recent(n=100)) == 4
+        slow = tracer.recent(n=4, slowest=True)
+        totals = [t["totalMs"] for t in slow]
+        assert totals == sorted(totals, reverse=True)
+
+    def test_stage_cells_precreated_for_pool_layout(self):
+        reg = MetricsRegistry()
+        Tracer("pre", registry=reg, stages=("x", "y"))
+        pm = render_parse(reg)
+        # declared stages expose zero-count cells before any traffic
+        assert pm.value("pio_pre_stage_seconds_count", stage="x") == 0
+        assert pm.value("pio_pre_stage_seconds_count", stage="y") == 0
+
+
+@pytest.fixture()
+def seg_path(tmp_path):
+    return str(tmp_path / "metrics.shm")
+
+
+def _make_worker_registry():
+    """The same registration sequence in every 'worker' — layout parity
+    is what makes registration-order slot assignment correct."""
+    reg = MetricsRegistry()
+    c = reg.counter("w_total", "w", ("k",))
+    c.labels("x")
+    h = reg.histogram("w_seconds", "w lat", buckets=(0.1, 1.0))
+    h.labels()
+    return reg, c, h
+
+
+class TestPoolSegment:
+    def test_create_open_read_write(self, seg_path):
+        seg = PoolMetricsSegment.create(seg_path, n_workers=3,
+                                        slots_per_worker=8)
+        seg.set(2, 7, 1.5)
+        assert seg.read(2, 7) == 1.5
+        assert seg.sum_slot(7) == 1.5
+        reopened = PoolMetricsSegment.open(seg_path)
+        assert reopened.n_workers == 3
+        assert reopened.slots_per_worker == 8
+        assert reopened.read(2, 7) == 1.5
+        reopened.close()
+        seg.unlink()
+        assert not os.path.exists(seg_path)
+
+    def test_open_rejects_garbage(self, tmp_path):
+        p = tmp_path / "junk"
+        p.write_bytes(b"not a segment at all................")
+        with pytest.raises(ValueError):
+            PoolMetricsSegment.open(str(p))
+
+    def test_bounds_checked(self, seg_path):
+        seg = PoolMetricsSegment.create(seg_path, 1, slots_per_worker=4)
+        with pytest.raises(IndexError):
+            seg.set(1, 0, 1.0)
+        with pytest.raises(IndexError):
+            seg.read(0, 4)
+        seg.unlink()
+
+    def test_cross_worker_sum(self, seg_path):
+        """The acceptance-criteria mechanism, in-process: two registries
+        bound as worker 0 and 1 of one segment — a scrape of EITHER
+        reports the pool-wide totals."""
+        PoolMetricsSegment.create(seg_path, n_workers=2)
+        r0, c0, h0 = _make_worker_registry()
+        r1, c1, h1 = _make_worker_registry()
+        r0.bind_pool_segment(PoolMetricsSegment.open(seg_path), 0)
+        r1.bind_pool_segment(PoolMetricsSegment.open(seg_path), 1)
+        for _ in range(3):
+            c0.inc(k="x")
+        for _ in range(2):
+            c1.inc(k="x")
+        h0.observe(0.05)
+        h1.observe(0.5)
+        for reg in (r0, r1):  # both workers expose the same pool totals
+            pm = render_parse(reg)
+            assert pm.value("w_total", k="x") == 5
+            assert pm.value("w_seconds_count") == 2
+            buckets = dict(pm.histogram_buckets("w_seconds"))
+            assert buckets[0.1] == 1 and buckets[1.0] == 2
+        # local (pool=False) view stays per-worker
+        assert render_parse(r0, pool=False).value("w_total", k="x") == 3
+
+    def test_respawned_worker_adopts_stripe(self, seg_path):
+        """A crashed worker's replacement rebinds the same stripe and
+        must ADOPT its value — pool totals survive worker respawn."""
+        PoolMetricsSegment.create(seg_path, n_workers=2)
+        r0, c0, _ = _make_worker_registry()
+        r0.bind_pool_segment(PoolMetricsSegment.open(seg_path), 0)
+        c0.inc(4, k="x")
+        # "respawn": fresh registry, same worker index
+        r0b, c0b, _ = _make_worker_registry()
+        r0b.bind_pool_segment(PoolMetricsSegment.open(seg_path), 0)
+        assert c0b.value("x") == 4
+        c0b.inc(k="x")
+        assert c0b.value("x") == 5
+
+    def test_gauges_never_bound(self, seg_path):
+        PoolMetricsSegment.create(seg_path, n_workers=2)
+        reg = MetricsRegistry()
+        g = reg.gauge("up", "uptime")
+        g.set(10)
+        reg.bind_pool_segment(PoolMetricsSegment.open(seg_path), 0)
+        reg2 = MetricsRegistry()
+        g2 = reg2.gauge("up", "uptime")
+        g2.set(99)
+        reg2.bind_pool_segment(PoolMetricsSegment.open(seg_path), 1)
+        # each worker's gauge stays local — no cross-stripe summing
+        assert render_parse(reg).value("up") == 10
+        assert render_parse(reg2).value("up") == 99
+
+    def test_segment_too_small_raises(self, seg_path):
+        PoolMetricsSegment.create(seg_path, 1, slots_per_worker=2)
+        reg, _, _ = _make_worker_registry()  # needs 1 + (2+1+2) slots
+        with pytest.raises(ValueError, match="too small"):
+            reg.bind_pool_segment(PoolMetricsSegment.open(seg_path), 0)
+
+    def test_concurrent_observe_under_binding(self, seg_path):
+        """Counter increments from several threads while bound: the
+        stripe must end up exactly at the true total (per-cell lock)."""
+        PoolMetricsSegment.create(seg_path, n_workers=1)
+        reg, c, _ = _make_worker_registry()
+        seg = PoolMetricsSegment.open(seg_path)
+        reg.bind_pool_segment(seg, 0)
+
+        def spin():
+            for _ in range(500):
+                c.inc(k="x")
+
+        threads = [threading.Thread(target=spin) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value("x") == 2000
+        assert seg.read(0, 0) == 2000
+
+
+class TestPromParse:
+    def test_summary_quantile_lines(self):
+        pm = parse_prometheus_text(
+            '# TYPE lat_ms summary\n'
+            'lat_ms{quantile="0.5"} 1.25\n'
+            'lat_ms{quantile="0.95"} 9\n'
+            'lat_ms_sum 100\nlat_ms_count 42\n'
+        )
+        assert pm.value("lat_ms", quantile="0.5") == 1.25
+        assert pm.value("lat_ms_count") == 42
+
+    def test_histogram_quantile_estimate(self):
+        pm = parse_prometheus_text(
+            'h_bucket{le="0.1"} 0\n'
+            'h_bucket{le="0.2"} 100\n'
+            'h_bucket{le="+Inf"} 100\n'
+            'h_count 100\nh_sum 15\n'
+        )
+        q = pm.histogram_quantile("h", 0.5)
+        assert 0.1 <= q <= 0.2
+
+    def test_inf_value_parsing(self):
+        pm = parse_prometheus_text("x +Inf\ny -Inf\n")
+        assert pm.value("x") == float("inf")
+        assert pm.value("y") == float("-inf")
+
+
+class TestMonotonicClock:
+    def test_is_monotonic_and_subsecond(self):
+        a = monotonic_s()
+        b = monotonic_s()
+        assert b >= a
+        assert isinstance(a, float)
+
+
+class TestProfileHook:
+    def test_inert_without_directory(self, monkeypatch):
+        from pio_tpu.obs.profile import DeviceProfileHook
+
+        monkeypatch.delenv("PIO_TPU_PROFILE", raising=False)
+        hook = DeviceProfileHook.from_env()
+        assert not hook.enabled
+        with hook.capture():
+            pass  # must be a no-op, not start a trace
+
+    def test_from_env_reads_directory_and_n(self, monkeypatch):
+        from pio_tpu.obs.profile import DeviceProfileHook
+
+        monkeypatch.setenv("PIO_TPU_PROFILE", "/tmp/prof")
+        monkeypatch.setenv("PIO_TPU_PROFILE_EXECUTIONS", "3")
+        hook = DeviceProfileHook.from_env()
+        assert hook.enabled
+        assert hook.directory == "/tmp/prof"
+        assert hook.first_n == 3

@@ -288,6 +288,56 @@ class TestEventServer:
         assert "# TYPE pio_events_ingested_total counter" in text
         assert 'event="rate"' in text and 'status="201"' in text
 
+    def test_metrics_round_trip_and_stage_histograms(
+        self, eventserver, app_and_key
+    ):
+        """/metrics parses with the obs text parser; the ingest stage
+        histogram (parse/validate/store) has observations after a POST."""
+        import urllib.request
+
+        from pio_tpu.obs.promparse import parse_prometheus_text
+
+        _, key = app_and_key
+        http("POST", f"{eventserver}/events.json?accessKey={key}", EV)
+        with urllib.request.urlopen(f"{eventserver}/metrics", timeout=10) as r:
+            pm = parse_prometheus_text(r.read().decode())
+        assert pm.types["pio_events_ingested_total"] == "counter"
+        assert pm.types["pio_event_stage_seconds"] == "histogram"
+        for stage in ("parse", "validate", "store"):
+            assert pm.value("pio_event_stage_seconds_count", stage=stage) >= 1
+        # bucket counts are cumulative => monotone non-decreasing
+        buckets = pm.histogram_buckets("pio_event_stage_seconds", stage="store")
+        cums = [c for _, c in buckets]
+        assert cums == sorted(cums) and cums[-1] >= 1
+
+    def test_stats_parity_and_window(self, eventserver, app_and_key):
+        """/stats.json exposes the same request-latency keys as the query
+        server, plus a per-stage summary; ?window= narrows the view."""
+        _, key = app_and_key
+        for _ in range(3):
+            http("POST", f"{eventserver}/events.json?accessKey={key}", EV)
+        _, stats = http("GET", f"{eventserver}/stats.json")
+        assert stats["requestCount"] >= 3
+        assert stats["errorCount"] == 0
+        assert stats["p50Ms"] is not None and stats["p50Ms"] < 1000
+        assert stats["p95Ms"] >= stats["p50Ms"]
+        assert "store" in stats["stages"]
+        assert stats["apps"]  # classic per-app block preserved
+        _, win = http("GET", f"{eventserver}/stats.json?window=60")
+        assert win["windowSeconds"] == 60.0
+        assert win["requestCount"] >= 3
+        _, zero = http("GET", f"{eventserver}/stats.json?window=0.000001")
+        assert zero["requestCount"] == 0
+
+    def test_traces_json(self, eventserver, app_and_key):
+        _, key = app_and_key
+        http("POST", f"{eventserver}/events.json?accessKey={key}", EV)
+        _, body = http("GET", f"{eventserver}/traces.json?n=5")
+        traces = body["traces"]
+        assert traces and traces[0]["kind"] == "event"
+        stages = {s["stage"] for t in traces for s in t["spans"]}
+        assert {"parse", "validate", "store"} <= stages
+
     def test_webhook_json(self, eventserver, app_and_key):
         app_id, key = app_and_key
         payload = {
@@ -559,6 +609,101 @@ class TestQueryServer:
             text = r.read().decode()
         assert "pio_queries_total{" in text
         assert 'quantile="0.95"' in text
+
+    def test_stage_histograms_after_query(self, queryserver):
+        """Acceptance criterion: queue/execute/serialize stage histograms
+        show non-zero observations after a served request, and the whole
+        exposition round-trips through the obs text parser."""
+        import urllib.request
+
+        from pio_tpu.obs.promparse import parse_prometheus_text
+
+        url, _, _ = queryserver
+        http("POST", f"{url}/queries.json", {"user": "u1", "num": 2})
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+            pm = parse_prometheus_text(r.read().decode())
+        assert pm.value("pio_queries_total", engine_id="rec-srv") >= 1
+        assert pm.types["pio_query_stage_seconds"] == "histogram"
+        for stage in ("parse", "queue", "execute", "serialize"):
+            assert pm.value(
+                "pio_query_stage_seconds_count",
+                engine_id="rec-srv", stage=stage,
+            ) >= 1, f"stage {stage} never observed"
+        buckets = pm.histogram_buckets(
+            "pio_query_stage_seconds", engine_id="rec-srv", stage="execute"
+        )
+        cums = [c for _, c in buckets]
+        assert cums == sorted(cums) and cums[-1] >= 1
+        # legacy summary surface still present alongside the histograms
+        assert pm.value("pio_query_latency_ms_count", engine_id="rec-srv") >= 1
+
+    def test_stats_stages_and_window(self, queryserver):
+        url, _, _ = queryserver
+        for _ in range(3):
+            http("POST", f"{url}/queries.json", {"user": "u1", "num": 2})
+        _, stats = http("GET", f"{url}/stats.json")
+        st = stats["stages"]
+        for stage in ("queue", "execute", "serialize"):
+            assert st[stage]["count"] >= 3
+            assert st[stage]["avgMs"] is not None
+        _, win = http("GET", f"{url}/stats.json?window=60")
+        assert win["windowSeconds"] == 60.0
+        assert win["requestCount"] >= 3
+        _, zero = http("GET", f"{url}/stats.json?window=0.000001")
+        assert zero["requestCount"] == 0
+
+    def test_traces_json(self, queryserver):
+        url, _, _ = queryserver
+        for _ in range(2):
+            http("POST", f"{url}/queries.json", {"user": "u1", "num": 2})
+        _, body = http("GET", f"{url}/traces.json?n=10")
+        traces = body["traces"]
+        assert len(traces) >= 2
+        t = traces[0]
+        assert t["kind"] == "query"
+        stages = [s["stage"] for s in t["spans"]]
+        for stage in ("parse", "queue", "execute", "serialize"):
+            assert stage in stages
+        totals = [x["totalMs"] for x in traces]
+        assert totals == sorted(totals, reverse=True)  # slowest-first default
+        _, recent = http("GET", f"{url}/traces.json?n=1&order=recent")
+        assert len(recent["traces"]) == 1
+
+    def test_microbatch_stage_timings(self, app_and_key, monkeypatch):
+        """On the micro-batch path, queue and execute stage timings come
+        from the worker thread (drain wait + shared dispatch) and land in
+        the same histogram the inline path uses."""
+        import urllib.request
+
+        from pio_tpu.obs.promparse import parse_prometheus_text
+
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_US", "2000")
+        app_id, _ = app_and_key
+        variant, ctx, _ = _train(app_id)
+        server, service = create_query_server(
+            variant, host="127.0.0.1", port=0, ctx=ctx
+        )
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            for _ in range(4):
+                assert http(
+                    "POST", f"{url}/queries.json", {"user": "u1", "num": 2}
+                )[0] == 200
+            with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+                pm = parse_prometheus_text(r.read().decode())
+            for stage in ("queue", "execute"):
+                assert pm.value(
+                    "pio_query_stage_seconds_count",
+                    engine_id="rec-srv", stage=stage,
+                ) >= 4
+            # queue times are real waits, not zero-stamped
+            assert pm.value(
+                "pio_query_stage_seconds_sum",
+                engine_id="rec-srv", stage="queue",
+            ) > 0
+        finally:
+            server.stop()
 
     def test_microbatch_poisoned_query_falls_back_concurrently(self):
         """One query whose batch dispatch fails must not serialize its

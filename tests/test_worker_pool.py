@@ -55,7 +55,9 @@ def _seed_and_train(n_users=10, n_items=6):
             )
     variant = variant_from_dict(VARIANT)
     engine, ep = build_engine(variant)
-    run_train(engine, ep, variant, ctx=ComputeContext.create(seed=0))
+    # local (single-device) training: this suite exercises pool SERVING;
+    # the mesh training path has its own coverage in test_als.py
+    run_train(engine, ep, variant, ctx=ComputeContext.local())
     return variant
 
 
@@ -122,13 +124,58 @@ class TestServingPool:
         # virtually never all land on one listener
         assert len(workers_seen) == 2, workers_seen
 
+    def test_pool_wide_metrics_on_any_worker(self, pool):
+        """Acceptance criterion: with the shared-memory segment bound,
+        GET /metrics on whichever worker answers reports POOL-WIDE
+        totals — N requests in, a scraped counter of exactly N out,
+        regardless of how the kernel split the connections."""
+        from pio_tpu.obs.promparse import parse_prometheus_text
+
+        def scrape():
+            conn = http.client.HTTPConnection("127.0.0.1", pool.port,
+                                              timeout=30)
+            try:
+                conn.request("GET", "/metrics")
+                r = conn.getresponse()
+                assert r.status == 200
+                return parse_prometheus_text(r.read().decode())
+            finally:
+                conn.close()
+
+        base = scrape().value("pio_queries_total", engine_id="pool-e2e")
+        N = 20
+        workers_seen = set()
+        for _ in range(N):
+            status, _ = _post(pool.port, "/queries.json",
+                              {"user": "u1", "num": 2})
+            assert status == 200
+            _, stats = _get(pool.port, "/stats.json")
+            workers_seen.add(stats["worker"])
+        # several scrapes (fresh connections → possibly different
+        # workers) must all agree on the pool-wide total
+        for _ in range(6):
+            pm = scrape()
+            assert pm.value(
+                "pio_queries_total", engine_id="pool-e2e"
+            ) == base + N
+        assert len(workers_seen) == 2, workers_seen
+        # stage histograms aggregate the same way: every request passed
+        # through execute exactly once, whichever worker served it
+        assert pm.value(
+            "pio_query_stage_seconds_count",
+            engine_id="pool-e2e", stage="execute",
+        ) >= base + N
+        # /stats.json carries the pool block alongside per-worker stats
+        _, stats = _get(pool.port, "/stats.json")
+        assert stats["pool"]["requestCount"] >= base + N
+
     def test_reload_rolls_every_worker(self, pool):
         # retrain → new COMPLETED instance; one /reload must roll ALL
         # workers (generation counter), not just the one that got the POST
         variant = variant_from_dict(VARIANT)
         engine, ep = build_engine(variant)
         new_id = run_train(
-            engine, ep, variant, ctx=ComputeContext.create(seed=0)
+            engine, ep, variant, ctx=ComputeContext.local()
         )
         status, out = _post(pool.port, "/reload", {})
         assert status == 200 and out["engineInstanceId"] == new_id
