@@ -289,6 +289,7 @@ def _bench_server_p50(factors, n_users: int, n_items: int,
 
     out = {}
     server, _service, post = _serve_single(variant, 0)
+    out["time_to_ready_s"] = server.time_to_ready_s
     try:
         got = post({"user": "u1", "num": 10})  # warm (compile + route)
         assert got.get("itemScores"), got
@@ -318,6 +319,9 @@ def _bench_server_p50(factors, n_users: int, n_items: int,
             out["concurrent_microbatch"] = _with_metrics_delta(
                 server.port,
                 lambda: _concurrent_stage(server.port, n_users),
+            )
+            out["concurrent_microbatch"]["time_to_ready_s"] = (
+                server.time_to_ready_s
             )
             mb = service._batcher.to_dict()
             out["concurrent_microbatch"]["mode"] = mb["mode"]
@@ -371,12 +375,34 @@ class _KeepAliveClient:
         self._c.close()
 
 
+def _wait_readyz(port: int, timeout: float = 30.0) -> float:
+    """Poll ``GET /readyz`` until 200 (the orchestrator's view of
+    startup); returns seconds waited."""
+    import urllib.error
+    import urllib.request
+
+    t0 = time.perf_counter()
+    deadline = t0 + timeout
+    while time.perf_counter() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=2.0
+            ) as r:
+                if r.status == 200:
+                    break
+        except (urllib.error.HTTPError, OSError):
+            pass
+        time.sleep(0.02)
+    return time.perf_counter() - t0
+
+
 def _serve_single(variant, microbatch_us: int):
     from pio_tpu.server.query_server import create_query_server
 
     prev = os.environ.pop("PIO_TPU_SERVE_MICROBATCH_US", None)
     if microbatch_us:
         os.environ["PIO_TPU_SERVE_MICROBATCH_US"] = str(microbatch_us)
+    t_boot = time.perf_counter()
     try:
         server, service = create_query_server(
             variant, host="127.0.0.1", port=0
@@ -386,6 +412,10 @@ def _serve_single(variant, microbatch_us: int):
         if prev is not None:
             os.environ["PIO_TPU_SERVE_MICROBATCH_US"] = prev
     server.start()
+    # time-to-ready: server construction (engine + model load) through
+    # the first /readyz 200 — what a rolling deploy actually waits on
+    _wait_readyz(server.port)
+    server.time_to_ready_s = round(time.perf_counter() - t_boot, 4)
     return server, service, _KeepAliveClient(server.port)
 
 
@@ -611,9 +641,12 @@ def _bench_pool_serving(factors, n_users: int, n_items: int) -> dict:
     pool = ServingPool(
         variant, host="127.0.0.1", port=0, n_workers=n_workers
     )
+    t_boot = time.perf_counter()
     pool.start()
     try:
+        # wait_ready polls /readyz, so this is spawn → first worker READY
         pool.wait_ready(timeout=180)
+        time_to_ready_s = round(time.perf_counter() - t_boot, 4)
         warm = _KeepAliveClient(pool.port)
         for _ in range(2 * n_workers):  # hit every worker's first-compile
             warm({"user": "u1", "num": 10})
@@ -627,6 +660,7 @@ def _bench_pool_serving(factors, n_users: int, n_items: int) -> dict:
         )
         got["workers"] = n_workers
         got["host_cores"] = cores
+        got["time_to_ready_s"] = time_to_ready_s
         return got
     finally:
         pool.stop()

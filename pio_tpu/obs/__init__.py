@@ -15,6 +15,20 @@ SURVEY.md §5 observability row):
   buckets into a per-worker stripe of one mmapped segment, so a scrape
   of ANY worker reports pool-wide totals.
 
+The ops plane on top (ISSUE 2):
+
+- **Structured logs** (:mod:`pio_tpu.obs.slog`): every record rendered
+  as one-line JSON carrying the trace id of the enclosing span (the
+  tracer publishes a contextvar), a bounded ring behind
+  ``GET /logs.json``, and ``pio_tpu_log_messages_total`` volume counters.
+- **Health probes** (:mod:`pio_tpu.obs.health`): named liveness
+  (``/healthz`` — heartbeats, critical threads) and readiness
+  (``/readyz`` — engine deployed, storage reachable, pool stripe
+  attached) check registries.
+- **SLO engine** (:mod:`pio_tpu.obs.slo`): declared objectives
+  (``p99=50ms:99.9``) evaluated against the live counters/histograms as
+  multi-window burn rates — ``GET /slo.json`` + ``pio_tpu_slo_*`` gauges.
+
 Plus :mod:`pio_tpu.obs.profile` (the opt-in ``PIO_TPU_PROFILE=dir`` JAX
 profiler hook) and :mod:`pio_tpu.obs.promparse` (a small text-format
 parser shared by tests, bench.py and the dashboard).
@@ -40,19 +54,26 @@ from pio_tpu.obs.metrics import (
     escape_label_value,
     monotonic_s,
 )
+from pio_tpu.obs.health import Heartbeat, HealthMonitor
+from pio_tpu.obs.slo import SLOEngine, SLObjective, parse_slo
 from pio_tpu.obs.tracing import Trace, Tracer
 
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS",
     "Counter",
     "Gauge",
+    "HealthMonitor",
+    "Heartbeat",
     "Histogram",
     "MetricsRegistry",
     "REGISTRY",
     "RequestWindow",
+    "SLOEngine",
+    "SLObjective",
     "Trace",
     "Tracer",
     "escape_help",
     "escape_label_value",
     "monotonic_s",
+    "parse_slo",
 ]

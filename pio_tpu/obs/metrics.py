@@ -281,6 +281,17 @@ class _HistogramCell:
     def sum(self) -> float:
         return self._sum
 
+    def count_le(self, threshold: float,
+                 pool: bool = True) -> Tuple[int, int]:
+        """``(observations ≤ threshold, total observations)`` — the
+        good/total pair a latency SLO needs. The threshold snaps DOWN to
+        the nearest bucket edge (cumulative buckets can't see inside a
+        bucket; snapping down undercounts "good", never overcounts), so
+        declare SLO thresholds on bucket edges. Pool-wide when bound."""
+        buckets, _sum, count = self._snapshot(pool)
+        k = bisect.bisect_right(self._edges, threshold)
+        return sum(buckets[:k]), count
+
     def quantile(self, q: float, pool: bool = False) -> Optional[float]:
         """Bucket-interpolated quantile estimate (linear within the
         winning bucket; the +Inf bucket clamps to its lower edge)."""

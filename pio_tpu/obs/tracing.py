@@ -28,6 +28,7 @@ from pio_tpu.obs.metrics import (
     MetricsRegistry,
     monotonic_s,
 )
+from pio_tpu.obs.slog import TRACE_CONTEXT
 
 
 class Trace:
@@ -89,9 +90,13 @@ class _TraceHandle:
     @contextmanager
     def span(self, stage: str):
         t0 = monotonic_s()
+        # publish (trace_id, stage) so logs emitted inside the span carry
+        # both — slog.JsonLogHandler reads this on every record
+        token = TRACE_CONTEXT.set((self._trace.trace_id, stage))
         try:
             yield
         finally:
+            TRACE_CONTEXT.reset(token)
             dur = monotonic_s() - t0
             self.add_span(stage, dur, rel_start_s=t0 - self._trace.t0)
 
@@ -154,12 +159,16 @@ class Tracer:
         if meta:
             t.meta.update(meta)
         handle = _TraceHandle(self, t)
+        # any log line emitted while this trace is open — even outside a
+        # named span — correlates to the request via /logs.json?trace_id=
+        token = TRACE_CONTEXT.set((trace_id, None))
         try:
             yield handle
         except BaseException:
             t.error = True
             raise
         finally:
+            TRACE_CONTEXT.reset(token)
             t.total_s = monotonic_s() - t.t0
             with self._lock:
                 if len(self._ring) < self._ring_cap:

@@ -26,9 +26,13 @@ import html as _html
 import json
 from typing import Any, Optional, Tuple
 
-from pio_tpu.obs import MetricsRegistry
+from pio_tpu.obs import HealthMonitor, MetricsRegistry
+from pio_tpu.obs import slog
 from pio_tpu.obs.promparse import ParsedMetrics, parse_prometheus_text
-from pio_tpu.server.http import JsonHTTPServer, RawResponse, Request, Router
+from pio_tpu.server.http import (
+    HTTPError, JsonHTTPServer, RawResponse, Request, Router, int_param,
+    metrics_response,
+)
 from pio_tpu.storage import RunStatus, Storage
 
 _CORS = {"Access-Control-Allow-Origin": "*"}
@@ -65,6 +69,10 @@ class DashboardService:
             "Dashboard page renders",
             ("page",),
         )
+        slog.install()
+        self.obs.add_collector(slog.exposition_lines)
+        self.health = HealthMonitor()
+        self.health.add_readiness("storage", self._check_storage_ready)
         self.router = Router()
         self.router.add("GET", "/", self.index)
         self.router.add("GET", "/instances\\.json", self.list_json)
@@ -72,6 +80,9 @@ class DashboardService:
         self.router.add("GET", "/instances/([^/]+)\\.html", self.get_html)
         self.router.add("GET", "/serving\\.html", self.serving)
         self.router.add("GET", "/metrics", self.get_metrics)
+        self.router.add("GET", "/logs\\.json", self.get_logs)
+        self.router.add("GET", "/healthz", self.healthz)
+        self.router.add("GET", "/readyz", self.readyz)
 
     def _completed(self):
         return Storage.get_meta_data_evaluation_instances().get_completed()
@@ -132,11 +143,34 @@ class DashboardService:
         )
         return 200, _html_response(body)
 
+    # -- health/logs (ISSUE 2) ----------------------------------------------
+    def _check_storage_ready(self):
+        Storage.get_meta_data_evaluation_instances()
+        return True, "metadata store reachable"
+
+    def healthz(self, req: Request) -> Tuple[int, Any]:
+        ok, report = self.health.liveness()
+        return (200 if ok else 503), report
+
+    def readyz(self, req: Request) -> Tuple[int, Any]:
+        ok, report = self.health.readiness()
+        return (200 if ok else 503), report
+
+    def get_logs(self, req: Request) -> Tuple[int, Any]:
+        n = int_param(req.params, "n", 100, lo=0, hi=slog.ring().cap)
+        try:
+            return 200, slog.logs_payload(
+                n=n,
+                level=req.params.get("level"),
+                trace_id=req.params.get("trace_id"),
+                logger=req.params.get("logger"),
+            )
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+
     # -- serving observability (ISSUE 1) ------------------------------------
     def get_metrics(self, req: Request) -> Tuple[int, Any]:
-        from pio_tpu.server.metrics import render
-
-        return 200, render(self.obs.render())
+        return 200, metrics_response(self.obs.render())
 
     def _scrape_query_server(self) -> Tuple[Optional[ParsedMetrics],
                                             Optional[dict], str]:
@@ -153,6 +187,67 @@ class DashboardService:
             return pm, status, ""
         except Exception as e:
             return None, None, f"{type(e).__name__}: {e}"
+
+    def _fetch_json(self, path: str) -> Optional[dict]:
+        """Best-effort GET of a query-server JSON endpoint (None on any
+        failure — the serving page degrades panel-by-panel)."""
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                self.query_url + path, timeout=3.0
+            ) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except Exception:
+            return None
+
+    def _slo_panel(self) -> str:
+        """SLO/error-budget table from the query server's /slo.json."""
+        data = self._fetch_json("/slo.json")
+        if not data or not data.get("slos"):
+            return (
+                "<h2>SLOs</h2><p>none configured "
+                "(<code>pio deploy --slo p99=50ms:99.9</code>)</p>"
+            )
+        rows = []
+        for s in data["slos"]:
+            firing = [a["severity"] for a in s.get("alerts", []) if a["firing"]]
+            burns = s.get("burnRates", {})
+            fast = burns.get("300s")
+            slow = burns.get("3600s")
+            rows.append(
+                f"<tr><td>{_html.escape(s['name'])}</td>"
+                f"<td>{s['objective'] * 100:.3g}%</td>"
+                f"<td>{int(s['total'])}</td><td>{int(s['errors'])}</td>"
+                f"<td>{s['errorBudgetRemaining'] * 100:.1f}%</td>"
+                f"<td>{fast if fast is not None else 'n/a'}</td>"
+                f"<td>{slow if slow is not None else 'n/a'}</td>"
+                f"<td>{_html.escape(', '.join(firing) or '-')}</td></tr>"
+            )
+        return (
+            "<h2>SLOs</h2>"
+            "<table><tr><th>objective</th><th>target</th><th>requests</th>"
+            "<th>errors</th><th>budget left</th><th>burn 5m</th>"
+            "<th>burn 1h</th><th>alerts</th></tr>"
+            + "".join(rows) + "</table>"
+        )
+
+    def _log_panel(self, n: int = 25) -> str:
+        """Live tail of the query server's structured log ring."""
+        data = self._fetch_json(f"/logs.json?n={n}")
+        if not data or not data.get("logs"):
+            return "<h2>Recent logs</h2><p>no log entries</p>"
+        lines = []
+        for e in data["logs"]:
+            trace = f" [{e['trace_id']}]" if e.get("trace_id") else ""
+            lines.append(_html.escape(
+                f"{e.get('ts', '')} {e.get('level', ''):7s}"
+                f"{trace} {e.get('logger', '')}: {e.get('msg', '')}"
+            ))
+        return (
+            "<h2>Recent logs</h2><pre style='background:#f6f6f6;"
+            "padding:1em;overflow-x:auto'>" + "\n".join(lines) + "</pre>"
+        )
 
     def serving(self, req: Request) -> Tuple[int, Any]:
         """Live serving view: pool-wide request totals + avg QPS since
@@ -227,7 +322,8 @@ class DashboardService:
             + "</table>"
         )
         return 200, _html_response(
-            head + summary + stage_table + "</body></html>"
+            head + summary + stage_table + self._slo_panel()
+            + self._log_panel() + "</body></html>"
         )
 
 

@@ -22,13 +22,21 @@ from __future__ import annotations
 
 import datetime as _dt
 import logging
+import os
 import threading
 from contextlib import nullcontext
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from pio_tpu.data.event import Event, EventValidationError
-from pio_tpu.obs import MetricsRegistry, RequestWindow, Tracer, monotonic_s
-from pio_tpu.server.http import HTTPError, JsonHTTPServer, Request, Router
+from pio_tpu.obs import (
+    HealthMonitor, MetricsRegistry, RequestWindow, Tracer, monotonic_s,
+)
+from pio_tpu.obs import slog
+from pio_tpu.obs.slo import engine_for_specs
+from pio_tpu.server.http import (
+    HTTPError, JsonHTTPServer, Request, Router, float_param, int_param,
+    metrics_response,
+)
 from pio_tpu.server.webhooks import (
     FORM_CONNECTORS,
     JSON_CONNECTORS,
@@ -123,7 +131,7 @@ class EventServerService:
     #: so a fresh key works immediately).
     AUTH_CACHE_TTL_S = 2.0
 
-    def __init__(self):
+    def __init__(self, slos: Optional[List[str]] = None):
         #: per-instance registry — see query_server (test servers must
         #: not cross-pollinate scrapes through a process global)
         self.obs = MetricsRegistry()
@@ -132,9 +140,34 @@ class EventServerService:
             "Events by app/event/status",
             ("app_id", "event", "entity_type", "status"),
         )
+        #: full-request latency of the ingest write paths — the latency
+        #: SLO source (see query_server's pio_request_seconds)
+        self._request_hist = self.obs.histogram(
+            "pio_request_seconds",
+            "Full-request wall seconds of the event write paths",
+            ("engine_id",),
+        )
+        self._request_cell = self._request_hist.labels("eventserver")
         self.tracer = Tracer("event", registry=self.obs, stages=EVENT_STAGES)
         self.req_window = RequestWindow()
         self.stats = _Stats(counter=self._events_counter)
+        slog.install()
+        self.obs.add_collector(slog.exposition_lines)
+        # -- health probes (ISSUE 2) --
+        self.health = HealthMonitor()
+        self.health.add_liveness("group_commit", self._check_group_commit)
+        self.health.add_readiness("storage", self._check_storage_ready)
+        # -- SLO engine (optional; specs from the caller or PIO_TPU_SLO) --
+        if slos is None:
+            env_slos = os.environ.get("PIO_TPU_SLO", "")
+            slos = [s for s in env_slos.split(",") if s.strip()]
+        self.slo = None
+        if slos:
+            self.slo = engine_for_specs(
+                slos, self.obs,
+                availability_source=self._availability_good_total,
+                latency_cell_getter=lambda: self._request_cell,
+            )
         self._auth_cache: dict = {}
         self._auth_gen = 0  # bumped by invalidation; fences re-caching
         self._auth_cache_lock = threading.Lock()
@@ -153,6 +186,10 @@ class EventServerService:
         r.add("GET", "/stats\\.json", self.get_stats)
         r.add("GET", "/metrics", self.get_metrics)
         r.add("GET", "/traces\\.json", self.get_traces)
+        r.add("GET", "/logs\\.json", self.get_logs)
+        r.add("GET", "/slo\\.json", self.get_slo)
+        r.add("GET", "/healthz", self.healthz)
+        r.add("GET", "/readyz", self.readyz)
         r.add("POST", "/webhooks/([^/]+)\\.json", self.webhook_json)
         r.add("POST", "/webhooks/([^/]+)\\.form", self.webhook_form)
         r.add("GET", "/plugins\\.json", self.list_plugins)
@@ -211,6 +248,61 @@ class EventServerService:
     def alive(self, req: Request):
         return 200, {"status": "alive"}
 
+    # -- health/readiness (ISSUE 2) -----------------------------------------
+    def _check_group_commit(self):
+        """Liveness via the event store's group committer, when it has
+        one: the commit lock must be acquirable (a leader wedged inside
+        a hung backend flush holds it forever — see
+        :meth:`GroupCommitter.probe`). Backends without group commit
+        pass vacuously."""
+        try:
+            gc = getattr(Storage.get_levents(), "_gc", None)
+        except Exception as e:
+            return False, f"event store unavailable: {e}"
+        if gc is None:
+            return True, "no group committer (backend writes directly)"
+        return gc.probe(timeout=0.5)
+
+    def _check_storage_ready(self):
+        """Readiness: both stores this server writes/authenticates
+        against must answer."""
+        Storage.get_meta_data_access_keys()
+        Storage.get_levents()
+        return True, "event + metadata stores reachable"
+
+    def _availability_good_total(self):
+        w = self.req_window
+        total = w.count
+        errors = w.errors
+        return total - errors, total
+
+    def healthz(self, req: Request):
+        ok, report = self.health.liveness()
+        return (200 if ok else 503), report
+
+    def readyz(self, req: Request):
+        ok, report = self.health.readiness()
+        return (200 if ok else 503), report
+
+    def get_logs(self, req: Request):
+        n = int_param(req.params, "n", 100, lo=0, hi=slog.ring().cap)
+        try:
+            return 200, slog.logs_payload(
+                n=n,
+                level=req.params.get("level"),
+                trace_id=req.params.get("trace_id"),
+                logger=req.params.get("logger"),
+            )
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+
+    def get_slo(self, req: Request):
+        if self.slo is None:
+            return 200, {"slos": [], "configured": False}
+        out = self.slo.evaluate()
+        out["configured"] = True
+        return 200, out
+
     def _validate_one(self, d: Any, app_id: int, channel_id, whitelist,
                       tr=None):
         """JSON → validated Event (whitelist + input blockers applied)."""
@@ -263,7 +355,9 @@ class EventServerService:
                 error = False
                 return 201, {"eventId": event_id}
         finally:
-            self.req_window.record((monotonic_s() - t0) * 1e3, error)
+            dur_s = monotonic_s() - t0
+            self.req_window.record(dur_s * 1e3, error)
+            self._request_cell.observe(dur_s)
 
     def batch_events(self, req: Request):
         app_id, channel_id, whitelist = self._auth(req)
@@ -283,7 +377,9 @@ class EventServerService:
                 error = False
                 return out
         finally:
-            self.req_window.record((monotonic_s() - t0) * 1e3, error)
+            dur_s = monotonic_s() - t0
+            self.req_window.record(dur_s * 1e3, error)
+            self._request_cell.observe(dur_s)
 
     def _batch_events(self, req, app_id, channel_id, whitelist, tr):
         # validate every item first (per-item status contract), then land
@@ -400,10 +496,7 @@ class EventServerService:
         parity block: request count/errors and latency percentiles for
         the ingest write path; ``?window=SECONDS`` narrows to the
         trailing window (reservoir-backed, like the query server)."""
-        try:
-            window_s = float(req.params.get("window", "0"))
-        except (TypeError, ValueError):
-            window_s = 0.0
+        window_s = float_param(req.params, "window", 0.0, lo=0.0)
         if window_s > 0:
             return 200, self.req_window.window(window_s)
         out = self.stats.to_dict()
@@ -434,15 +527,10 @@ class EventServerService:
         return out
 
     def get_metrics(self, req: Request):
-        from pio_tpu.server.metrics import render
-
-        return 200, render(self.obs.render())
+        return 200, metrics_response(self.obs.render())
 
     def get_traces(self, req: Request):
-        try:
-            n = int(req.params.get("n", "20"))
-        except (TypeError, ValueError):
-            n = 20
+        n = int_param(req.params, "n", 20, lo=0, hi=self.tracer._ring_cap)
         order = req.params.get("order", "slowest")
         return 200, {
             "traces": self.tracer.recent(n, slowest=(order != "recent")),
@@ -470,7 +558,9 @@ class EventServerService:
                 error = False
                 return 201, {"eventId": event_id}
         finally:
-            self.req_window.record((monotonic_s() - t0) * 1e3, error)
+            dur_s = monotonic_s() - t0
+            self.req_window.record(dur_s * 1e3, error)
+            self._request_cell.observe(dur_s)
 
     def webhook_form(self, req: Request):
         app_id, channel_id, whitelist = self._auth(req)
@@ -497,15 +587,22 @@ class EventServerService:
                 error = False
                 return 201, {"eventId": event_id}
         finally:
-            self.req_window.record((monotonic_s() - t0) * 1e3, error)
+            dur_s = monotonic_s() - t0
+            self.req_window.record(dur_s * 1e3, error)
+            self._request_cell.observe(dur_s)
 
 
 def create_event_server(
-    host: str = "0.0.0.0", port: int = 7070
+    host: str = "0.0.0.0", port: int = 7070,
+    slos: Optional[List[str]] = None,
 ) -> JsonHTTPServer:
     """Build (unstarted) server — reference ``EventServer.createEventServer``."""
     from pio_tpu.server.plugins import load_plugins_from_env
 
     load_plugins_from_env()
-    service = EventServerService()
-    return JsonHTTPServer(service.router, host, port, name="pio-tpu-eventserver")
+    service = EventServerService(slos=slos)
+    server = JsonHTTPServer(
+        service.router, host, port, name="pio-tpu-eventserver"
+    )
+    server.service = service  # reachable for embedding/tests
+    return server

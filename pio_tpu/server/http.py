@@ -112,6 +112,57 @@ class HTTPError(Exception):
         self.message = message
 
 
+#: Prometheus scrape content type (text format 0.0.4).
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def metrics_response(lines: List[str]) -> RawResponse:
+    """Wrap exposition lines in the Prometheus scrape content type —
+    the one ``GET /metrics`` handler body every server shares."""
+    return RawResponse(
+        "\n".join(lines) + "\n", content_type=METRICS_CONTENT_TYPE
+    )
+
+
+def int_param(params: Dict[str, str], name: str, default: int,
+              lo: Optional[int] = None,
+              hi: Optional[int] = None) -> int:
+    """Validated integer query param: non-integer or below ``lo`` → 400
+    (a typo'd ``?n=`` must not silently fall back to the default, and a
+    negative count is a client error, not an empty result); values above
+    ``hi`` clamp (asking for more than the ring holds is well-defined)."""
+    raw = params.get(name)
+    if raw is None:
+        return default
+    try:
+        v = int(raw)
+    except (TypeError, ValueError):
+        raise HTTPError(400, f"query param {name}={raw!r} is not an integer")
+    if lo is not None and v < lo:
+        raise HTTPError(400, f"query param {name} must be >= {lo}")
+    if hi is not None and v > hi:
+        v = hi
+    return v
+
+
+def float_param(params: Dict[str, str], name: str, default: float,
+                lo: Optional[float] = None) -> float:
+    """Validated float query param — same contract as :func:`int_param`
+    (``/stats.json?window=abc`` is a 400, not a silent cumulative view)."""
+    raw = params.get(name)
+    if raw is None:
+        return default
+    try:
+        v = float(raw)
+    except (TypeError, ValueError):
+        raise HTTPError(400, f"query param {name}={raw!r} is not a number")
+    if v != v:  # NaN compares unequal to itself
+        raise HTTPError(400, f"query param {name} must be a finite number")
+    if lo is not None and v < lo:
+        raise HTTPError(400, f"query param {name} must be >= {lo:g}")
+    return v
+
+
 class Router:
     """Method+regex route table."""
 

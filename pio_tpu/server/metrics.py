@@ -1,20 +1,28 @@
-"""Prometheus text-exposition helpers for the HTTP servers.
+"""DEPRECATED compatibility shim — import from ``pio_tpu.obs`` (escaping
+helpers) and ``pio_tpu.server.http`` (``metrics_response``) instead.
 
-Since ISSUE 1 the real machinery lives in :mod:`pio_tpu.obs` — typed
-Counter/Gauge/Histogram families with ``# HELP``/``# TYPE`` exposition,
-per-stage histograms and pool-wide shared-memory aggregation. This
-module remains as the thin HTTP-facing shim: ``render`` wraps exposition
-lines in the proper scrape content type, and ``escape_label`` stays as a
-compatibility wrapper over the obs escaping helpers (existing plugins
-import it from here).
+Everything this module once provided has a real home now: the metric
+types and escaping live in :mod:`pio_tpu.obs.metrics`, and the HTTP
+scrape wrapper is :func:`pio_tpu.server.http.metrics_response`. The last
+in-tree callers have been rerouted; this shim remains one release for
+out-of-tree plugins that ``from pio_tpu.server.metrics import
+escape_label`` and will be deleted in a later PR.
 """
 
 from __future__ import annotations
 
-from pio_tpu.obs.metrics import escape_help, escape_label_value
+import warnings
 
-#: Prometheus scrape content type (text format 0.0.4).
-CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+from pio_tpu.obs.metrics import escape_help, escape_label_value
+from pio_tpu.server.http import METRICS_CONTENT_TYPE as CONTENT_TYPE
+from pio_tpu.server.http import metrics_response
+
+warnings.warn(
+    "pio_tpu.server.metrics is deprecated: import escaping helpers from "
+    "pio_tpu.obs and metrics_response from pio_tpu.server.http",
+    DeprecationWarning,
+    stacklevel=2,
+)
 
 
 def escape_label(value: str) -> str:
@@ -24,11 +32,9 @@ def escape_label(value: str) -> str:
 
 
 def render(lines: list) -> "object":
-    """Wrap exposition lines (a list — the one shape every metric surface
-    uses) in the proper content type."""
-    from pio_tpu.server.http import RawResponse
-
-    return RawResponse("\n".join(lines) + "\n", content_type=CONTENT_TYPE)
+    """Compatibility wrapper over
+    :func:`pio_tpu.server.http.metrics_response`."""
+    return metrics_response(lines)
 
 
 __all__ = ["CONTENT_TYPE", "escape_help", "escape_label", "render"]

@@ -30,11 +30,17 @@ from typing import Any, List, Optional, Tuple
 from pio_tpu.controller.engine import Engine, EngineParams
 from pio_tpu.controller.params import ParamsError, params_from_dict
 from pio_tpu.data.event import Event
-from pio_tpu.obs import MetricsRegistry, RequestWindow, Tracer, monotonic_s
+from pio_tpu.obs import (
+    Heartbeat, HealthMonitor, MetricsRegistry, RequestWindow, Tracer,
+    monotonic_s,
+)
+from pio_tpu.obs import slog
 from pio_tpu.obs.profile import DeviceProfileHook
+from pio_tpu.obs.slo import engine_for_specs
 from pio_tpu.parallel.context import ComputeContext
 from pio_tpu.server.http import (
-    HTTPError, JsonHTTPServer, Request, Router, keys_equal,
+    HTTPError, JsonHTTPServer, Request, Router, float_param, int_param,
+    keys_equal, metrics_response,
 )
 from pio_tpu.storage import Storage
 from pio_tpu.workflow.core_workflow import load_models_for_instance
@@ -288,6 +294,7 @@ class QueryServerService:
         feedback: bool = False,
         feedback_app_id: Optional[int] = None,
         admin_key: Optional[str] = None,
+        slos: Optional[List[str]] = None,
     ):
         self.variant = variant
         self.ctx = ctx or ComputeContext.create()
@@ -307,15 +314,51 @@ class QueryServerService:
         self._query_errors_total = self.obs.counter(
             "pio_query_errors_total", "Queries that errored", ("engine_id",)
         )
+        #: full-request latency histogram — the SLO engine's latency
+        #: source (stage histograms cover WHERE time went; this one
+        #: covers the request the client saw)
+        self._request_hist = self.obs.histogram(
+            "pio_request_seconds",
+            "Full-request wall seconds of /queries.json",
+            ("engine_id",),
+        )
         # pre-create the cells so pool-mode slot layout sees them at init
         self._queries_total.labels(eng)
         self._query_errors_total.labels(eng)
+        self._request_cell = self._request_hist.labels(eng)
         self.tracer = Tracer(
             "query", registry=self.obs, stages=QUERY_STAGES,
             extra_labels={"engine_id": eng},
         )
         self.stats = RequestWindow()
         self.obs.add_collector(self._compat_metric_lines)
+        # structured-log ring (process-wide install is record-only; the
+        # CLI switches console rendering) + log-volume counter re-export
+        slog.install()
+        self.obs.add_collector(slog.exposition_lines)
+        # -- health probes (ISSUE 2) --
+        self.heartbeat = Heartbeat(max_age_s=float(
+            os.environ.get("PIO_TPU_HEARTBEAT_MAX_AGE_S", "30")
+        ))
+        self.health = HealthMonitor()
+        self.health.add_liveness("http_loop", self._http_loop_alive)
+        self.health.add_critical_thread(
+            "microbatch_worker",
+            lambda: getattr(self._batcher, "_thread", None),
+        )
+        self.health.add_readiness("engine", self._check_engine_ready)
+        self.health.add_readiness("storage", self._check_storage_ready)
+        # -- SLO engine (ISSUE 2): specs from the caller or PIO_TPU_SLO --
+        if slos is None:
+            env_slos = os.environ.get("PIO_TPU_SLO", "")
+            slos = [s for s in env_slos.split(",") if s.strip()]
+        self.slo = None
+        if slos:
+            self.slo = engine_for_specs(
+                slos, self.obs,
+                availability_source=self._availability_good_total,
+                latency_cell_getter=lambda: self._request_cell,
+            )
         self.profile_hook = DeviceProfileHook.from_env()
         self._swap_lock = threading.Lock()
         self._deployed = True
@@ -347,6 +390,10 @@ class QueryServerService:
         r.add("GET", "/stats\\.json", self.get_stats)
         r.add("GET", "/metrics", self.get_metrics)
         r.add("GET", "/traces\\.json", self.get_traces)
+        r.add("GET", "/logs\\.json", self.get_logs)
+        r.add("GET", "/slo\\.json", self.get_slo)
+        r.add("GET", "/healthz", self.healthz)
+        r.add("GET", "/readyz", self.readyz)
         r.add("POST", "/reload", self.reload)
         r.add("POST", "/undeploy", self.undeploy)
         r.add("GET", "/plugins\\.json", self.list_plugins)
@@ -381,6 +428,73 @@ class QueryServerService:
             "startTime": self.start_time.isoformat(),
             "requestCount": self.stats.count,
         }
+
+    # -- health/readiness (ISSUE 2) -----------------------------------------
+    def _http_loop_alive(self):
+        """Liveness: the attached server's accept-loop thread. When the
+        server runs ``serve_forever`` in the main thread (or none is
+        attached — embedded use), there is no thread to check: pass."""
+        server = self._server
+        t = getattr(server, "_thread", None) if server is not None else None
+        if t is None:
+            return True, "accept loop not thread-managed"
+        return t.is_alive(), "accept loop thread " + (
+            "alive" if t.is_alive() else "dead"
+        )
+
+    def _check_engine_ready(self):
+        with self._swap_lock:
+            ok = self._deployed and bool(self.pairs)
+            iid = self.instance_id
+        if not self._deployed:
+            return False, "undeployed"
+        return ok, f"instance {iid}" if ok else "no algorithms loaded"
+
+    def _check_storage_ready(self):
+        """Readiness: the metadata store must answer, and the deployed
+        instance must still exist there (a vanished record means /reload
+        can never succeed)."""
+        rec = Storage.get_meta_data_engine_instances().get(self.instance_id)
+        if rec is None:
+            return False, f"instance {self.instance_id} not in metadata store"
+        return True, "metadata store reachable"
+
+    def _availability_good_total(self):
+        eng = self.variant.engine_id
+        total = self._queries_total.value(eng)
+        errors = self._query_errors_total.value(eng)
+        return total - errors, total
+
+    def healthz(self, req: Request):
+        ok, report = self.health.liveness()
+        return (200 if ok else 503), report
+
+    def readyz(self, req: Request):
+        ok, report = self.health.readiness()
+        return (200 if ok else 503), report
+
+    def get_logs(self, req: Request):
+        """Recent structured log entries from the in-process ring,
+        filterable by minimum level and exact trace id."""
+        n = int_param(req.params, "n", 100, lo=0, hi=slog.ring().cap)
+        try:
+            return 200, slog.logs_payload(
+                n=n,
+                level=req.params.get("level"),
+                trace_id=req.params.get("trace_id"),
+                logger=req.params.get("logger"),
+            )
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+
+    def get_slo(self, req: Request):
+        """Burn-rate evaluation of the configured SLOs against the live
+        counters/histograms (empty when none were declared)."""
+        if self.slo is None:
+            return 200, {"slos": [], "configured": False}
+        out = self.slo.evaluate()
+        out["configured"] = True
+        return 200, out
 
     def _parse_query(self, body: Any, qc):
         if body is None:
@@ -417,6 +531,13 @@ class QueryServerService:
         self._pool_gen = gen
         self._pool_shutdown = shutdown_evt
         self._seen_gen = gen.value
+        # pool-mode probes: worker main loop beats the heartbeat; the
+        # supervisor's /healthz poll catches a wedged loop. Readiness
+        # additionally requires the shared metrics stripe (without it
+        # this worker silently under-reports every pool-wide scrape).
+        slog.set_worker(str(idx))
+        self.health.add_liveness("event_loop", self.heartbeat.check)
+        self.health.add_readiness("pool_stripe", self._check_pool_stripe)
         if metrics_path:
             from pio_tpu.obs.shm import PoolMetricsSegment
 
@@ -428,6 +549,11 @@ class QueryServerService:
                     "pool metrics segment bind failed; this worker "
                     "exposes local-only metrics"
                 )
+
+    def _check_pool_stripe(self):
+        if self.obs.pool_bound:
+            return True, f"stripe {self._pool_idx} bound"
+        return False, "shared metrics segment not bound"
 
     def _pool_sync(self) -> None:
         gen = self._pool_gen
@@ -490,10 +616,17 @@ class QueryServerService:
                         except Exception:
                             log.exception("query sniffer failed")
                 error = False
+                # inside the trace → this record carries the trace id,
+                # joining /logs.json?trace_id=... to /traces.json
+                log.info(
+                    "served query engine=%s ms=%.3f", eng,
+                    (monotonic_s() - t0) * 1e3,
+                )
                 return 200, out
         finally:
             dur_s = monotonic_s() - t0
             self.stats.record(dur_s * 1e3, error)
+            self._request_cell.observe(dur_s)
             self._queries_total.inc(engine_id=eng)
             if error:
                 self._query_errors_total.inc(engine_id=eng)
@@ -541,10 +674,7 @@ class QueryServerService:
         ]
 
     def get_stats(self, req: Request):
-        try:
-            window_s = float(req.params.get("window", "0"))
-        except (TypeError, ValueError):
-            window_s = 0.0
+        window_s = float_param(req.params, "window", 0.0, lo=0.0)
         if window_s > 0:
             out = self.stats.window(window_s)
         else:
@@ -596,10 +726,10 @@ class QueryServerService:
         """Extra exposition lines kept from the pre-obs server: the
         latency summary (quantile convention) and micro-batch counters —
         existing scrapes and the bench parse these."""
-        from pio_tpu.server.metrics import escape_label
+        from pio_tpu.obs import escape_label_value
 
         s = self.stats.to_dict()
-        eng = escape_label(self.variant.engine_id)
+        eng = escape_label_value(self.variant.engine_id)
         lab = f'engine_id="{eng}"'
         lines = []
         if s["avgMs"] is not None:
@@ -634,16 +764,12 @@ class QueryServerService:
         error counters, per-stage latency histograms, plus the legacy
         summary + micro-batch lines via the compat collector. In pool
         mode counters/histograms are POOL-WIDE (shared-memory sums)."""
-        from pio_tpu.server.metrics import render
-
-        return 200, render(self.obs.render())
+        return 200, metrics_response(self.obs.render())
 
     def get_traces(self, req: Request):
-        """Recent request traces (ring buffer), slowest first."""
-        try:
-            n = int(req.params.get("n", "20"))
-        except (TypeError, ValueError):
-            n = 20
+        """Recent request traces (ring buffer), slowest first. ``n`` is
+        clamped to the ring capacity; negatives/non-ints are a 400."""
+        n = int_param(req.params, "n", 20, lo=0, hi=self.tracer._ring_cap)
         order = req.params.get("order", "slowest")
         return 200, {
             "traces": self.tracer.recent(n, slowest=(order != "recent")),
@@ -710,12 +836,14 @@ def create_query_server(
     feedback_app_id: Optional[int] = None,
     admin_key: Optional[str] = None,
     reuse_port: bool = False,
+    slos: Optional[List[str]] = None,
 ) -> Tuple[JsonHTTPServer, QueryServerService]:
     from pio_tpu.server.plugins import load_plugins_from_env
 
     load_plugins_from_env()
     service = QueryServerService(
-        variant, instance_id, ctx, feedback, feedback_app_id, admin_key
+        variant, instance_id, ctx, feedback, feedback_app_id, admin_key,
+        slos=slos,
     )
     server = JsonHTTPServer(
         service.router, host, port, name="pio-tpu-queryserver",

@@ -55,8 +55,14 @@ log = logging.getLogger("pio_tpu.workerpool")
 #: processes on it
 _MAX_RESPAWNS = 3
 
+#: consecutive /healthz failures before the supervisor kills a worker —
+#: one failed poll is a blip (GC pause, slow scrape); K in a row on a
+#: 1 s-timeout probe is a wedge
+_HEALTH_FAILS_TO_KILL = 3
 
-def _worker_main(spec: dict, idx: int, gen, shutdown_evt) -> None:
+
+def _worker_main(spec: dict, idx: int, gen, shutdown_evt,
+                 health_ports=None) -> None:
     """Entry point of one pool worker (spawned process)."""
     if not (spec["device_worker"] and idx == 0):
         # host-mirror scoring only; pin JAX to CPU before ANY import can
@@ -70,6 +76,7 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt) -> None:
         except Exception:  # jax missing/unconfigurable → host numpy only
             pass
 
+    from pio_tpu.server.http import JsonHTTPServer
     from pio_tpu.server.query_server import create_query_server
 
     variant = EngineVariant(**spec["variant"])
@@ -85,6 +92,7 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt) -> None:
         feedback_app_id=spec.get("feedback_app_id"),
         admin_key=spec.get("admin_key"),
         reuse_port=True,
+        slos=spec.get("slos"),
     )
     service.enable_pool(
         idx, spec["n_workers"], gen, shutdown_evt,
@@ -92,6 +100,23 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt) -> None:
     )
     service.attach_server(server)
     server.start()
+    # health sidecar: the pool shares ONE SO_REUSEPORT port, so the
+    # supervisor cannot address a SPECIFIC worker through it (the kernel
+    # picks the listener). Each worker therefore also serves its full
+    # router on a loopback-only ephemeral port and publishes that port
+    # through the shared array — the supervisor polls sidecar /healthz.
+    sidecar = None
+    if health_ports is not None:
+        try:
+            sidecar = JsonHTTPServer(
+                service.router, "127.0.0.1", 0,
+                name=f"pio-tpu-health-{idx}",
+            )
+            sidecar.start()
+            health_ports[idx] = sidecar.port
+        except Exception:
+            log.exception("worker %d health sidecar failed to start", idx)
+            sidecar = None
     log.info("pool worker %d serving on :%d", idx, server.port)
     try:
         # POLL the event — never park in Event.wait(): a worker killed
@@ -101,10 +126,15 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt) -> None:
         # on the SHARED event blocks forever and /undeploy can no longer
         # stop the pool. is_set() holds the internal lock only for
         # microseconds, shrinking the corruption window to ~nothing.
+        # Each iteration beats the heartbeat: a wedged loop ages it out
+        # and the supervisor's /healthz poll turns 503.
         while not shutdown_evt.is_set():
+            service.heartbeat.beat()
             time.sleep(0.25)
     except KeyboardInterrupt:
         pass
+    if sidecar is not None:
+        sidecar.stop()
     server.stop()
 
 
@@ -122,6 +152,7 @@ class ServingPool:
         feedback_app_id: Optional[int] = None,
         admin_key: Optional[str] = None,
         device_worker: bool = False,
+        slos: Optional[list] = None,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -157,10 +188,24 @@ class ServingPool:
             "feedback_app_id": feedback_app_id,
             "admin_key": admin_key,
             "device_worker": device_worker,
+            "slos": list(slos) if slos else None,
         }
         self.n_workers = n_workers
         self._procs: list = []
         self._respawns = [0] * n_workers
+        #: sidecar health ports, published by each worker once its
+        #: loopback health server is up (0 = not yet / unavailable)
+        self._health_ports = self._ctx.Array("i", [0] * n_workers)
+        self._health_fails = [0] * n_workers
+        from pio_tpu.obs import REGISTRY
+
+        #: 1 = healthy, 0 = failing /healthz, -1 = process dead
+        self._health_gauge = REGISTRY.gauge(
+            "pio_tpu_worker_health_state",
+            "Supervisor view of each pool worker "
+            "(1 healthy, 0 unhealthy, -1 dead)",
+            ("worker",),
+        )
         # cross-worker metrics: the supervisor owns a fixed-layout
         # shared-memory segment; every worker mmaps its own stripe, so a
         # /metrics scrape on ANY worker can sum pool-wide totals
@@ -185,9 +230,14 @@ class ServingPool:
             )
 
     def _spawn(self, idx: int):
+        self._health_ports[idx] = 0  # stale port from a previous life
+        self._health_fails[idx] = 0
         p = self._ctx.Process(
             target=_worker_main,
-            args=(self._spec, idx, self._gen, self._shutdown),
+            args=(
+                self._spec, idx, self._gen, self._shutdown,
+                self._health_ports,
+            ),
             name=f"pio-tpu-serve-{idx}",
             daemon=True,
         )
@@ -199,36 +249,101 @@ class ServingPool:
         return self
 
     def wait_ready(self, timeout: float = 60.0) -> None:
-        """Block until a worker answers on the port (deploy readiness)."""
+        """Block until a worker reports READY (deploy readiness): a plain
+        TCP accept is not enough — a worker accepts connections before
+        its engine finished loading — so this polls ``GET /readyz`` until
+        a 200 (falling back to TCP-accept only if /readyz keeps erroring
+        at the HTTP layer, which cannot happen with in-tree workers)."""
+        import urllib.error
+        import urllib.request
+
         deadline = time.monotonic() + timeout
-        last_err: Optional[Exception] = None
+        last_err: Optional[BaseException] = None
+        probe_host = (
+            "127.0.0.1" if self._host in ("", "0.0.0.0", "::")
+            else self._host
+        )
         while time.monotonic() < deadline:
             if self._shutdown.is_set():
                 raise RuntimeError("pool shut down during startup")
-            probe_host = (
-                "127.0.0.1" if self._host in ("", "0.0.0.0", "::")
-                else self._host
-            )
             try:
-                with socket.create_connection(
-                    (probe_host, self.port), timeout=2.0
-                ):
-                    return
+                with urllib.request.urlopen(
+                    f"http://{probe_host}:{self.port}/readyz", timeout=2.0
+                ) as r:
+                    if r.status == 200:
+                        return
+            except urllib.error.HTTPError as e:
+                last_err = e  # reachable but not ready (503) — keep polling
             except OSError as e:
                 last_err = e
                 if all(not p.is_alive() for p in self._procs):
                     raise RuntimeError(
                         "every pool worker exited during startup"
                     ) from e
-                time.sleep(0.1)
+            time.sleep(0.1)
         raise TimeoutError(
-            f"no pool worker answering on :{self.port}: {last_err}"
+            f"no pool worker ready on :{self.port}: {last_err}"
         )
 
-    def wait(self, poll_s: float = 0.5) -> None:
+    def _poll_worker_health(self, idx: int) -> Optional[bool]:
+        """One /healthz probe of worker ``idx``'s loopback sidecar.
+        None = no sidecar port published yet (can't judge)."""
+        port = self._health_ports[idx]
+        if port <= 0:
+            return None
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=1.0
+            ) as r:
+                return r.status == 200
+        except Exception:
+            # 503 raises HTTPError; a wedged worker times out — both are
+            # health failures for the consecutive-failure counter
+            return False
+
+    def _health_sweep(self) -> None:
+        """Poll every live worker's sidecar; kill a worker after
+        ``_HEALTH_FAILS_TO_KILL`` consecutive failures so the existing
+        crash-respawn path (respawn budget included) replaces it. Kill,
+        not terminate: a wedged process may ignore SIGTERM."""
+        for i, p in enumerate(self._procs):
+            if not p.is_alive():
+                self._health_gauge.set(-1, worker=str(i))
+                continue
+            res = self._poll_worker_health(i)
+            if res is None:
+                continue
+            if res:
+                self._health_fails[i] = 0
+                self._health_gauge.set(1, worker=str(i))
+                continue
+            self._health_fails[i] += 1
+            self._health_gauge.set(0, worker=str(i))
+            log.warning(
+                "worker %d failed /healthz (%d/%d consecutive)",
+                i, self._health_fails[i], _HEALTH_FAILS_TO_KILL,
+            )
+            if self._health_fails[i] >= _HEALTH_FAILS_TO_KILL:
+                log.error(
+                    "worker %d unhealthy %d polls in a row; killing for "
+                    "respawn", i, self._health_fails[i],
+                )
+                p.kill()
+                p.join(timeout=2.0)
+
+    def wait(self, poll_s: float = 0.5,
+             health_poll_s: float = 2.0) -> None:
         """Supervise until /undeploy (or stop()): respawn crashed workers
-        within budget, then reap everything once the event fires."""
+        within budget, kill-and-respawn workers that fail /healthz
+        ``_HEALTH_FAILS_TO_KILL`` polls in a row, then reap everything
+        once the event fires."""
+        next_health = time.monotonic() + health_poll_s
         while not self._shutdown.is_set():
+            if time.monotonic() >= next_health:
+                next_health = time.monotonic() + health_poll_s
+                self._health_sweep()
             for i, p in enumerate(self._procs):
                 if p.is_alive() or self._shutdown.is_set():
                     continue

@@ -162,3 +162,20 @@ class GroupCommitter:
         if item.exc is not None:
             raise item.exc
         return item.result
+
+    def probe(self, timeout: float = 0.5):
+        """Liveness check: try to take the commit lock within ``timeout``.
+
+        Group commit is leader/follower — there is no dedicated thread
+        whose aliveness a probe could check. What CAN wedge is the
+        commit lock itself (a leader stuck inside a hung backend flush
+        holds it forever, and every subsequent submit spins behind it),
+        so the health probe measures exactly that: lock acquirable →
+        healthy; ``timeout`` elapsed → a flush has been in-flight at
+        least that long."""
+        if self._commit_lock.acquire(timeout=timeout):
+            self._commit_lock.release()
+            return True, "commit lock acquirable"
+        return False, (
+            f"commit lock held > {timeout}s (flush in flight or wedged)"
+        )

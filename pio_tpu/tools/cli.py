@@ -340,6 +340,15 @@ def cmd_deploy(args) -> int:
     feedback_app_id = None
     if args.feedback_app:
         feedback_app_id = _resolve_app(args.feedback_app).id
+    slos = list(getattr(args, "slo", None) or []) or None
+    if slos:
+        # fail fast on a typo'd spec, and export so pool worker
+        # processes (spawn context) configure the same objectives
+        from pio_tpu.obs.slo import parse_slo
+
+        for spec in slos:
+            parse_slo(spec)
+        os.environ["PIO_TPU_SLO"] = ",".join(slos)
     if getattr(args, "workers", 1) > 1:
         from pio_tpu.server.worker_pool import ServingPool
 
@@ -353,8 +362,11 @@ def cmd_deploy(args) -> int:
             feedback_app_id=feedback_app_id,
             admin_key=args.admin_key,
             device_worker=args.device_worker,
+            slos=slos,
         )
         pool.start()
+        # readiness-gated: wait_ready polls /readyz, so "listening" below
+        # is only printed once a worker passes every readiness check
         pool.wait_ready()
         _out(
             f"Query Server pool ({args.workers} workers) listening on "
@@ -374,9 +386,16 @@ def cmd_deploy(args) -> int:
         feedback=bool(args.feedback_app),
         feedback_app_id=feedback_app_id,
         admin_key=args.admin_key,
+        slos=slos,
     )
     # reference parity: `pio undeploy` terminates the serving process
     service.attach_server(server)
+    # readiness gate: the engine/models loaded in the constructor, but
+    # only announce once every probe agrees (storage round trip included)
+    ready, report = service.health.readiness()
+    if not ready:
+        _err(f"query server failed readiness: {report}")
+        return 1
     _out(
         f"Query Server for instance {service.instance_id} "
         f"listening on {args.ip}:{server.port}"
@@ -730,6 +749,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="capture a jax.profiler trace of the first N device "
              "executions into this dir (sets PIO_TPU_PROFILE; N from "
              "PIO_TPU_PROFILE_EXECUTIONS, default 8)",
+    )
+    a.add_argument(
+        "--slo", action="append", default=[], metavar="SPEC",
+        help="declare a serving SLO, repeatable: p99=50ms:99.9 (99.9%% "
+             "of requests within 50 ms) or availability=99.9, optional "
+             "/WINDOW suffix (e.g. /6h); evaluated live on /slo.json "
+             "and exported as pio_tpu_slo_* gauges",
     )
     a.set_defaults(fn=cmd_deploy)
 

@@ -42,6 +42,7 @@ from pio_tpu.storage import (
     RunStatus,
     Storage,
 )
+from pio_tpu.obs import slog
 from pio_tpu.workflow.engine_json import EngineVariant
 from pio_tpu.workflow.params import WorkflowParams
 
@@ -125,6 +126,10 @@ def run_train(
     )
     instance_id = instances.insert(instance)
     instance = instances.get(instance_id)
+    # JSON log ring + volume counter for the train path too — `pio
+    # train` is a daemonless run, so the ring is its only /logs.json
+    # analog (dumped on failure, queryable in-process by tests)
+    slog.install()
     log.info("training started: instance %s", instance_id)
 
     if workflow_params.checkpoint_every > 0:
@@ -179,9 +184,15 @@ def run_train(
             train_s = monotonic_s() - t0
             # engine.train measured the phases; turn them into spans so
             # the run shows up in the trace ring AND the per-stage
-            # training histograms (pio_train_stage_seconds)
+            # training histograms (pio_train_stage_seconds). The log
+            # lines ride inside the trace, so each carries its trace id —
+            # /logs.json?trace_id= reassembles one run's full story.
             for phase, dur in timings.items():
                 tr.add_span(phase, float(dur))
+                log.info(
+                    "train phase %s done in %.3fs (instance %s)",
+                    phase, float(dur), instance_id,
+                )
             if (workflow_params.stop_after_read
                     or workflow_params.stop_after_prepare):
                 instances.update(instance.with_status(RunStatus.ABORTED))

@@ -1,0 +1,149 @@
+#!/usr/bin/env bash
+# Smoke test of the serving ops plane: boot a query server against a tiny
+# trained engine, then hit every operational endpoint from the OUTSIDE
+# (curl over real HTTP, the way a probe/load balancer/scrape job would)
+# and assert 200 + well-formed JSON / Prometheus text.
+#
+# Endpoints covered: /healthz /readyz /metrics /logs.json /slo.json
+# (plus one real /queries.json POST so logs, histograms and the SLO
+# engine have live data to report).
+#
+# Runs hermetically: memory storage, ephemeral port, CPU-pinned JAX.
+# Exit 0 = all checks passed. Wired into tier-1 via
+# tests/test_smoke_endpoints.py.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+WORKDIR="$(mktemp -d -t pio-tpu-smoke-XXXXXX)"
+SERVER_PID=""
+cleanup() {
+    [ -n "$SERVER_PID" ] && kill "$SERVER_PID" 2>/dev/null || true
+    [ -n "$SERVER_PID" ] && wait "$SERVER_PID" 2>/dev/null || true
+    rm -rf "$WORKDIR"
+}
+trap cleanup EXIT
+
+export JAX_PLATFORMS=cpu
+export PIO_TPU_HOME="$WORKDIR/home"
+mkdir -p "$PIO_TPU_HOME"
+PORT_FILE="$WORKDIR/port"
+
+# Boot: train the recommendation template on a tiny in-memory corpus,
+# serve it with a declared SLO, publish the ephemeral port, then park.
+python - "$PORT_FILE" <<'PY' &
+import datetime as dt
+import os
+import signal
+import sys
+
+os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "MEM"
+os.environ["PIO_STORAGE_SOURCES_MEM_TYPE"] = "memory"
+os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "MEM"
+os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "MEM"
+
+import pio_tpu.templates  # noqa: F401  (registers the factory)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.server import create_query_server
+from pio_tpu.storage import App, Storage
+from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+app_id = Storage.get_meta_data_apps().insert(App(0, "smoke"))
+le = Storage.get_levents()
+t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+for u in range(8):
+    for i in range(6):
+        in_block = (u < 4) == (i < 3)
+        le.insert(
+            Event("rate", "user", f"u{u}", "item", f"i{i}",
+                  properties={"rating": 5.0 if in_block else 1.0},
+                  event_time=t0),
+            app_id,
+        )
+variant = variant_from_dict({
+    "id": "smoke-rec",
+    "engineFactory": "templates.recommendation",
+    "datasource": {"params": {"app_name": "smoke"}},
+    "algorithms": [{"name": "als", "params": {
+        "rank": 4, "num_iterations": 4, "lambda_": 0.1}}],
+})
+engine, ep = build_engine(variant)
+run_train(engine, ep, variant, ctx=ComputeContext.local())
+server, service = create_query_server(
+    variant, host="127.0.0.1", port=0, ctx=ComputeContext.local(),
+    slos=["p99=50ms:99.9", "availability=99.9"],
+)
+server.start()
+with open(sys.argv[1] + ".tmp", "w") as f:
+    f.write(str(server.port))
+os.rename(sys.argv[1] + ".tmp", sys.argv[1])  # atomic publish
+signal.sigwait({signal.SIGTERM, signal.SIGINT})
+server.stop()
+PY
+SERVER_PID=$!
+
+echo "waiting for server to boot (train + deploy)..."
+for _ in $(seq 1 240); do
+    [ -s "$PORT_FILE" ] && break
+    kill -0 "$SERVER_PID" 2>/dev/null || {
+        echo "FAIL: server process died during boot" >&2; exit 1; }
+    sleep 0.5
+done
+[ -s "$PORT_FILE" ] || { echo "FAIL: server never published its port" >&2; exit 1; }
+PORT="$(cat "$PORT_FILE")"
+BASE="http://127.0.0.1:$PORT"
+echo "server up on :$PORT"
+
+fail() { echo "FAIL: $*" >&2; exit 1; }
+
+check_json() {  # 200 + parseable JSON
+    local path="$1"
+    curl -fsS --max-time 10 "$BASE$path" | python -m json.tool >/dev/null \
+        || fail "$path did not return 200 + valid JSON"
+    echo "ok   $path"
+}
+
+# live traffic first, so /logs.json, /metrics and /slo.json report a
+# real request (not just empty rings)
+curl -fsS --max-time 30 -X POST -H 'Content-Type: application/json' \
+    -d '{"user": "u1", "num": 3}' "$BASE/queries.json" \
+    | python -m json.tool >/dev/null || fail "/queries.json round trip"
+echo "ok   /queries.json"
+
+check_json /healthz
+check_json /readyz
+check_json /logs.json
+check_json "/logs.json?level=info&n=50"
+check_json /slo.json
+check_json /traces.json
+check_json /stats.json
+
+# /slo.json must carry both declared objectives with burn-rate fields
+curl -fsS --max-time 10 "$BASE/slo.json" | python -c '
+import json, sys
+body = json.load(sys.stdin)
+names = {s["name"] for s in body["slos"]}
+assert {"latency_p99", "availability"} <= names, names
+for s in body["slos"]:
+    assert "burnRates" in s and "errorBudgetRemaining" in s, s
+' || fail "/slo.json missing declared objectives"
+echo "ok   /slo.json objectives"
+
+# /metrics must be Prometheus text with the core families present
+METRICS="$(curl -fsS --max-time 10 "$BASE/metrics")"
+for family in \
+    '# TYPE pio_queries_total counter' \
+    '# TYPE pio_request_seconds histogram' \
+    '# TYPE pio_tpu_slo_error_budget_remaining gauge' \
+    '# TYPE pio_tpu_log_messages_total counter'; do
+    grep -qF "$family" <<<"$METRICS" || fail "/metrics missing '$family'"
+done
+echo "ok   /metrics exposition"
+
+# parameter validation: negative n must be a 400, not a silent default
+STATUS="$(curl -s -o /dev/null -w '%{http_code}' --max-time 10 "$BASE/logs.json?n=-5")"
+[ "$STATUS" = 400 ] || fail "/logs.json?n=-5 returned $STATUS, want 400"
+echo "ok   /logs.json?n=-5 -> 400"
+
+echo "smoke OK"

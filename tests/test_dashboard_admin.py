@@ -119,6 +119,47 @@ class TestDashboard:
         assert "text/html" in headers["Content-Type"]
         assert "Serving" in page
 
+    def test_probes_and_logs(self, dashboard):
+        status, report, _ = http("GET", dashboard + "/healthz")
+        assert status == 200 and report["status"] == "ok"
+        status, report, _ = http("GET", dashboard + "/readyz")
+        assert status == 200 and report["status"] == "ready"
+        assert report["checks"]["storage"]["ok"]
+        status, body, _ = http("GET", dashboard + "/logs.json")
+        assert status == 200 and "logs" in body and "ringCapacity" in body
+        assert http("GET", dashboard + "/logs.json?n=-1")[0] == 400
+        assert http("GET", dashboard + "/logs.json?level=loud")[0] == 400
+
+    def test_serving_view_slo_panel_and_log_tail(self, dashboard, tmp_home):
+        """With SLOs declared on the query server, /serving.html renders
+        the error-budget table and a structured-log tail."""
+        import pio_tpu.templates  # noqa: F401
+        from tests.test_servers import _train
+        from pio_tpu.server import create_query_server
+
+        app_id = Storage.get_meta_data_apps().insert(App(0, "srv-test"))
+        variant, ctx, _ = _train(app_id)
+        server, _ = create_query_server(
+            variant, host="127.0.0.1", port=0, ctx=ctx,
+            slos=["p99=50ms:99.9"],
+        )
+        server.start()
+        try:
+            qurl = f"http://127.0.0.1:{server.port}"
+            assert http(
+                "POST", qurl + "/queries.json", {"user": "u1", "num": 2}
+            )[0] == 200
+            status, page, _ = http(
+                "GET", dashboard + f"/serving.html?url={qurl}"
+            )
+            assert status == 200
+            assert "latency_p99" in page        # SLO table row
+            assert "budget left" in page        # budget column header
+            assert "Recent logs" in page
+            assert "served query" in page       # the request's log line
+        finally:
+            server.stop()
+
     def test_serving_view_renders_stage_table(self, dashboard, tmp_home):
         """Point the dashboard at a live query server and check the
         pool-wide totals + per-stage latency table are rendered."""

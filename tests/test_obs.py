@@ -361,6 +361,43 @@ class TestPromParse:
         assert pm.value("x") == float("inf")
         assert pm.value("y") == float("-inf")
 
+    @pytest.mark.parametrize("raw", [
+        'back\\slash', 'dou"ble', 'new\nline', '\\', '"', '\n',
+        'all\\three"at\nonce', 'trailing\\',
+    ])
+    def test_escaped_label_values_round_trip(self, raw):
+        # what the registry renders, the parser must read back verbatim
+        reg = MetricsRegistry()
+        reg.counter("esc_total", "", ("val",)).inc(3, val=raw)
+        pm = render_parse(reg)
+        assert pm.value("esc_total", val=raw) == 3
+
+    def test_escaped_label_value_literal_line(self):
+        # against a hand-written line too, not just our own renderer
+        pm = parse_prometheus_text(
+            'e_total{a="x\\\\y",b="q\\"r",c="s\\nt"} 7\n'
+        )
+        assert pm.value("e_total", a="x\\y", b='q"r', c="s\nt") == 7
+
+    def test_help_text_unescapes(self):
+        pm = parse_prometheus_text(
+            "# HELP weird line one\\nline two with \\\\ slash\n"
+        )
+        assert pm.helps["weird"] == "line one\nline two with \\ slash"
+
+    def test_inf_only_bucket_histogram(self):
+        # a scraped histogram may carry ONLY the mandatory +Inf bucket;
+        # the quantile estimate must clamp, not divide by a missing edge
+        pm = parse_prometheus_text(
+            'h1_bucket{le="+Inf"} 5\nh1_sum 10\nh1_count 5\n'
+        )
+        assert pm.histogram_buckets("h1") == [(float("inf"), 5.0)]
+        assert pm.histogram_quantile("h1", 0.99) == 0.0
+
+    def test_empty_histogram_has_no_quantile(self):
+        pm = parse_prometheus_text('h2_bucket{le="+Inf"} 0\nh2_count 0\n')
+        assert pm.histogram_quantile("h2", 0.5) is None
+
 
 class TestMonotonicClock:
     def test_is_monotonic_and_subsecond(self):

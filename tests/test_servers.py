@@ -745,6 +745,164 @@ class TestQueryServer:
             create_query_server(variant, host="127.0.0.1", port=0)
 
 
+class TestOpsEndpoints:
+    """The serving ops plane end-to-end (ISSUE 2 acceptance): deep
+    probes, log/trace correlation over real HTTP, live SLO evaluation,
+    and strict query-param validation."""
+
+    def test_healthz_and_readyz_report_checks(self, queryserver):
+        url, _, _ = queryserver
+        status, report = http("GET", f"{url}/healthz")
+        assert status == 200 and report["status"] == "ok"
+        assert set(report["checks"]) >= {"http_loop", "microbatch_worker"}
+        assert all(c["ok"] for c in report["checks"].values())
+        status, report = http("GET", f"{url}/readyz")
+        assert status == 200 and report["status"] == "ready"
+        assert set(report["checks"]) >= {"engine", "storage"}
+        assert "instance" in report["checks"]["engine"]["detail"]
+
+    def test_undeploy_flips_readyz_not_healthz(self, queryserver):
+        url, _, _ = queryserver
+        http("POST", f"{url}/undeploy", {})
+        status, report = http("GET", f"{url}/readyz")
+        assert status == 503
+        assert report["checks"]["engine"]["detail"] == "undeployed"
+        # the process is still healthy — a restart would fix nothing
+        assert http("GET", f"{url}/healthz")[0] == 200
+
+    def test_dead_microbatch_thread_flips_healthz(self, app_and_key,
+                                                  monkeypatch):
+        """Acceptance: killing the micro-batch worker thread turns
+        /healthz into a 503 naming the dead thread (the condition the
+        pool supervisor kills-and-respawns on)."""
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_US", "500")
+        app_id, _ = app_and_key
+        variant, ctx, _ = _train(app_id)
+        server, service = create_query_server(
+            variant, host="127.0.0.1", port=0, ctx=ctx
+        )
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            assert http("GET", f"{url}/healthz")[0] == 200
+            service._batcher.stop()
+            service._batcher._thread.join(timeout=5)
+            assert not service._batcher._thread.is_alive()
+            status, report = http("GET", f"{url}/healthz")
+            assert status == 503 and report["status"] == "unhealthy"
+            assert not report["checks"]["microbatch_worker"]["ok"]
+            assert "dead" in report["checks"]["microbatch_worker"]["detail"]
+        finally:
+            server.stop()
+
+    def test_logs_join_traces_by_trace_id(self, queryserver):
+        """Acceptance: a served query emits a JSON log record whose
+        trace_id matches the id /traces.json reports, and /logs.json can
+        filter down to exactly that request's lines."""
+        url, _, _ = queryserver
+        assert http(
+            "POST", f"{url}/queries.json", {"user": "u1", "num": 2}
+        )[0] == 200
+        _, body = http("GET", f"{url}/traces.json")
+        (trace,) = body["traces"]
+        tid = trace["id"]
+        status, logs = http("GET", f"{url}/logs.json?trace_id={tid}")
+        assert status == 200
+        assert logs["logs"], f"no log lines for trace {tid}"
+        assert all(e["trace_id"] == tid for e in logs["logs"])
+        assert any("served query" in e["msg"] for e in logs["logs"])
+        # every record is the full structured shape
+        e = logs["logs"][-1]
+        assert {"ts", "level", "logger", "msg", "trace_id", "span"} <= set(e)
+
+    def test_slo_json_from_live_histograms(self, app_and_key):
+        """Acceptance: with --slo p99=50ms:99.9 declared, /slo.json
+        reports burn rate and remaining error budget computed from the
+        live pio_request_seconds histogram, and the same numbers export
+        as gauges on /metrics."""
+        app_id, _ = app_and_key
+        variant, ctx, _ = _train(app_id)
+        server, _ = create_query_server(
+            variant, host="127.0.0.1", port=0, ctx=ctx,
+            slos=["p99=50ms:99.9", "availability=99.9"],
+        )
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            for _ in range(3):
+                assert http(
+                    "POST", f"{url}/queries.json", {"user": "u1"}
+                )[0] == 200
+            status, body = http("GET", f"{url}/slo.json")
+            assert status == 200 and body["configured"] is True
+            by_name = {s["name"]: s for s in body["slos"]}
+            lat = by_name["latency_p99"]
+            assert lat["kind"] == "latency" and lat["thresholdMs"] == 50.0
+            assert lat["total"] >= 3
+            assert "300s" in lat["burnRates"] and "3600s" in lat["burnRates"]
+            assert -1000.0 <= lat["errorBudgetRemaining"] <= 1.0
+            avail = by_name["availability"]
+            assert avail["errors"] == 0 and avail["errorBudgetRemaining"] == 1.0
+            assert all(not a["firing"] for a in avail["alerts"])
+            with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+                text = r.read().decode()
+            assert 'pio_tpu_slo_error_budget_remaining{slo="latency_p99"}' \
+                in text
+            assert 'pio_tpu_slo_burn_rate{slo="availability",window="300s"}' \
+                in text
+            assert "# TYPE pio_tpu_log_messages_total counter" in text
+        finally:
+            server.stop()
+
+    def test_unconfigured_slo_endpoint(self, queryserver):
+        url, _, _ = queryserver
+        status, body = http("GET", f"{url}/slo.json")
+        assert status == 200
+        assert body == {"slos": [], "configured": False}
+
+    def test_query_param_validation(self, queryserver):
+        """Satellite: ?n= and ?window= are validated — negatives and
+        non-numerics are a 400, oversized n clamps to the ring size."""
+        url, service, _ = queryserver
+        http("POST", f"{url}/queries.json", {"user": "u1"})
+        for bad in ("/traces.json?n=-1", "/traces.json?n=abc",
+                    "/stats.json?window=abc", "/stats.json?window=-3",
+                    "/stats.json?window=nan",
+                    "/logs.json?n=-5", "/logs.json?n=1.5",
+                    "/logs.json?level=loud"):
+            status, body = http("GET", url + bad)
+            assert status == 400, f"{bad} -> {status} {body}"
+            assert "message" in body
+        # above the ring capacity: clamp, not error
+        status, body = http(
+            "GET", f"{url}/traces.json?n={service.tracer._ring_cap + 999}"
+        )
+        assert status == 200 and len(body["traces"]) >= 1
+        status, body = http("GET", f"{url}/logs.json?n=999999")
+        assert status == 200 and len(body["logs"]) <= body["ringCapacity"]
+
+    def test_eventserver_probes_logs_and_validation(self, eventserver,
+                                                    app_and_key):
+        _, key = app_and_key
+        status, report = http("GET", f"{eventserver}/healthz")
+        assert status == 200 and report["status"] == "ok"
+        assert "group_commit" in report["checks"]
+        status, report = http("GET", f"{eventserver}/readyz")
+        assert status == 200 and report["status"] == "ready"
+        assert report["checks"]["storage"]["ok"]
+        # ingest one event, then the ops surface
+        assert http(
+            "POST", f"{eventserver}/events.json?accessKey={key}", EV
+        )[0] == 201
+        status, body = http("GET", f"{eventserver}/logs.json")
+        assert status == 200 and body["ringCapacity"] >= 1
+        status, body = http("GET", f"{eventserver}/slo.json")
+        assert status == 200 and body["configured"] is False
+        assert http("GET", f"{eventserver}/stats.json?window=abc")[0] == 400
+        assert http("GET", f"{eventserver}/logs.json?n=-2")[0] == 400
+        assert http("GET", f"{eventserver}/traces.json?n=-2")[0] == 400
+
+
 class TestHTTPHardening:
     """Hand-rolled HTTP/1.1 parser edge cases (pio_tpu/server/http.py):
     framing attacks and resource-exhaustion vectors must be rejected

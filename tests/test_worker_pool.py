@@ -216,6 +216,50 @@ class TestServingPool:
         assert not sup.is_alive()
         assert all(not p.is_alive() for p in pool._procs)
 
+    def test_supervisor_kills_wedged_worker_via_health_probe(self, pool):
+        """ISSUE 2 acceptance: a worker that is alive-but-wedged (frozen
+        with SIGSTOP — its process exists, its /healthz never answers)
+        is killed after the consecutive-failure threshold and respawned
+        by the ordinary crash path."""
+        import os
+        import signal
+        import threading
+
+        # every worker publishes its loopback health sidecar port
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(p > 0 for p in pool._health_ports):
+                break
+            time.sleep(0.2)
+        ports = list(pool._health_ports)
+        assert all(p > 0 for p in ports), ports
+        for p in ports:
+            status, report = _get(p, "/healthz")
+            assert status == 200 and report["status"] == "ok"
+
+        sup = threading.Thread(
+            target=pool.wait,
+            kwargs={"poll_s": 0.2, "health_poll_s": 0.5},
+            daemon=True,
+        )
+        sup.start()
+        victim = pool._procs[1]
+        os.kill(victim.pid, signal.SIGSTOP)  # wedged, not dead
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if pool._procs[1] is not victim and pool._procs[1].is_alive():
+                break
+            time.sleep(0.2)
+        assert pool._procs[1] is not victim, "wedged worker never replaced"
+        assert pool._respawns[1] == 1
+        # the replacement serves (either worker may take the connection)
+        status, got = _post(pool.port, "/queries.json",
+                            {"user": "u1", "num": 2})
+        assert status == 200 and len(got["itemScores"]) == 2
+        _post(pool.port, "/undeploy", {})
+        sup.join(30)
+        assert not sup.is_alive()
+
     def test_undeploy_stops_whole_pool(self, pool):
         status, out = _post(pool.port, "/undeploy", {})
         assert status == 200
