@@ -55,7 +55,7 @@ from pio_tpu.obs.metrics import (
     monotonic_s,
 )
 from pio_tpu.obs.health import Heartbeat, HealthMonitor
-from pio_tpu.obs.slo import SLOEngine, SLObjective, parse_slo
+from pio_tpu.obs.slo import SLOEngine, SLObjective, parse_duration_s, parse_slo
 from pio_tpu.obs.tracing import Trace, Tracer
 
 __all__ = [
@@ -75,5 +75,6 @@ __all__ = [
     "escape_help",
     "escape_label_value",
     "monotonic_s",
+    "parse_duration_s",
     "parse_slo",
 ]

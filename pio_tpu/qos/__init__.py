@@ -1,0 +1,82 @@
+"""Admission control & QoS: pool-wide rate limiting, deadline-aware
+shedding, circuit breaking, and graceful degradation.
+
+PR 2's SLO engine can *observe* an overload; this package *acts* on one.
+The pieces, in request order:
+
+- :mod:`policy` — priority classes + the ``rps=500,queue=64,deadline=100ms``
+  spec grammar (``pio deploy --qos`` / ``PIO_TPU_QOS`` / engine.json
+  ``qos`` block);
+- :mod:`limiter` — token buckets (per engine, per access key; pool-wide
+  via the obs shared-memory segment) and a concurrency limiter with a
+  bounded admission queue;
+- :mod:`deadline` — ``X-Pio-Deadline-Ms`` propagation into the
+  micro-batcher, shedding expired-in-queue work before execution;
+- :mod:`breaker` — closed/open/half-open circuit breakers around storage
+  and scorer calls;
+- :mod:`degrade` — a bounded LRU serving explicitly-marked stale
+  responses (``X-Pio-Degraded: stale-cache``) instead of hard 503s;
+- :mod:`gate` — the per-service composition + metrics
+  (``pio_tpu_qos_shed_total{reason}``, inflight/queue gauges, breaker
+  state) surfaced on ``GET /qos.json``.
+"""
+
+from pio_tpu.qos.breaker import CircuitBreaker
+from pio_tpu.qos.deadline import (
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceeded,
+    parse_deadline_ms,
+)
+from pio_tpu.qos.degrade import (
+    DEGRADED_HEADER,
+    DEGRADED_VALUE,
+    StaleCache,
+    cache_key,
+)
+from pio_tpu.qos.gate import (
+    Admission,
+    QoSGate,
+    SHED_REASONS,
+    retry_after_header,
+)
+from pio_tpu.qos.limiter import ConcurrencyLimiter, KeyedBuckets, TokenBucket
+from pio_tpu.qos.policy import (
+    PRIORITY_CLASSES,
+    PRIORITY_FLOORS,
+    PRIORITY_HEADER,
+    QoSError,
+    QoSPolicy,
+    parse_qos,
+    policy_from_dict,
+    priority_floor,
+    resolve_policy,
+)
+
+__all__ = [
+    "Admission",
+    "CircuitBreaker",
+    "ConcurrencyLimiter",
+    "DEADLINE_HEADER",
+    "DEGRADED_HEADER",
+    "DEGRADED_VALUE",
+    "Deadline",
+    "DeadlineExceeded",
+    "KeyedBuckets",
+    "PRIORITY_CLASSES",
+    "PRIORITY_FLOORS",
+    "PRIORITY_HEADER",
+    "QoSError",
+    "QoSGate",
+    "QoSPolicy",
+    "SHED_REASONS",
+    "StaleCache",
+    "TokenBucket",
+    "cache_key",
+    "parse_deadline_ms",
+    "parse_qos",
+    "policy_from_dict",
+    "priority_floor",
+    "resolve_policy",
+    "retry_after_header",
+]

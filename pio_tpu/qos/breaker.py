@@ -1,0 +1,147 @@
+"""Circuit breaker (closed → open → half-open) with failure-RATE
+tripping over a bounded outcome window.
+
+Guards the two dependencies a serving request leans on — storage reads
+and scorer calls. A dependency that is failing for everyone should fail
+FAST for everyone: tripping converts a pile-up of slow errors into
+immediate sheds (which the degradation layer may turn into stale
+answers), and the half-open probe trickle discovers recovery without a
+thundering herd.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Tuple
+
+from pio_tpu.obs.metrics import monotonic_s
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+#: numeric state for the ``pio_tpu_qos_breaker_state`` gauge
+STATE_CODES = {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}
+
+
+class CircuitBreaker:
+    """``allow()`` before the call, then exactly one of
+    ``record_success()`` / ``record_failure()`` after it.
+
+    - CLOSED: everything passes; the last ``window`` outcomes are kept,
+      and once ≥ ``window`` samples show a failure fraction ≥
+      ``failure_rate`` the breaker opens.
+    - OPEN: every call is refused (with the cooldown remaining as a
+      Retry-After hint) until ``cooldown_s`` elapses, then HALF_OPEN.
+    - HALF_OPEN: up to ``probes`` calls pass; any failure reopens,
+      ``probes`` successes close and clear the window.
+    """
+
+    def __init__(self, failure_rate: float = 0.5, window: int = 20,
+                 cooldown_s: float = 5.0, probes: int = 3,
+                 clock: Callable[[], float] = monotonic_s,
+                 on_state_change: Optional[Callable[[str], None]] = None):
+        if not 0.0 < failure_rate <= 1.0:
+            raise ValueError("failure_rate must be in (0, 1]")
+        self.failure_rate = failure_rate
+        self.window = max(int(window), 1)
+        self.cooldown_s = cooldown_s
+        self.probes = max(int(probes), 1)
+        self._clock = clock
+        self._on_change = on_state_change
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._outcomes = []  # bounded ring of bools (True = failure)
+        self._opened_at = 0.0
+        self._probe_inflight = 0
+        self._probe_successes = 0
+
+    # -- state -------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def _transition_locked(self, state: str) -> None:
+        if state == self._state:
+            return
+        self._state = state
+        if state == OPEN:
+            self._opened_at = self._clock()
+        if state in (OPEN, HALF_OPEN):
+            self._probe_inflight = 0
+            self._probe_successes = 0
+        if state == CLOSED:
+            self._outcomes.clear()
+        if self._on_change is not None:
+            try:
+                self._on_change(state)
+            except Exception:
+                pass  # a metrics/log hook must never wedge the breaker
+
+    def _maybe_half_open_locked(self) -> None:
+        if self._state == OPEN and \
+                self._clock() - self._opened_at >= self.cooldown_s:
+            self._transition_locked(HALF_OPEN)
+
+    # -- call protocol -----------------------------------------------------
+    def allow(self) -> Tuple[bool, float]:
+        """``(allowed, retry_after_s)`` — retry_after is the cooldown
+        remaining when refused (0 when refused only by probe contention)."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CLOSED:
+                return True, 0.0
+            if self._state == OPEN:
+                return False, max(
+                    self.cooldown_s - (self._clock() - self._opened_at), 0.0
+                )
+            # HALF_OPEN: a bounded probe trickle
+            if self._probe_inflight < self.probes:
+                self._probe_inflight += 1
+                return True, 0.0
+            return False, 0.0
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._probe_inflight = max(self._probe_inflight - 1, 0)
+                self._probe_successes += 1
+                if self._probe_successes >= self.probes:
+                    self._transition_locked(CLOSED)
+                return
+            self._record_outcome_locked(False)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # the dependency is still sick — restart the cooldown
+                self._transition_locked(OPEN)
+                return
+            if self._state == OPEN:
+                return
+            self._record_outcome_locked(True)
+            n = len(self._outcomes)
+            if n >= self.window:
+                fails = sum(1 for f in self._outcomes if f)
+                if fails / n >= self.failure_rate:
+                    self._transition_locked(OPEN)
+
+    def _record_outcome_locked(self, failed: bool) -> None:
+        self._outcomes.append(failed)
+        if len(self._outcomes) > self.window:
+            del self._outcomes[0]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            self._maybe_half_open_locked()
+            n = len(self._outcomes)
+            return {
+                "state": self._state,
+                "windowSamples": n,
+                "windowFailures": sum(1 for f in self._outcomes if f),
+                "cooldownRemainingS": (
+                    max(self.cooldown_s
+                        - (self._clock() - self._opened_at), 0.0)
+                    if self._state == OPEN else 0.0
+                ),
+            }

@@ -232,6 +232,58 @@ class DashboardService:
             + "".join(rows) + "</table>"
         )
 
+    def _qos_panel(self) -> str:
+        """Admission-control panel from the query server's /qos.json
+        (ISSUE 3): shed counts by reason, token-bucket level, queue and
+        inflight occupancy, breaker states."""
+        data = self._fetch_json("/qos.json")
+        if not data or not data.get("enabled"):
+            return (
+                "<h2>QoS</h2><p>admission control off "
+                "(<code>pio deploy --qos 'rps=500,queue=64,"
+                "deadline=100ms'</code>)</p>"
+            )
+        shed = data.get("shed", {})
+        shed_rows = "".join(
+            f"<tr><td>{_html.escape(reason)}</td><td>{int(n)}</td></tr>"
+            for reason, n in sorted(shed.items())
+        )
+        parts = [
+            "<h2>QoS</h2>",
+            f"<p>admitted (pool-wide): {int(data.get('admitted', 0))}"
+            f" &middot; degraded (stale-cache): "
+            f"{int(data.get('degraded', 0))}</p>",
+            "<table><tr><th>shed reason</th><th>count</th></tr>"
+            + (shed_rows or "<tr><td colspan='2'>none</td></tr>")
+            + "</table>",
+        ]
+        bucket = data.get("bucket")
+        if bucket:
+            parts.append(
+                f"<p>engine bucket: {bucket['tokens']:.1f} / "
+                f"{bucket['burst']:.0f} tokens "
+                f"(refill {bucket['rate']:.0f}/s)</p>"
+            )
+        conc = data.get("concurrency")
+        if conc:
+            parts.append(
+                f"<p>concurrency: {conc['inflight']}/{conc['maxInflight']} "
+                f"inflight, {conc['queued']}/{conc['maxQueue']} queued</p>"
+            )
+        breakers = data.get("breakers") or {}
+        if breakers:
+            rows = "".join(
+                f"<tr><td>{_html.escape(dep)}</td>"
+                f"<td>{_html.escape(b['state'])}</td>"
+                f"<td>{b['windowFailures']}/{b['windowSamples']}</td></tr>"
+                for dep, b in sorted(breakers.items())
+            )
+            parts.append(
+                "<table><tr><th>breaker</th><th>state</th>"
+                "<th>failures</th></tr>" + rows + "</table>"
+            )
+        return "".join(parts)
+
     def _log_panel(self, n: int = 25) -> str:
         """Live tail of the query server's structured log ring."""
         data = self._fetch_json(f"/logs.json?n={n}")
@@ -323,7 +375,7 @@ class DashboardService:
         )
         return 200, _html_response(
             head + summary + stage_table + self._slo_panel()
-            + self._log_panel() + "</body></html>"
+            + self._qos_panel() + self._log_panel() + "</body></html>"
         )
 
 

@@ -33,6 +33,9 @@ from pio_tpu.obs import (
 )
 from pio_tpu.obs import slog
 from pio_tpu.obs.slo import engine_for_specs
+from pio_tpu.qos import (
+    PRIORITY_HEADER, QoSGate, resolve_policy, retry_after_header,
+)
 from pio_tpu.server.http import (
     HTTPError, JsonHTTPServer, Request, Router, float_param, int_param,
     metrics_response,
@@ -131,7 +134,8 @@ class EventServerService:
     #: so a fresh key works immediately).
     AUTH_CACHE_TTL_S = 2.0
 
-    def __init__(self, slos: Optional[List[str]] = None):
+    def __init__(self, slos: Optional[List[str]] = None,
+                 qos: Optional[Any] = None):
         #: per-instance registry — see query_server (test servers must
         #: not cross-pollinate scrapes through a process global)
         self.obs = MetricsRegistry()
@@ -168,6 +172,18 @@ class EventServerService:
                 availability_source=self._availability_good_total,
                 latency_cell_getter=lambda: self._request_cell,
             )
+        # -- QoS (ISSUE 3): engine-wide + per-access-key token buckets on
+        # the write paths, breaker around storage inserts. The event
+        # server never runs in SO_REUSEPORT pool mode, so its buckets
+        # are process-local by construction.
+        policy = resolve_policy(qos)
+        self.qos = (
+            QoSGate(policy, self.obs, scope="eventserver")
+            if policy is not None else None
+        )
+        self._storage_breaker = (
+            self.qos.breaker("storage") if self.qos is not None else None
+        )
         self._auth_cache: dict = {}
         self._auth_gen = 0  # bumped by invalidation; fences re-caching
         self._auth_cache_lock = threading.Lock()
@@ -188,6 +204,7 @@ class EventServerService:
         r.add("GET", "/traces\\.json", self.get_traces)
         r.add("GET", "/logs\\.json", self.get_logs)
         r.add("GET", "/slo\\.json", self.get_slo)
+        r.add("GET", "/qos\\.json", self.get_qos)
         r.add("GET", "/healthz", self.healthz)
         r.add("GET", "/readyz", self.readyz)
         r.add("POST", "/webhooks/([^/]+)\\.json", self.webhook_json)
@@ -303,6 +320,56 @@ class EventServerService:
         out["configured"] = True
         return 200, out
 
+    def get_qos(self, req: Request):
+        """Admission-control state (see the query server's twin)."""
+        if self.qos is None:
+            return 200, {"enabled": False}
+        return 200, self.qos.snapshot()
+
+    def _qos_admit(self, req: Request):
+        """Admission for the write paths: engine bucket, THEN the
+        caller's per-access-key bucket — one chatty key exhausts its own
+        budget before it can dent everyone else's. Sheds raise 429/503
+        with ``Retry-After`` (ingest has no stale-cache rescue: replaying
+        an old write would be a lie, not a degradation)."""
+        if self.qos is None:
+            return None
+        adm = self.qos.admit(
+            priority=req.header(PRIORITY_HEADER), key=req.bearer_key()
+        )
+        if not adm.ok:
+            self.qos.count_shed(adm.reason)
+            status = (
+                429 if adm.reason in ("rate_limit", "key_rate_limit")
+                else 503
+            )
+            raise HTTPError(
+                status, f"overloaded: {adm.reason}",
+                headers=retry_after_header(adm.retry_after_s),
+            )
+        return adm
+
+    def _guarded_insert(self, fn):
+        """Run a storage write through the circuit breaker: an open
+        breaker fails fast with 503 + Retry-After instead of queueing
+        more work onto a dependency that is already drowning."""
+        if self._storage_breaker is None:
+            return fn()
+        allowed, retry = self._storage_breaker.allow()
+        if not allowed:
+            self.qos.count_shed("breaker")
+            raise HTTPError(
+                503, "overloaded: storage circuit breaker open",
+                headers=retry_after_header(retry),
+            )
+        try:
+            out = fn()
+        except Exception:
+            self._storage_breaker.record_failure()
+            raise
+        self._storage_breaker.record_success()
+        return out
+
     def _validate_one(self, d: Any, app_id: int, channel_id, whitelist,
                       tr=None):
         """JSON → validated Event (whitelist + input blockers applied)."""
@@ -334,7 +401,11 @@ class EventServerService:
         event = self._validate_one(d, app_id, channel_id, whitelist, tr)
         sp = tr.span if tr is not None else (lambda stage: nullcontext())
         with sp("store"):
-            event_id = Storage.get_levents().insert(event, app_id, channel_id)
+            event_id = self._guarded_insert(
+                lambda: Storage.get_levents().insert(
+                    event, app_id, channel_id
+                )
+            )
         self._post_ingest(d, event, app_id, channel_id)
         return event_id
 
@@ -342,7 +413,9 @@ class EventServerService:
         app_id, channel_id, whitelist = self._auth(req)
         t0 = monotonic_s()
         error = True
+        adm = None
         try:
+            adm = self._qos_admit(req)
             with self.tracer.trace("event") as tr:
                 try:
                     event_id = self._ingest_one(
@@ -355,6 +428,8 @@ class EventServerService:
                 error = False
                 return 201, {"eventId": event_id}
         finally:
+            if adm is not None:
+                adm.release()
             dur_s = monotonic_s() - t0
             self.req_window.record(dur_s * 1e3, error)
             self._request_cell.observe(dur_s)
@@ -369,7 +444,9 @@ class EventServerService:
             }
         t0 = monotonic_s()
         error = True
+        adm = None
         try:
+            adm = self._qos_admit(req)
             with self.tracer.trace("batch", batchSize=len(req.body)) as tr:
                 out = self._batch_events(
                     req, app_id, channel_id, whitelist, tr
@@ -377,6 +454,8 @@ class EventServerService:
                 error = False
                 return out
         finally:
+            if adm is not None:
+                adm.release()
             dur_s = monotonic_s() - t0
             self.req_window.record(dur_s * 1e3, error)
             self._request_cell.observe(dur_s)
@@ -399,8 +478,10 @@ class EventServerService:
                     results[k] = {"status": status, "message": str(e)}
         if valid:
             with tr.span("store"):
-                ids = Storage.get_levents().insert_batch(
-                    [e for _, _, e in valid], app_id, channel_id
+                ids = self._guarded_insert(
+                    lambda: Storage.get_levents().insert_batch(
+                        [e for _, _, e in valid], app_id, channel_id
+                    )
                 )
             if len(ids) != len(valid):  # a broken backend override must
                 # surface as per-item errors, not nulls in the response
@@ -545,7 +626,9 @@ class EventServerService:
             return 400, {"message": "webhook payload must be a JSON object"}
         t0 = monotonic_s()
         error = True
+        adm = None
         try:
+            adm = self._qos_admit(req)
             with self.tracer.trace("webhook") as tr:
                 try:
                     d = connector.to_event_dict(req.body or {})
@@ -558,6 +641,8 @@ class EventServerService:
                 error = False
                 return 201, {"eventId": event_id}
         finally:
+            if adm is not None:
+                adm.release()
             dur_s = monotonic_s() - t0
             self.req_window.record(dur_s * 1e3, error)
             self._request_cell.observe(dur_s)
@@ -574,7 +659,9 @@ class EventServerService:
         )
         t0 = monotonic_s()
         error = True
+        adm = None
         try:
+            adm = self._qos_admit(req)
             with self.tracer.trace("webhook") as tr:
                 try:
                     d = connector.to_event_dict(form)
@@ -587,6 +674,8 @@ class EventServerService:
                 error = False
                 return 201, {"eventId": event_id}
         finally:
+            if adm is not None:
+                adm.release()
             dur_s = monotonic_s() - t0
             self.req_window.record(dur_s * 1e3, error)
             self._request_cell.observe(dur_s)
@@ -595,12 +684,13 @@ class EventServerService:
 def create_event_server(
     host: str = "0.0.0.0", port: int = 7070,
     slos: Optional[List[str]] = None,
+    qos: Optional[Any] = None,
 ) -> JsonHTTPServer:
     """Build (unstarted) server — reference ``EventServer.createEventServer``."""
     from pio_tpu.server.plugins import load_plugins_from_env
 
     load_plugins_from_env()
-    service = EventServerService(slos=slos)
+    service = EventServerService(slos=slos, qos=qos)
     server = JsonHTTPServer(
         service.router, host, port, name="pio-tpu-eventserver"
     )

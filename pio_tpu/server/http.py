@@ -26,10 +26,42 @@ from urllib.parse import parse_qs
 
 log = logging.getLogger("pio_tpu.server")
 
+
+def _env_float(name: str, default: float) -> float:
+    """Float from the environment, falling back (with a warning) on a
+    malformed value — a typo'd limit must degrade to the default, not
+    kill every server at import time."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        v = float(raw)
+    except (TypeError, ValueError):
+        import warnings
+
+        warnings.warn(
+            f"{name}={raw!r} is not a number; using default {default:g}",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return default
+    if v != v or v <= 0:  # NaN / non-positive caps would reject everything
+        import warnings
+
+        warnings.warn(
+            f"{name}={raw!r} must be a positive number; "
+            f"using default {default:g}",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return default
+    return v
+
+
 #: Reject request bodies above this many MiB with 413 (configurable —
 #: model artifacts PUT to the blob daemon can be large, but an unbounded
 #: body is a trivial memory/disk DoS on any network-facing server).
-MAX_BODY_MB = float(os.environ.get("PIO_TPU_MAX_BODY_MB", "4096"))
+MAX_BODY_MB = _env_float("PIO_TPU_MAX_BODY_MB", 4096.0)
 
 #: Octet-stream bodies above this spill from memory to a temp file while
 #: being read off the socket (the blob daemon's PUT path — a multi-GB
@@ -40,7 +72,7 @@ _SPOOL_BYTES = 8 << 20
 #: tighter cap than raw octet-stream uploads — without it, a request with
 #: a non-binary Content-Type and a huge Content-Length would be buffered
 #: whole in RAM before any handler (or auth) ran.
-MAX_JSON_BODY_MB = float(os.environ.get("PIO_TPU_MAX_JSON_BODY_MB", "64"))
+MAX_JSON_BODY_MB = _env_float("PIO_TPU_MAX_JSON_BODY_MB", 64.0)
 
 
 def keys_equal(provided: str, expected: str) -> bool:
@@ -106,10 +138,25 @@ Handler = Callable[[Request], Tuple[int, Any]]
 
 
 class HTTPError(Exception):
-    def __init__(self, status: int, message: str):
+    """Handler-raised error. ``headers`` (optional) are emitted on the
+    response — the QoS layer needs ``Retry-After`` on its 429/503s."""
+
+    def __init__(self, status: int, message: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = dict(headers) if headers else {}
+
+
+def json_response(body: Any, headers: Dict[str, str]) -> RawResponse:
+    """A JSON body that must carry extra headers (the plain dict path
+    through ``_respond`` can't — e.g. ``X-Pio-Degraded`` stale serves)."""
+    return RawResponse(
+        json.dumps(body),
+        content_type="application/json; charset=UTF-8",
+        headers=headers,
+    )
 
 
 #: Prometheus scrape content type (text format 0.0.4).
@@ -188,7 +235,8 @@ _REASONS = {
     302: "Found", 304: "Not Modified", 400: "Bad Request",
     401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
     405: "Method Not Allowed", 411: "Length Required",
-    413: "Content Too Large", 431: "Request Header Fields Too Large",
+    413: "Content Too Large", 429: "Too Many Requests",
+    431: "Request Header Fields Too Large",
     500: "Internal Server Error", 503: "Service Unavailable",
 }
 
@@ -510,7 +558,11 @@ def _make_handler_class(
             try:
                 status, out = router.dispatch(req)
             except HTTPError as e:
-                status, out = e.status, {"message": e.message}
+                status = e.status
+                out = (
+                    json_response({"message": e.message}, e.headers)
+                    if e.headers else {"message": e.message}
+                )
             except Exception:
                 log.exception("unhandled error on %s %s", method, path)
                 status, out = 500, {"message": "internal server error"}

@@ -38,9 +38,14 @@ from pio_tpu.obs import slog
 from pio_tpu.obs.profile import DeviceProfileHook
 from pio_tpu.obs.slo import engine_for_specs
 from pio_tpu.parallel.context import ComputeContext
+from pio_tpu.qos import (
+    DEADLINE_HEADER, DEGRADED_HEADER, DEGRADED_VALUE, PRIORITY_HEADER,
+    Deadline, DeadlineExceeded, QoSGate, cache_key, resolve_policy,
+    retry_after_header,
+)
 from pio_tpu.server.http import (
     HTTPError, JsonHTTPServer, Request, Router, float_param, int_param,
-    keys_equal, metrics_response,
+    json_response, keys_equal, metrics_response,
 )
 from pio_tpu.storage import Storage
 from pio_tpu.workflow.core_workflow import load_models_for_instance
@@ -135,7 +140,7 @@ class _MicroBatcher:
         )
         self._thread.start()
 
-    def submit(self, query, span_sink=None):
+    def submit(self, query, span_sink=None, deadline=None):
         """Serve one query through the current regime; blocks until done.
         If the batch dispatch failed, the fallback per-query predict runs
         HERE — in the request's own thread — so one poisoned query
@@ -145,7 +150,13 @@ class _MicroBatcher:
         ``span_sink`` (a trace handle with ``add_span``) receives the
         queue-wait and execute stage timings measured where they actually
         happen — the worker thread computes per-member queue wait at
-        drain time and the shared batch dispatch duration."""
+        drain time and the shared batch dispatch duration.
+
+        ``deadline`` (a :class:`pio_tpu.qos.Deadline`, optional) rides
+        along in the pend entry: the worker sheds members whose budget
+        elapsed in queue BEFORE dispatching the batch (raised here as
+        ``DeadlineExceeded``) and never stretches the collection window
+        past the tightest queued deadline."""
         mode = self._mode
         if mode == "off" or mode == "probe_solo":
             t0 = monotonic_s()
@@ -158,8 +169,9 @@ class _MicroBatcher:
                 self._note_probe("solo", dt)
             return out
         t0 = monotonic_s()
-        # q, result, exc, done, enqueue_t, stage timings (worker-filled)
-        pend = [query, None, None, threading.Event(), t0, {}]
+        # q, result, exc, done, enqueue_t, stage timings (worker-filled),
+        # deadline
+        pend = [query, None, None, threading.Event(), t0, {}, deadline]
         with self._cv:
             if self._stopped:
                 raise HTTPError(503, "undeployed")
@@ -244,26 +256,50 @@ class _MicroBatcher:
                 if self._stopped and not self._queue:
                     return
             # collection window: let concurrent request threads pile on —
-            # but don't idle when a full batch is already waiting
+            # but don't idle when a full batch is already waiting, and
+            # never wait past the tightest queued deadline (the batch
+            # honors its most impatient member)
             if self._window_s > 0:
                 with self._cv:
                     full = len(self._queue) >= self.MAX_BATCH
+                    tightest = min(
+                        (p[6].remaining_s() for p in self._queue
+                         if p[6] is not None),
+                        default=None,
+                    )
                 if not full:
-                    time.sleep(self._window_s)
+                    sleep_s = self._window_s
+                    if tightest is not None:
+                        sleep_s = min(sleep_s, max(tightest, 0.0))
+                    if sleep_s > 0:
+                        time.sleep(sleep_s)
             with self._cv:
                 batch = self._queue[: self.MAX_BATCH]
                 del self._queue[: len(batch)]
             if not batch:
                 continue
-            self.batches += 1
-            self.batched_queries += len(batch)
-            self.max_batch = max(self.max_batch, len(batch))
             # stage attribution: everything before the drain is queue
             # wait (per member — each enqueued at its own time), the
             # shared dispatch below is each member's execute time
             t_drain = monotonic_s()
             for p in batch:
                 p[5]["queue_s"] = max(t_drain - p[4], 0.0)
+            # deadline shedding: a member whose budget elapsed in queue
+            # is failed HERE, before the model runs — its client already
+            # gave up, and executing it would only slow its batch-mates
+            live = []
+            for p in batch:
+                if p[6] is not None and p[6].expired():
+                    p[2] = DeadlineExceeded("deadline elapsed in queue")
+                    p[3].set()
+                else:
+                    live.append(p)
+            batch = live
+            if not batch:
+                continue
+            self.batches += 1
+            self.batched_queries += len(batch)
+            self.max_batch = max(self.max_batch, len(batch))
             try:
                 results = self._service._predict_batch(
                     [p[0] for p in batch]
@@ -295,6 +331,7 @@ class QueryServerService:
         feedback_app_id: Optional[int] = None,
         admin_key: Optional[str] = None,
         slos: Optional[List[str]] = None,
+        qos: Optional[Any] = None,
     ):
         self.variant = variant
         self.ctx = ctx or ComputeContext.create()
@@ -359,6 +396,18 @@ class QueryServerService:
                 availability_source=self._availability_good_total,
                 latency_cell_getter=lambda: self._request_cell,
             )
+        # -- QoS (ISSUE 3): admission control, deadlines, degradation.
+        # The gate's counters MUST be created here (before any
+        # enable_pool bind) so its shed/admitted cells land in the shared
+        # segment and the rps= budget is enforced POOL-WIDE.
+        policy = resolve_policy(qos, variant.variant)
+        self.qos = (
+            QoSGate(policy, self.obs, scope="queryserver")
+            if policy is not None else None
+        )
+        self._scorer_breaker = (
+            self.qos.breaker("scorer") if self.qos is not None else None
+        )
         self.profile_hook = DeviceProfileHook.from_env()
         self._swap_lock = threading.Lock()
         self._deployed = True
@@ -392,6 +441,7 @@ class QueryServerService:
         r.add("GET", "/traces\\.json", self.get_traces)
         r.add("GET", "/logs\\.json", self.get_logs)
         r.add("GET", "/slo\\.json", self.get_slo)
+        r.add("GET", "/qos\\.json", self.get_qos)
         r.add("GET", "/healthz", self.healthz)
         r.add("GET", "/readyz", self.readyz)
         r.add("POST", "/reload", self.reload)
@@ -496,6 +546,33 @@ class QueryServerService:
         out["configured"] = True
         return 200, out
 
+    def get_qos(self, req: Request):
+        """Admission-control state: policy, bucket level, inflight/queue,
+        shed counts by reason, breaker states, stale-cache stats."""
+        if self.qos is None:
+            return 200, {"enabled": False}
+        return 200, self.qos.snapshot()
+
+    def _shed(self, req: Request, reason: str, retry_after_s: float):
+        """Turn a shed decision into a response: a stale-cache hit (when
+        degradation is configured) answers 200 with ``X-Pio-Degraded``;
+        otherwise 429 (rate limits) / 503 (everything else) with
+        ``Retry-After``. ``pio_tpu_qos_shed_total`` counts only the
+        actual rejections — degraded serves get their own counter."""
+        if self.qos.stale is not None and req.body is not None:
+            cached = self.qos.stale.get(cache_key(req.body))
+            if cached is not None:
+                self.qos.count_degraded()
+                return 200, json_response(
+                    cached, {DEGRADED_HEADER: DEGRADED_VALUE}
+                )
+        self.qos.count_shed(reason)
+        status = 429 if reason in ("rate_limit", "key_rate_limit") else 503
+        raise HTTPError(
+            status, f"overloaded: {reason}",
+            headers=retry_after_header(retry_after_s),
+        )
+
     def _parse_query(self, body: Any, qc):
         if body is None:
             raise HTTPError(400, "query body required")
@@ -544,6 +621,10 @@ class QueryServerService:
             try:
                 seg = PoolMetricsSegment.open(metrics_path)
                 self.obs.bind_pool_segment(seg, idx)
+                if self.qos is not None:
+                    # the admitted-counter stripes are live now; forget
+                    # pre-bind totals so history doesn't drain the bucket
+                    self.qos.on_pool_bound()
             except Exception:
                 log.exception(
                     "pool metrics segment bind failed; this worker "
@@ -572,7 +653,37 @@ class QueryServerService:
         t0 = monotonic_s()
         error = True
         eng = self.variant.engine_id
+        adm = None
+        deadline = None
         try:
+            if self.qos is not None:
+                # deadline clock starts at receipt; a malformed header is
+                # a client error, not silently "no deadline"
+                try:
+                    deadline = Deadline.from_header(
+                        req.header(DEADLINE_HEADER),
+                        default_ms=self.qos.policy.deadline_ms,
+                    )
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+                timeout_s = (
+                    max(deadline.remaining_s(), 0.0)
+                    if deadline is not None else None
+                )
+                adm = self.qos.admit(
+                    priority=req.header(PRIORITY_HEADER),
+                    timeout_s=timeout_s,
+                )
+                if not adm.ok:
+                    out = self._shed(req, adm.reason, adm.retry_after_s)
+                    error = False
+                    return out
+                if self._scorer_breaker is not None:
+                    allowed, retry = self._scorer_breaker.allow()
+                    if not allowed:
+                        out = self._shed(req, "breaker", retry)
+                        error = False
+                        return out
             with self.tracer.trace("query") as tr:
                 # one consistent snapshot — a concurrent /reload must not
                 # mix the old engine's query class with the new engine's
@@ -585,16 +696,37 @@ class QueryServerService:
                 with tr.span("parse"):
                     query = self._parse_query(req.body, qc)
                     query = serving.supplement(query)
-                if self._batcher is not None and not self._batcher.bypassed:
-                    result = self._batcher.submit(query, span_sink=tr)
-                else:
-                    tr.add_span("queue", 0.0)
-                    with tr.span("execute"):
-                        with self.profile_hook.capture():
-                            predictions = [
-                                algo.predict(m, query) for algo, m in pairs
-                            ]
-                        result = serving.serve(query, predictions)
+                try:
+                    if deadline is not None and deadline.expired():
+                        # budget burned before execution (queue wait /
+                        # parse) — shed before the model runs
+                        raise DeadlineExceeded("deadline elapsed")
+                    if self._batcher is not None \
+                            and not self._batcher.bypassed:
+                        result = self._batcher.submit(
+                            query, span_sink=tr, deadline=deadline
+                        )
+                    else:
+                        tr.add_span("queue", 0.0)
+                        with tr.span("execute"):
+                            with self.profile_hook.capture():
+                                predictions = [
+                                    algo.predict(m, query)
+                                    for algo, m in pairs
+                                ]
+                            result = serving.serve(query, predictions)
+                except DeadlineExceeded:
+                    out = self._shed(req, "deadline", 0.0)
+                    error = False
+                    return out
+                except HTTPError:
+                    raise
+                except Exception:
+                    if self._scorer_breaker is not None:
+                        self._scorer_breaker.record_failure()
+                    raise
+                if self._scorer_breaker is not None:
+                    self._scorer_breaker.record_success()
                 with tr.span("serialize"):
                     out = _to_jsonable(result)
                     for blocker in QUERY_BLOCKERS:
@@ -615,6 +747,10 @@ class QueryServerService:
                             sniffer(req.body, out)
                         except Exception:
                             log.exception("query sniffer failed")
+                if self.qos is not None and self.qos.stale is not None \
+                        and req.body is not None:
+                    # feed the degradation cache with the fresh answer
+                    self.qos.stale.put(cache_key(req.body), out)
                 error = False
                 # inside the trace → this record carries the trace id,
                 # joining /logs.json?trace_id=... to /traces.json
@@ -624,6 +760,8 @@ class QueryServerService:
                 )
                 return 200, out
         finally:
+            if adm is not None:
+                adm.release()
             dur_s = monotonic_s() - t0
             self.stats.record(dur_s * 1e3, error)
             self._request_cell.observe(dur_s)
@@ -837,13 +975,14 @@ def create_query_server(
     admin_key: Optional[str] = None,
     reuse_port: bool = False,
     slos: Optional[List[str]] = None,
+    qos: Optional[Any] = None,
 ) -> Tuple[JsonHTTPServer, QueryServerService]:
     from pio_tpu.server.plugins import load_plugins_from_env
 
     load_plugins_from_env()
     service = QueryServerService(
         variant, instance_id, ctx, feedback, feedback_app_id, admin_key,
-        slos=slos,
+        slos=slos, qos=qos,
     )
     server = JsonHTTPServer(
         service.router, host, port, name="pio-tpu-queryserver",

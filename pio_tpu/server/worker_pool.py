@@ -93,6 +93,7 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt,
         admin_key=spec.get("admin_key"),
         reuse_port=True,
         slos=spec.get("slos"),
+        qos=spec.get("qos"),
     )
     service.enable_pool(
         idx, spec["n_workers"], gen, shutdown_evt,
@@ -153,6 +154,7 @@ class ServingPool:
         admin_key: Optional[str] = None,
         device_worker: bool = False,
         slos: Optional[list] = None,
+        qos: Optional[str] = None,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -189,6 +191,12 @@ class ServingPool:
             "admin_key": admin_key,
             "device_worker": device_worker,
             "slos": list(slos) if slos else None,
+            # QoS spec string: every worker parses the same policy, and
+            # because each runs identical service-init code, their QoS
+            # counter cells land on the same shared-segment slots — the
+            # striped token bucket depends on that alignment to enforce
+            # one rps= budget POOL-WIDE (see pio_tpu/qos/limiter.py)
+            "qos": qos,
         }
         self.n_workers = n_workers
         self._procs: list = []

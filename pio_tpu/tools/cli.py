@@ -349,6 +349,13 @@ def cmd_deploy(args) -> int:
         for spec in slos:
             parse_slo(spec)
         os.environ["PIO_TPU_SLO"] = ",".join(slos)
+    qos = getattr(args, "qos", None) or None
+    if qos:
+        # same fail-fast + spawn-context export dance as --slo above
+        from pio_tpu.qos import parse_qos
+
+        parse_qos(qos)
+        os.environ["PIO_TPU_QOS"] = qos
     if getattr(args, "workers", 1) > 1:
         from pio_tpu.server.worker_pool import ServingPool
 
@@ -363,6 +370,7 @@ def cmd_deploy(args) -> int:
             admin_key=args.admin_key,
             device_worker=args.device_worker,
             slos=slos,
+            qos=qos,
         )
         pool.start()
         # readiness-gated: wait_ready polls /readyz, so "listening" below
@@ -387,6 +395,7 @@ def cmd_deploy(args) -> int:
         feedback_app_id=feedback_app_id,
         admin_key=args.admin_key,
         slos=slos,
+        qos=qos,
     )
     # reference parity: `pio undeploy` terminates the serving process
     service.attach_server(server)
@@ -756,6 +765,15 @@ def build_parser() -> argparse.ArgumentParser:
              "of requests within 50 ms) or availability=99.9, optional "
              "/WINDOW suffix (e.g. /6h); evaluated live on /slo.json "
              "and exported as pio_tpu_slo_* gauges",
+    )
+    a.add_argument(
+        "--qos", default=None, metavar="SPEC",
+        help="admission control spec, e.g. "
+             "'rps=500,queue=64,deadline=100ms' (keys: rps, burst, "
+             "key_rps, key_burst, inflight, queue, deadline, cache, "
+             "fail_rate, fail_window, probes, cooldown); excess load "
+             "is shed with 429/503 + Retry-After, state on /qos.json; "
+             "with --workers>1 the rps budget is pool-wide",
     )
     a.set_defaults(fn=cmd_deploy)
 

@@ -5,8 +5,9 @@
 # and assert 200 + well-formed JSON / Prometheus text.
 #
 # Endpoints covered: /healthz /readyz /metrics /logs.json /slo.json
-# (plus one real /queries.json POST so logs, histograms and the SLO
-# engine have live data to report).
+# /qos.json (plus one real /queries.json POST so logs, histograms and
+# the SLO engine have live data to report, and a rapid-fire burst so
+# admission control demonstrably sheds with 429 + Retry-After).
 #
 # Runs hermetically: memory storage, ephemeral port, CPU-pinned JAX.
 # Exit 0 = all checks passed. Wired into tier-1 via
@@ -70,9 +71,13 @@ variant = variant_from_dict({
 })
 engine, ep = build_engine(variant)
 run_train(engine, ep, variant, ctx=ComputeContext.local())
+# qos: generous enough that the sequential checks never shed, small
+# enough that the burst at the end reliably trips 429s; no stale cache
+# (a cache hit would turn the asserted 429 into a degraded 200)
 server, service = create_query_server(
     variant, host="127.0.0.1", port=0, ctx=ComputeContext.local(),
     slos=["p99=50ms:99.9", "availability=99.9"],
+    qos="rps=2,burst=8",
 )
 server.start()
 with open(sys.argv[1] + ".tmp", "w") as f:
@@ -118,6 +123,17 @@ check_json "/logs.json?level=info&n=50"
 check_json /slo.json
 check_json /traces.json
 check_json /stats.json
+check_json /qos.json
+
+# /qos.json must reflect the deployed admission policy
+curl -fsS --max-time 10 "$BASE/qos.json" | python -c '
+import json, sys
+body = json.load(sys.stdin)
+assert body["enabled"] is True, body
+assert body["policy"]["rps"] == 2, body["policy"]
+assert "shed" in body and "bucket" in body, body
+' || fail "/qos.json missing admission-control state"
+echo "ok   /qos.json policy"
 
 # /slo.json must carry both declared objectives with burn-rate fields
 curl -fsS --max-time 10 "$BASE/slo.json" | python -c '
@@ -145,5 +161,32 @@ echo "ok   /metrics exposition"
 STATUS="$(curl -s -o /dev/null -w '%{http_code}' --max-time 10 "$BASE/logs.json?n=-5")"
 [ "$STATUS" = 400 ] || fail "/logs.json?n=-5 returned $STATUS, want 400"
 echo "ok   /logs.json?n=-5 -> 400"
+
+# admission control: rapid-fire past the rps=2,burst=8 budget (LAST, so
+# drained tokens can't starve the checks above) and require at least one
+# 429 carrying a Retry-After hint
+SHED_HEADERS="$WORKDIR/shed-headers"
+GOT_429=0
+for _ in $(seq 1 25); do
+    STATUS="$(curl -s -o /dev/null -D "$SHED_HEADERS" -w '%{http_code}' \
+        --max-time 10 -X POST -H 'Content-Type: application/json' \
+        -d '{"user": "u1", "num": 3}' "$BASE/queries.json")"
+    if [ "$STATUS" = 429 ]; then GOT_429=1; break; fi
+done
+[ "$GOT_429" = 1 ] || fail "burst of 25 queries never rate-limited (no 429)"
+grep -qi '^Retry-After:' "$SHED_HEADERS" \
+    || fail "429 response missing Retry-After header"
+echo "ok   burst -> 429 + Retry-After"
+
+# ...and the shed must be accounted on /qos.json and /metrics
+curl -fsS --max-time 10 "$BASE/qos.json" | python -c '
+import json, sys
+body = json.load(sys.stdin)
+assert body["shed"]["rate_limit"] >= 1, body["shed"]
+' || fail "/qos.json did not count the rate_limit shed"
+curl -fsS --max-time 10 "$BASE/metrics" \
+    | grep -q 'pio_tpu_qos_shed_total{.*reason="rate_limit"' \
+    || fail "/metrics missing pio_tpu_qos_shed_total rate_limit sample"
+echo "ok   shed accounted in /qos.json + /metrics"
 
 echo "smoke OK"

@@ -1,0 +1,706 @@
+"""Admission control & QoS (ISSUE 3): spec grammar, token buckets
+(including the pool-wide striped budget), concurrency limiting, circuit
+breaking, deadlines, stale-cache degradation — plus the two servers'
+behavior under synthetic overload: excess load must shed with 429/503 +
+``Retry-After`` (or degrade to a marked stale 200) while the server
+stays up and every rejection lands in ``pio_tpu_qos_shed_total``."""
+
+import datetime as dt
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pio_tpu.templates  # noqa: F401  (registers the factory)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.qos import (
+    DEADLINE_HEADER,
+    DEGRADED_HEADER,
+    DEGRADED_VALUE,
+    CircuitBreaker,
+    ConcurrencyLimiter,
+    Deadline,
+    QoSError,
+    QoSGate,
+    QoSPolicy,
+    StaleCache,
+    TokenBucket,
+    cache_key,
+    parse_deadline_ms,
+    parse_qos,
+    policy_from_dict,
+    priority_floor,
+    resolve_policy,
+)
+from pio_tpu.server import create_event_server, create_query_server
+from pio_tpu.storage import AccessKey, App, Storage
+from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+# -- policy / spec grammar ---------------------------------------------------
+
+
+class TestParseQos:
+    def test_issue_spec(self):
+        p = parse_qos("rps=500,queue=64,deadline=100ms")
+        assert p.rps == 500.0
+        assert p.queue == 64
+        assert p.deadline_ms == 100.0
+        assert p.effective_burst() == 500.0  # default: one second of rps
+
+    def test_all_keys(self):
+        p = parse_qos(
+            "rps=10,burst=20,key_rps=5,key_burst=7,inflight=4,queue=2,"
+            "deadline=50ms,cache=128,fail_rate=0.3,fail_window=10,"
+            "probes=2,cooldown=250ms"
+        )
+        assert (p.rps, p.burst, p.key_rps, p.key_burst) == (10, 20, 5, 7)
+        assert (p.inflight, p.queue, p.cache) == (4, 2, 128)
+        assert p.deadline_ms == 50.0
+        assert (p.fail_rate, p.fail_window, p.probes) == (0.3, 10, 2)
+        assert p.cooldown_s == pytest.approx(0.25)
+
+    @pytest.mark.parametrize("bad", [
+        "rps",                 # not key=value
+        "turbo=9",             # unknown key
+        "rps=-1",              # negative
+        "queue=-5",
+        "fail_rate=1.5",       # fraction > 1
+        "deadline=banana",     # not a duration
+        "rps=abc",
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(QoSError):
+            parse_qos(bad)
+
+    def test_policy_from_dict(self):
+        assert policy_from_dict({"spec": "rps=3"}).rps == 3.0
+        p = policy_from_dict({"rps": 3, "queue": 2})
+        assert (p.rps, p.queue) == (3, 2)
+        with pytest.raises(QoSError):
+            policy_from_dict({"nope": 1})
+
+    def test_resolve_precedence(self, monkeypatch):
+        monkeypatch.setenv("PIO_TPU_QOS", "rps=7")
+        assert resolve_policy("rps=9").rps == 9.0       # explicit wins
+        assert resolve_policy(None).rps == 7.0          # env next
+        monkeypatch.delenv("PIO_TPU_QOS")
+        assert resolve_policy(None, {"qos": "rps=5"}).rps == 5.0
+        assert resolve_policy(None, {"qos": {"spec": "rps=4"}}).rps == 4.0
+        assert resolve_policy(None, {}) is None         # QoS off
+        ready = QoSPolicy(rps=1.0)
+        assert resolve_policy(ready) is ready           # passthrough
+
+    def test_priority_floors(self):
+        assert priority_floor("interactive") == 0.0
+        assert priority_floor("batchpredict") == 0.25
+        assert priority_floor("shadow") == 0.5
+        assert priority_floor(None) == 0.0
+        assert priority_floor("TyPo") == 0.0  # unknown ⇒ interactive
+
+
+# -- token buckets -----------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        clock = FakeClock()
+        b = TokenBucket(rate=2.0, burst=3.0, clock=clock)
+        assert all(b.try_acquire()[0] for _ in range(3))
+        ok, retry = b.try_acquire()
+        assert not ok and retry == pytest.approx(0.5)  # 1 token / 2 rps
+        clock.advance(0.5)
+        assert b.try_acquire()[0]
+        clock.advance(100.0)  # refill clamps at burst
+        assert b.level() == pytest.approx(3.0)
+
+    def test_priority_floor_reserves_headroom(self):
+        clock = FakeClock()
+        b = TokenBucket(rate=1.0, burst=10.0, clock=clock)
+        for _ in range(5):
+            assert b.try_acquire()[0]
+        # 5 tokens left = exactly the shadow floor: background is shed,
+        # interactive still admitted
+        assert not b.try_acquire(floor=0.5)[0]
+        assert b.try_acquire(floor=0.0)[0]
+
+    def test_pool_wide_budget_via_striped_segment(self, tmp_path):
+        """Two registries bound as pool workers 0/1 share ONE budget:
+        admissions through either gate drain the other's bucket."""
+        from pio_tpu.obs.metrics import MetricsRegistry
+        from pio_tpu.obs.shm import PoolMetricsSegment
+
+        clock = FakeClock()
+        policy = parse_qos("rps=1,burst=6")
+        path = str(tmp_path / "pool-metrics")
+        seg = PoolMetricsSegment.create(path, n_workers=2)
+        try:
+            gates = []
+            for idx in range(2):
+                reg = MetricsRegistry()
+                gate = QoSGate(policy, reg, scope="queryserver",
+                               clock=clock)
+                reg.bind_pool_segment(
+                    PoolMetricsSegment.open(path), idx
+                )
+                gate.on_pool_bound()
+                gates.append(gate)
+            a, b = gates
+            for _ in range(4):
+                assert a.admit().ok
+            # worker B observes A's 4 admissions through the segment:
+            # only 2 of the shared 6-token burst remain
+            assert b.admit().ok
+            assert b.admit().ok
+            refused = b.admit()
+            assert not refused.ok and refused.reason == "rate_limit"
+            assert refused.retry_after_s > 0
+            # ...and A sees B's consumption right back
+            assert not a.admit().ok
+            # pool-wide admitted total covers both workers
+            assert a.bucket._pool_total() == pytest.approx(6.0)
+        finally:
+            seg.unlink()
+
+    def test_rebase_forgets_stripe_history(self, tmp_path):
+        """A respawned worker adopting a stripe with prior admissions
+        must not start with a pre-drained bucket."""
+        from pio_tpu.obs.metrics import MetricsRegistry
+        from pio_tpu.obs.shm import PoolMetricsSegment
+
+        clock = FakeClock()
+        policy = parse_qos("rps=1,burst=4")
+        path = str(tmp_path / "pool-metrics")
+        seg = PoolMetricsSegment.create(path, n_workers=1)
+        try:
+            def spawn_worker():
+                reg = MetricsRegistry()
+                gate = QoSGate(policy, reg, scope="queryserver",
+                               clock=clock)
+                reg.bind_pool_segment(PoolMetricsSegment.open(path), 0)
+                gate.on_pool_bound()
+                return gate
+
+            first = spawn_worker()
+            for _ in range(3):
+                assert first.admit().ok  # stripe now carries history
+            # "respawn": a fresh worker adopts the same stripe — rebase
+            # must keep those 3 historical admissions from draining the
+            # new bucket, leaving the full burst of 4
+            respawned = spawn_worker()
+            assert all(respawned.admit().ok for _ in range(4))
+            assert not respawned.admit().ok
+        finally:
+            seg.unlink()
+
+
+class TestConcurrencyLimiter:
+    def test_slots_queue_and_timeout(self):
+        lim = ConcurrencyLimiter(max_inflight=1, max_queue=0)
+        assert lim.enter() == ConcurrencyLimiter.OK
+        assert lim.enter() == ConcurrencyLimiter.QUEUE_FULL
+        lim.exit()
+        assert lim.enter() == ConcurrencyLimiter.OK
+        lim.exit()
+
+    def test_queue_timeout(self):
+        lim = ConcurrencyLimiter(max_inflight=1, max_queue=2)
+        assert lim.enter() == ConcurrencyLimiter.OK
+        assert lim.enter(timeout_s=0.0) == ConcurrencyLimiter.TIMEOUT
+        lim.exit()
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_trip_cooldown_probe_close(self):
+        clock = FakeClock()
+        states = []
+        br = CircuitBreaker(failure_rate=0.5, window=4, cooldown_s=5.0,
+                            probes=2, clock=clock,
+                            on_state_change=states.append)
+        for _ in range(4):
+            assert br.allow()[0]
+            br.record_failure()
+        assert br.state == "open"
+        ok, retry = br.allow()
+        assert not ok and 0 < retry <= 5.0
+        clock.advance(5.0)
+        assert br.state == "half_open"
+        # probe trickle: 2 concurrent probes pass, the 3rd is refused
+        assert br.allow()[0] and br.allow()[0]
+        assert not br.allow()[0]
+        br.record_success()
+        br.record_success()
+        assert br.state == "closed"
+        assert br.snapshot()["windowSamples"] == 0  # window cleared
+        assert states == ["open", "half_open", "closed"]
+
+    def test_half_open_failure_reopens(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_rate=0.5, window=2, cooldown_s=1.0,
+                            probes=1, clock=clock)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "open"
+        clock.advance(1.0)
+        assert br.allow()[0]  # half-open probe
+        br.record_failure()   # still sick: cooldown restarts
+        assert br.state == "open"
+        assert not br.allow()[0]
+
+    def test_mixed_window_below_rate_stays_closed(self):
+        br = CircuitBreaker(failure_rate=0.75, window=4,
+                            clock=FakeClock())
+        for failed in (True, False, True, False, True, False):
+            br.record_failure() if failed else br.record_success()
+        assert br.state == "closed"
+
+
+# -- deadlines & degradation -------------------------------------------------
+
+
+class TestDeadline:
+    def test_parse(self):
+        assert parse_deadline_ms(None) is None
+        assert parse_deadline_ms("  ") is None
+        assert parse_deadline_ms("150") == 150.0
+        for bad in ("abc", "-5", "0", "nan"):
+            with pytest.raises(ValueError):
+                parse_deadline_ms(bad)
+
+    def test_remaining_and_expiry(self):
+        clock = FakeClock()
+        d = Deadline(100.0, clock=clock)
+        assert d.remaining_s() == pytest.approx(0.1)
+        assert not d.expired()
+        clock.advance(0.1)
+        assert d.expired()
+
+    def test_from_header_default(self):
+        clock = FakeClock()
+        assert Deadline.from_header(None, default_ms=None,
+                                    clock=clock) is None
+        d = Deadline.from_header(None, default_ms=50.0, clock=clock)
+        assert d.remaining_s() == pytest.approx(0.05)
+        d = Deadline.from_header("25", default_ms=50.0, clock=clock)
+        assert d.remaining_s() == pytest.approx(0.025)
+
+
+class TestStaleCache:
+    def test_lru_and_stats(self):
+        c = StaleCache(2)
+        c.put("a", 1)
+        c.put("b", 2)
+        assert c.get("a") == 1  # touches a: b is now the LRU entry
+        c.put("c", 3)
+        assert c.get("b") is None  # evicted
+        assert c.get("a") == 1 and c.get("c") == 3
+        s = c.stats()
+        assert s["entries"] == 2 and s["hits"] == 3 and s["misses"] == 1
+
+    def test_cache_key_order_insensitive(self):
+        assert cache_key({"user": "u1", "num": 3}) == \
+            cache_key({"num": 3, "user": "u1"})
+
+
+# -- http env hardening (satellite) ------------------------------------------
+
+
+class TestEnvHardening:
+    def test_malformed_env_warns_and_falls_back(self, monkeypatch):
+        from pio_tpu.server import http as http_mod
+
+        monkeypatch.setenv("PIO_TPU_MAX_BODY_MB", "banana")
+        with pytest.warns(RuntimeWarning, match="PIO_TPU_MAX_BODY_MB"):
+            assert http_mod._env_float("PIO_TPU_MAX_BODY_MB", 4096.0) \
+                == 4096.0
+
+    @pytest.mark.parametrize("bad", ["-3", "0", "nan"])
+    def test_non_positive_env_warns_and_falls_back(self, monkeypatch, bad):
+        from pio_tpu.server import http as http_mod
+
+        monkeypatch.setenv("PIO_TPU_MAX_JSON_BODY_MB", bad)
+        with pytest.warns(RuntimeWarning):
+            assert http_mod._env_float(
+                "PIO_TPU_MAX_JSON_BODY_MB", 64.0
+            ) == 64.0
+
+    def test_valid_env_parses(self, monkeypatch):
+        from pio_tpu.server import http as http_mod
+
+        monkeypatch.setenv("PIO_TPU_MAX_BODY_MB", "10.5")
+        assert http_mod._env_float("PIO_TPU_MAX_BODY_MB", 4096.0) == 10.5
+        monkeypatch.delenv("PIO_TPU_MAX_BODY_MB")
+        assert http_mod._env_float("PIO_TPU_MAX_BODY_MB", 4096.0) == 4096.0
+
+
+# -- live servers under overload ---------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def mem_storage(tmp_home, monkeypatch):
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "MEM")
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def http(method, url, body=None, headers=None):
+    """(status, parsed body, lowercase header dict)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return (resp.status, json.loads(resp.read() or b"null"),
+                    {k.lower(): v for k, v in resp.headers.items()})
+    except urllib.error.HTTPError as e:
+        return (e.code, json.loads(e.read() or b"null"),
+                {k.lower(): v for k, v in e.headers.items()})
+
+
+VARIANT = {
+    "id": "rec-qos",
+    "engineFactory": "templates.recommendation",
+    "datasource": {"params": {"app_name": "qos-test"}},
+    "algorithms": [
+        {"name": "als",
+         "params": {"rank": 4, "num_iterations": 6, "lambda_": 0.1}}
+    ],
+}
+
+
+@pytest.fixture()
+def app_id():
+    return Storage.get_meta_data_apps().insert(App(0, "qos-test"))
+
+
+def _train(app_id):
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    for u in range(8):
+        for i in range(6):
+            in_block = (u < 4) == (i < 3)
+            le.insert(
+                Event("rate", "user", f"u{u}", "item", f"i{i}",
+                      properties={"rating": 5.0 if in_block else 1.0},
+                      event_time=t0),
+                app_id,
+            )
+    variant = variant_from_dict(VARIANT)
+    engine, ep = build_engine(variant)
+    ctx = ComputeContext.local()
+    run_train(engine, ep, variant, ctx=ctx)
+    return variant, ctx
+
+
+def _serve(app_id, qos, **kwargs):
+    variant, ctx = _train(app_id)
+    server, service = create_query_server(
+        variant, host="127.0.0.1", port=0, ctx=ctx, qos=qos, **kwargs
+    )
+    server.start()
+    return server, service, f"http://127.0.0.1:{server.port}"
+
+
+def _scrape(url):
+    from pio_tpu.obs.promparse import parse_prometheus_text
+
+    with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+        return parse_prometheus_text(r.read().decode("utf-8"))
+
+
+class TestQueryServerOverload:
+    def test_2x_burst_sheds_and_survives(self, app_id):
+        """The acceptance scenario: a burst well past the admitted
+        budget. Excess requests shed as 429 + Retry-After, admitted ones
+        complete, the server stays up, and shed_total accounts for every
+        rejection."""
+        import concurrent.futures
+
+        server, service, url = _serve(app_id, qos="rps=5,burst=5")
+        try:
+            def one(t):
+                return http("POST", f"{url}/queries.json",
+                            {"user": f"u{t % 8}", "num": 3})
+
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                results = list(ex.map(one, range(40)))
+            admitted = [r for r in results if r[0] == 200]
+            shed = [r for r in results if r[0] == 429]
+            assert {r[0] for r in results} <= {200, 429}
+            assert admitted, "budget-sized slice must complete"
+            assert shed, "2x burst must shed"
+            for _, body, headers in shed:
+                assert int(headers["retry-after"]) >= 1
+                assert "overloaded" in body["message"]
+            for _, body, _ in admitted:
+                assert len(body["itemScores"]) == 3
+            # still alive and healthy after the burst
+            assert http("GET", f"{url}/healthz")[0] == 200
+            # every rejection is accounted, none double-counted
+            pm = _scrape(url)
+            assert sum(
+                pm.family("pio_tpu_qos_shed_total").values()
+            ) == len(shed)
+            assert pm.value(
+                "pio_tpu_qos_shed_total",
+                scope="queryserver", reason="rate_limit",
+            ) == len(shed)
+            snap = http("GET", f"{url}/qos.json")[1]
+            assert snap["shed"]["rate_limit"] == len(shed)
+            assert snap["admitted"] == len(admitted)
+        finally:
+            server.stop()
+
+    def test_deadline_expired_in_queue_never_reaches_scorer(
+            self, app_id, monkeypatch):
+        """A query whose X-Pio-Deadline-Ms budget elapses in the
+        micro-batch queue is shed BEFORE model execution: 503, counted
+        as reason=deadline, and its user never appears in any batch."""
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_US", "200000")
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_ADAPTIVE", "0")
+        server, service, url = _serve(app_id, qos="rps=1000")
+        try:
+            seen = []
+            real = service._predict_batch
+
+            def spying(queries):
+                seen.extend(q.user for q in queries)
+                return real(queries)
+
+            monkeypatch.setattr(service, "_predict_batch", spying)
+            # warm query (no deadline) proves the batch path works
+            status, body, _ = http(
+                "POST", f"{url}/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200 and "u1" in seen
+            # 20ms budget vs a 200ms collection window: expires in queue
+            status, body, headers = http(
+                "POST", f"{url}/queries.json", {"user": "u2", "num": 3},
+                headers={DEADLINE_HEADER: "20"},
+            )
+            assert status == 503
+            assert "deadline" in body["message"]
+            assert int(headers["retry-after"]) >= 1
+            assert "u2" not in seen, "expired query must not execute"
+            snap = http("GET", f"{url}/qos.json")[1]
+            assert snap["shed"]["deadline"] == 1
+        finally:
+            server.stop()
+
+    def test_malformed_deadline_is_client_error(self, app_id):
+        server, service, url = _serve(app_id, qos="rps=1000")
+        try:
+            status, body, _ = http(
+                "POST", f"{url}/queries.json", {"user": "u1", "num": 3},
+                headers={DEADLINE_HEADER: "soon"},
+            )
+            assert status == 400
+        finally:
+            server.stop()
+
+    def test_scorer_breaker_opens_and_recovers(self, app_id):
+        """Scorer failures trip the breaker: subsequent queries shed
+        fast as 503 reason=breaker; after the cooldown a half-open probe
+        success closes it again."""
+        server, service, url = _serve(
+            app_id,
+            qos="rps=1000,fail_rate=0.5,fail_window=4,"
+                "cooldown=300ms,probes=1",
+        )
+        try:
+            class Sick:
+                def predict(self, model, query):
+                    raise RuntimeError("scorer down")
+
+            good_pairs = service.pairs
+            service.pairs = [(Sick(), None)]
+            for _ in range(4):
+                status, _, _ = http(
+                    "POST", f"{url}/queries.json", {"user": "u1", "num": 3}
+                )
+                assert status == 500
+            # breaker open: shed BEFORE the scorer is even attempted
+            status, body, headers = http(
+                "POST", f"{url}/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 503
+            assert "breaker" in body["message"]
+            assert int(headers["retry-after"]) >= 1
+            snap = http("GET", f"{url}/qos.json")[1]
+            assert snap["breakers"]["scorer"]["state"] == "open"
+            assert snap["shed"]["breaker"] >= 1
+            # dependency recovers; cooldown elapses; probe closes it
+            service.pairs = good_pairs
+            time.sleep(0.35)
+            status, body, _ = http(
+                "POST", f"{url}/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200 and body["itemScores"]
+            snap = http("GET", f"{url}/qos.json")[1]
+            assert snap["breakers"]["scorer"]["state"] == "closed"
+        finally:
+            server.stop()
+
+    def test_stale_cache_degrades_instead_of_shedding(self, app_id):
+        """With cache= configured, a shed whose query was answered
+        recently returns the stale answer as a marked 200; only true
+        rejections count as shed."""
+        # rps is tiny so refill during the first query's JAX warmup
+        # cannot hand the third request a fresh token
+        server, service, url = _serve(app_id,
+                                      qos="rps=0.05,burst=2,cache=32")
+        try:
+            body = {"user": "u1", "num": 3}
+            s1, fresh, h1 = http("POST", f"{url}/queries.json", body)
+            assert s1 == 200 and DEGRADED_HEADER.lower() not in h1
+            http("POST", f"{url}/queries.json", body)  # drains the burst
+            status, stale, headers = http(
+                "POST", f"{url}/queries.json", body
+            )
+            assert status == 200
+            assert headers[DEGRADED_HEADER.lower()] == DEGRADED_VALUE
+            assert stale["itemScores"] == fresh["itemScores"]
+            # an uncached query past the budget is a real 429
+            status, _, headers = http(
+                "POST", f"{url}/queries.json", {"user": "u7", "num": 2}
+            )
+            assert status == 429 and "retry-after" in headers
+            snap = http("GET", f"{url}/qos.json")[1]
+            assert snap["degraded"] == 1
+            assert snap["shed"]["rate_limit"] == 1
+            assert snap["staleCache"]["hits"] == 1
+        finally:
+            server.stop()
+
+    def test_priority_header_sheds_background_first(self, app_id):
+        """Shadow traffic only rides a mostly-full bucket: once the
+        burst is half drained, shadow sheds while interactive admits."""
+        # tiny rps: warmup-time refill must stay well under one token
+        server, service, url = _serve(app_id, qos="rps=0.05,burst=8")
+        try:
+            for _ in range(4):  # drain to the shadow floor (50%)
+                assert http("POST", f"{url}/queries.json",
+                            {"user": "u1", "num": 3})[0] == 200
+            status, _, headers = http(
+                "POST", f"{url}/queries.json", {"user": "u1", "num": 3},
+                headers={"X-Pio-Priority": "shadow"},
+            )
+            assert status == 429 and "retry-after" in headers
+            assert http("POST", f"{url}/queries.json",
+                        {"user": "u1", "num": 3})[0] == 200
+        finally:
+            server.stop()
+
+    def test_qos_json_disabled_without_policy(self, app_id):
+        server, service, url = _serve(app_id, qos=None)
+        try:
+            assert http("GET", f"{url}/qos.json")[1] == {"enabled": False}
+            # no QoS ⇒ untouched serving path
+            assert http("POST", f"{url}/queries.json",
+                        {"user": "u1", "num": 3})[0] == 200
+        finally:
+            server.stop()
+
+    def test_qos_json_snapshot_shape(self, app_id):
+        server, service, url = _serve(
+            app_id, qos="rps=100,inflight=8,queue=4,cache=16"
+        )
+        try:
+            snap = http("GET", f"{url}/qos.json")[1]
+            assert snap["enabled"] is True
+            assert snap["scope"] == "queryserver"
+            assert snap["policy"]["rps"] == 100.0
+            assert snap["policy"]["priorities"]["shadow"] == 0.5
+            assert set(snap["shed"]) == {
+                "rate_limit", "key_rate_limit", "queue_full",
+                "queue_timeout", "deadline", "breaker",
+            }
+            assert snap["bucket"]["burst"] == 100.0
+            assert snap["concurrency"]["maxInflight"] == 8
+            assert snap["staleCache"]["capacity"] == 16
+            assert snap["breakers"]["scorer"]["state"] == "closed"
+        finally:
+            server.stop()
+
+
+class TestEventServerQoS:
+    def test_per_key_rate_limit(self):
+        """Ingest is throttled per access key: one key exhausting its
+        bucket gets 429 + Retry-After; another key is unaffected."""
+        app_id = Storage.get_meta_data_apps().insert(App(0, "ev-qos"))
+        keys = Storage.get_meta_data_access_keys()
+        k1 = keys.insert(AccessKey("", app_id))
+        k2 = keys.insert(AccessKey("", app_id))
+        server = create_event_server(
+            host="127.0.0.1", port=0, qos="key_rps=1,key_burst=2"
+        ).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            ev = {"event": "rate", "entityType": "user", "entityId": "u1",
+                  "properties": {"rating": 4.0},
+                  "eventTime": "2026-03-01T10:00:00Z"}
+            for _ in range(2):
+                assert http(
+                    "POST", f"{url}/events.json?accessKey={k1}", ev
+                )[0] == 201
+            status, body, headers = http(
+                "POST", f"{url}/events.json?accessKey={k1}", ev
+            )
+            assert status == 429 and int(headers["retry-after"]) >= 1
+            # a different key still has its full bucket
+            assert http(
+                "POST", f"{url}/events.json?accessKey={k2}", ev
+            )[0] == 201
+            snap = http("GET", f"{url}/qos.json")[1]
+            assert snap["scope"] == "eventserver"
+            assert snap["shed"]["key_rate_limit"] == 1
+            assert snap["keyBuckets"]["keys"] == 2
+        finally:
+            server.stop()
+
+    def test_engine_wide_ingest_limit(self):
+        app_id = Storage.get_meta_data_apps().insert(App(0, "ev-qos2"))
+        key = Storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id)
+        )
+        server = create_event_server(
+            host="127.0.0.1", port=0, qos="rps=1,burst=3"
+        ).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            ev = {"event": "buy", "entityType": "user", "entityId": "u1",
+                  "eventTime": "2026-03-01T10:00:00Z"}
+            codes = [
+                http("POST", f"{url}/events.json?accessKey={key}", ev)[0]
+                for _ in range(6)
+            ]
+            assert codes.count(201) >= 3
+            assert 429 in codes
+            # sheds feed the error accounting (and thus the SLO engine)
+            stats = http("GET", f"{url}/stats.json")[1]
+            assert stats["errorCount"] == codes.count(429)
+        finally:
+            server.stop()
