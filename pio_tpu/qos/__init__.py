@@ -21,7 +21,7 @@ The pieces, in request order:
   state) surfaced on ``GET /qos.json``.
 """
 
-from pio_tpu.qos.breaker import CircuitBreaker
+from pio_tpu.qos.breaker import BreakerCall, CircuitBreaker
 from pio_tpu.qos.deadline import (
     DEADLINE_HEADER,
     Deadline,
@@ -55,6 +55,7 @@ from pio_tpu.qos.policy import (
 
 __all__ = [
     "Admission",
+    "BreakerCall",
     "CircuitBreaker",
     "ConcurrencyLimiter",
     "DEADLINE_HEADER",

@@ -171,6 +171,14 @@ class ConcurrencyLimiter:
                 return self.OK
             finally:
                 self._waiting -= 1
+                # lost-wakeup guard: exit() notifies ONE waiter. If that
+                # notify landed on us and we leave without taking the
+                # freed slot (deadline passed → TIMEOUT), or slots remain
+                # after we took ours, pass the baton so the capacity is
+                # used now instead of idling until another waiter's
+                # timeout or poll tick.
+                if self._inflight < self.max_inflight and self._waiting > 0:
+                    self._cond.notify()
 
     def exit(self) -> None:
         with self._cond:

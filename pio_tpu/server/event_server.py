@@ -349,26 +349,58 @@ class EventServerService:
             )
         return adm
 
+    def _admit_then_auth(self, req: Request):
+        """Admission BEFORE auth: the rate limiter exists to shed a
+        flood before it reaches the storage-backed access-key lookup, so
+        it cannot sit behind that lookup (the 2s positive cache does not
+        help a unique-key flood — misses are never cached). The per-key
+        bucket keys on the PRESENTED bearer key — a header read, no
+        storage — so an invalid key burns its own bucket, not a real
+        tenant's. The admission is released if auth then rejects.
+
+        Sheds are still recorded into the request window (they feed the
+        SLO engine's error accounting); auth failures are not, matching
+        the pre-QoS behavior."""
+        t0 = monotonic_s()
+        try:
+            adm = self._qos_admit(req)
+        except HTTPError:
+            dur_s = monotonic_s() - t0
+            self.req_window.record(dur_s * 1e3, True)
+            self._request_cell.observe(dur_s)
+            raise
+        try:
+            app_id, channel_id, whitelist = self._auth(req)
+        except BaseException:
+            if adm is not None:
+                adm.release()
+            raise
+        return adm, app_id, channel_id, whitelist
+
     def _guarded_insert(self, fn):
         """Run a storage write through the circuit breaker: an open
         breaker fails fast with 503 + Retry-After instead of queueing
         more work onto a dependency that is already drowning."""
         if self._storage_breaker is None:
             return fn()
-        allowed, retry = self._storage_breaker.allow()
-        if not allowed:
+        call = self._storage_breaker.acquire()
+        if not call.allowed:
             self.qos.count_shed("breaker")
             raise HTTPError(
                 503, "overloaded: storage circuit breaker open",
-                headers=retry_after_header(retry),
+                headers=retry_after_header(call.retry_after_s),
             )
         try:
             out = fn()
+            call.success()
+            return out
         except Exception:
-            self._storage_breaker.record_failure()
+            call.failure()
             raise
-        self._storage_breaker.record_success()
-        return out
+        finally:
+            # releases a half-open probe grant if the call was abandoned
+            # (e.g. a BaseException); no-op after success()/failure()
+            call.cancel()
 
     def _validate_one(self, d: Any, app_id: int, channel_id, whitelist,
                       tr=None):
@@ -410,12 +442,10 @@ class EventServerService:
         return event_id
 
     def create_event(self, req: Request):
-        app_id, channel_id, whitelist = self._auth(req)
+        adm, app_id, channel_id, whitelist = self._admit_then_auth(req)
         t0 = monotonic_s()
         error = True
-        adm = None
         try:
-            adm = self._qos_admit(req)
             with self.tracer.trace("event") as tr:
                 try:
                     event_id = self._ingest_one(
@@ -435,30 +465,33 @@ class EventServerService:
             self._request_cell.observe(dur_s)
 
     def batch_events(self, req: Request):
-        app_id, channel_id, whitelist = self._auth(req)
-        if not isinstance(req.body, list):
-            return 400, {"message": "batch body must be a JSON array"}
-        if len(req.body) > MAX_BATCH:
-            return 400, {
-                "message": f"batch size {len(req.body)} exceeds {MAX_BATCH}"
-            }
-        t0 = monotonic_s()
-        error = True
-        adm = None
+        adm, app_id, channel_id, whitelist = self._admit_then_auth(req)
         try:
-            adm = self._qos_admit(req)
-            with self.tracer.trace("batch", batchSize=len(req.body)) as tr:
-                out = self._batch_events(
-                    req, app_id, channel_id, whitelist, tr
-                )
-                error = False
-                return out
+            if not isinstance(req.body, list):
+                return 400, {"message": "batch body must be a JSON array"}
+            if len(req.body) > MAX_BATCH:
+                return 400, {
+                    "message":
+                        f"batch size {len(req.body)} exceeds {MAX_BATCH}"
+                }
+            t0 = monotonic_s()
+            error = True
+            try:
+                with self.tracer.trace(
+                    "batch", batchSize=len(req.body)
+                ) as tr:
+                    out = self._batch_events(
+                        req, app_id, channel_id, whitelist, tr
+                    )
+                    error = False
+                    return out
+            finally:
+                dur_s = monotonic_s() - t0
+                self.req_window.record(dur_s * 1e3, error)
+                self._request_cell.observe(dur_s)
         finally:
             if adm is not None:
                 adm.release()
-            dur_s = monotonic_s() - t0
-            self.req_window.record(dur_s * 1e3, error)
-            self._request_cell.observe(dur_s)
 
     def _batch_events(self, req, app_id, channel_id, whitelist, tr):
         # validate every item first (per-item status contract), then land
@@ -618,67 +651,73 @@ class EventServerService:
         }
 
     def webhook_json(self, req: Request):
-        app_id, channel_id, whitelist = self._auth(req)
-        connector = JSON_CONNECTORS.get(req.path_args[0])
-        if connector is None:
-            return 404, {"message": f"no JSON connector {req.path_args[0]!r}"}
-        if req.body is not None and not isinstance(req.body, dict):
-            return 400, {"message": "webhook payload must be a JSON object"}
-        t0 = monotonic_s()
-        error = True
-        adm = None
+        adm, app_id, channel_id, whitelist = self._admit_then_auth(req)
         try:
-            adm = self._qos_admit(req)
-            with self.tracer.trace("webhook") as tr:
-                try:
-                    d = connector.to_event_dict(req.body or {})
-                    event_id = self._ingest_one(
-                        d, app_id, channel_id, whitelist, tr
-                    )
-                except (ConnectorError, EventValidationError) as e:
-                    tr.mark_error()
-                    return 400, {"message": str(e)}
-                error = False
-                return 201, {"eventId": event_id}
+            connector = JSON_CONNECTORS.get(req.path_args[0])
+            if connector is None:
+                return 404, {
+                    "message": f"no JSON connector {req.path_args[0]!r}"
+                }
+            if req.body is not None and not isinstance(req.body, dict):
+                return 400, {
+                    "message": "webhook payload must be a JSON object"
+                }
+            t0 = monotonic_s()
+            error = True
+            try:
+                with self.tracer.trace("webhook") as tr:
+                    try:
+                        d = connector.to_event_dict(req.body or {})
+                        event_id = self._ingest_one(
+                            d, app_id, channel_id, whitelist, tr
+                        )
+                    except (ConnectorError, EventValidationError) as e:
+                        tr.mark_error()
+                        return 400, {"message": str(e)}
+                    error = False
+                    return 201, {"eventId": event_id}
+            finally:
+                dur_s = monotonic_s() - t0
+                self.req_window.record(dur_s * 1e3, error)
+                self._request_cell.observe(dur_s)
         finally:
             if adm is not None:
                 adm.release()
-            dur_s = monotonic_s() - t0
-            self.req_window.record(dur_s * 1e3, error)
-            self._request_cell.observe(dur_s)
 
     def webhook_form(self, req: Request):
-        app_id, channel_id, whitelist = self._auth(req)
-        connector = FORM_CONNECTORS.get(req.path_args[0])
-        if connector is None:
-            return 404, {"message": f"no form connector {req.path_args[0]!r}"}
-        form = parse_form(
-            req.raw_body.decode("utf-8", errors="replace")
-            if req.raw_body
-            else ""
-        )
-        t0 = monotonic_s()
-        error = True
-        adm = None
+        adm, app_id, channel_id, whitelist = self._admit_then_auth(req)
         try:
-            adm = self._qos_admit(req)
-            with self.tracer.trace("webhook") as tr:
-                try:
-                    d = connector.to_event_dict(form)
-                    event_id = self._ingest_one(
-                        d, app_id, channel_id, whitelist, tr
-                    )
-                except (ConnectorError, EventValidationError) as e:
-                    tr.mark_error()
-                    return 400, {"message": str(e)}
-                error = False
-                return 201, {"eventId": event_id}
+            connector = FORM_CONNECTORS.get(req.path_args[0])
+            if connector is None:
+                return 404, {
+                    "message": f"no form connector {req.path_args[0]!r}"
+                }
+            form = parse_form(
+                req.raw_body.decode("utf-8", errors="replace")
+                if req.raw_body
+                else ""
+            )
+            t0 = monotonic_s()
+            error = True
+            try:
+                with self.tracer.trace("webhook") as tr:
+                    try:
+                        d = connector.to_event_dict(form)
+                        event_id = self._ingest_one(
+                            d, app_id, channel_id, whitelist, tr
+                        )
+                    except (ConnectorError, EventValidationError) as e:
+                        tr.mark_error()
+                        return 400, {"message": str(e)}
+                    error = False
+                    return 201, {"eventId": event_id}
+            finally:
+                dur_s = monotonic_s() - t0
+                self.req_window.record(dur_s * 1e3, error)
+                self._request_cell.observe(dur_s)
         finally:
             if adm is not None:
                 adm.release()
-            dur_s = monotonic_s() - t0
-            self.req_window.record(dur_s * 1e3, error)
-            self._request_cell.observe(dur_s)
 
 
 def create_event_server(

@@ -23,7 +23,6 @@ import datetime as _dt
 import logging
 import os
 import threading
-import time
 import uuid
 from typing import Any, List, Optional, Tuple
 
@@ -111,6 +110,12 @@ class _MicroBatcher:
     """
 
     MAX_BATCH = 512
+    #: dispatch this far BEFORE the tightest queued deadline: waking at
+    #: the exact expiry instant would shed the very member the deadline
+    #: bound exists to protect (cond.wait also overshoots under load).
+    #: A member whose remaining budget is already under the slack
+    #: dispatches immediately instead of waiting out the window.
+    DEADLINE_SLACK_S = 0.05
     #: probe sample size per regime before the permanent mode decision.
     #: Only the chronologically LAST half of each window is compared —
     #: the first batches of a fresh deploy pay one-off XLA bucket
@@ -258,21 +263,29 @@ class _MicroBatcher:
             # collection window: let concurrent request threads pile on —
             # but don't idle when a full batch is already waiting, and
             # never wait past the tightest queued deadline (the batch
-            # honors its most impatient member)
+            # honors its most impatient member). Waiting happens on the
+            # condition variable, NOT a blind sleep: every enqueue
+            # notifies, so a member arriving mid-window with a TIGHTER
+            # deadline re-shortens the wait instead of expiring in queue
+            # behind a window computed before it existed.
             if self._window_s > 0:
+                window_end = monotonic_s() + self._window_s
                 with self._cv:
-                    full = len(self._queue) >= self.MAX_BATCH
-                    tightest = min(
-                        (p[6].remaining_s() for p in self._queue
-                         if p[6] is not None),
-                        default=None,
-                    )
-                if not full:
-                    sleep_s = self._window_s
-                    if tightest is not None:
-                        sleep_s = min(sleep_s, max(tightest, 0.0))
-                    if sleep_s > 0:
-                        time.sleep(sleep_s)
+                    while not self._stopped \
+                            and len(self._queue) < self.MAX_BATCH:
+                        wait_s = window_end - monotonic_s()
+                        tightest = min(
+                            (p[6].remaining_s() for p in self._queue
+                             if p[6] is not None),
+                            default=None,
+                        )
+                        if tightest is not None:
+                            wait_s = min(
+                                wait_s, tightest - self.DEADLINE_SLACK_S
+                            )
+                        if wait_s <= 0:
+                            break
+                        self._cv.wait(wait_s)
             with self._cv:
                 batch = self._queue[: self.MAX_BATCH]
                 del self._queue[: len(batch)]
@@ -655,6 +668,7 @@ class QueryServerService:
         eng = self.variant.engine_id
         adm = None
         deadline = None
+        bcall = None
         try:
             if self.qos is not None:
                 # deadline clock starts at receipt; a malformed header is
@@ -679,9 +693,9 @@ class QueryServerService:
                     error = False
                     return out
                 if self._scorer_breaker is not None:
-                    allowed, retry = self._scorer_breaker.allow()
-                    if not allowed:
-                        out = self._shed(req, "breaker", retry)
+                    bcall = self._scorer_breaker.acquire()
+                    if not bcall.allowed:
+                        out = self._shed(req, "breaker", bcall.retry_after_s)
                         error = False
                         return out
             with self.tracer.trace("query") as tr:
@@ -722,11 +736,11 @@ class QueryServerService:
                 except HTTPError:
                     raise
                 except Exception:
-                    if self._scorer_breaker is not None:
-                        self._scorer_breaker.record_failure()
+                    if bcall is not None:
+                        bcall.failure()
                     raise
-                if self._scorer_breaker is not None:
-                    self._scorer_breaker.record_success()
+                if bcall is not None:
+                    bcall.success()
                 with tr.span("serialize"):
                     out = _to_jsonable(result)
                     for blocker in QUERY_BLOCKERS:
@@ -760,6 +774,13 @@ class QueryServerService:
                 )
                 return 200, out
         finally:
+            if bcall is not None:
+                # exits that never reached the scorer (parse 400,
+                # deadline shed, undeployed 503) must still release a
+                # half-open probe grant or the breaker wedges in
+                # HALF_OPEN with all grants leaked; no-op after
+                # success()/failure()
+                bcall.cancel()
             if adm is not None:
                 adm.release()
             dur_s = monotonic_s() - t0

@@ -7,6 +7,7 @@ stays up and every rejection lands in ``pio_tpu_qos_shed_total``."""
 
 import datetime as dt
 import json
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -223,6 +224,36 @@ class TestConcurrencyLimiter:
         assert lim.enter(timeout_s=0.0) == ConcurrencyLimiter.TIMEOUT
         lim.exit()
 
+    def test_freed_slot_reaches_later_waiter_after_peer_timeout(self):
+        """A deadline waiter that gives up must not strand capacity: a
+        freed slot has to reach the remaining queued waiter promptly.
+        The survivor waits on its full 30s deadline — there is no poll
+        tick to paper over a dropped notify, so a lost wakeup here
+        hangs the join."""
+        lim = ConcurrencyLimiter(max_inflight=1, max_queue=2)
+        assert lim.enter() == ConcurrencyLimiter.OK
+        out = {}
+
+        def waiter(name, timeout_s):
+            out[name] = lim.enter(timeout_s=timeout_s)
+
+        ta = threading.Thread(target=waiter, args=("a", 0.05))
+        tb = threading.Thread(target=waiter, args=("b", 30.0))
+        ta.start()
+        deadline = time.time() + 5.0
+        while lim.queued < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        tb.start()
+        while lim.queued < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        ta.join(5.0)
+        assert out.get("a") == ConcurrencyLimiter.TIMEOUT
+        lim.exit()  # the freed slot must wake b, not vanish
+        tb.join(5.0)
+        assert not tb.is_alive(), "freed slot never reached waiter b"
+        assert out.get("b") == ConcurrencyLimiter.OK
+        lim.exit()
+
 
 # -- circuit breaker ---------------------------------------------------------
 
@@ -270,6 +301,78 @@ class TestCircuitBreaker:
         for failed in (True, False, True, False, True, False):
             br.record_failure() if failed else br.record_success()
         assert br.state == "closed"
+
+    def test_abandoned_probe_grants_do_not_wedge_half_open(self):
+        """Exits that never reach the dependency (parse 400s, deadline
+        sheds) release their probe grant via cancel(): the breaker must
+        not get stuck HALF_OPEN with every grant leaked and no call ever
+        able to record an outcome."""
+        clock = FakeClock()
+        br = CircuitBreaker(failure_rate=0.5, window=2, cooldown_s=1.0,
+                            probes=2, clock=clock)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "open"
+        clock.advance(1.0)
+        assert br.state == "half_open"
+        # burn through more abandoned calls than there are probe grants
+        for _ in range(5):
+            call = br.acquire()
+            assert call.allowed, "cancel() must hand the grant back"
+            call.cancel()
+            call.cancel()  # idempotent
+        # real probes still get grants and can close the breaker
+        c1, c2 = br.acquire(), br.acquire()
+        assert c1.allowed and c2.allowed
+        c1.success()
+        c2.success()
+        assert br.state == "closed"
+
+    def test_straggler_from_closed_epoch_cannot_close_half_open(self):
+        """A call admitted while CLOSED that finishes after the breaker
+        tripped and cooled down must not count as a half-open probe —
+        only calls that actually touched the recovered dependency may
+        close the breaker."""
+        clock = FakeClock()
+        br = CircuitBreaker(failure_rate=0.5, window=2, cooldown_s=1.0,
+                            probes=1, clock=clock)
+        straggler = br.acquire()  # granted while CLOSED
+        assert straggler.allowed
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "open"
+        clock.advance(1.0)
+        assert br.state == "half_open"
+        straggler.success()  # stale generation: ignored
+        assert br.state == "half_open"
+        probe = br.acquire()
+        assert probe.allowed, "straggler must not consume the probe grant"
+        probe.success()
+        assert br.state == "closed"
+
+    def test_stale_failure_cannot_reopen_half_open(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_rate=0.5, window=2, cooldown_s=1.0,
+                            probes=1, clock=clock)
+        straggler = br.acquire()
+        br.record_failure()
+        br.record_failure()
+        clock.advance(1.0)
+        assert br.state == "half_open"
+        straggler.failure()  # stale generation: must not restart cooldown
+        assert br.state == "half_open"
+
+    def test_refused_call_records_nothing(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_rate=0.5, window=2, cooldown_s=5.0,
+                            probes=1, clock=clock)
+        br.record_failure()
+        br.record_failure()
+        refused = br.acquire()
+        assert not refused.allowed and refused.retry_after_s > 0
+        refused.success()  # no-op: was never granted
+        refused.cancel()
+        assert br.state == "open"
 
 
 # -- deadlines & degradation -------------------------------------------------
@@ -478,35 +581,82 @@ class TestQueryServerOverload:
             self, app_id, monkeypatch):
         """A query whose X-Pio-Deadline-Ms budget elapses in the
         micro-batch queue is shed BEFORE model execution: 503, counted
-        as reason=deadline, and its user never appears in any batch."""
-        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_US", "200000")
+        as reason=deadline, and its user never appears in any batch.
+        The in-queue expiry is forced by wedging the batch worker inside
+        a slow dispatch — the deadline-bounded collection window alone
+        would dispatch the member BEFORE its budget ran out."""
+        import concurrent.futures
+
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_US", "50000")
         monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_ADAPTIVE", "0")
         server, service, url = _serve(app_id, qos="rps=1000")
         try:
             seen = []
             real = service._predict_batch
+            wedged = threading.Event()
 
             def spying(queries):
                 seen.extend(q.user for q in queries)
+                if not wedged.is_set():
+                    wedged.set()
+                    time.sleep(0.4)  # hold the worker past u2's budget
                 return real(queries)
 
             monkeypatch.setattr(service, "_predict_batch", spying)
-            # warm query (no deadline) proves the batch path works
-            status, body, _ = http(
-                "POST", f"{url}/queries.json", {"user": "u1", "num": 3}
-            )
-            assert status == 200 and "u1" in seen
-            # 20ms budget vs a 200ms collection window: expires in queue
-            status, body, headers = http(
-                "POST", f"{url}/queries.json", {"user": "u2", "num": 3},
-                headers={DEADLINE_HEADER: "20"},
-            )
+            with concurrent.futures.ThreadPoolExecutor(1) as ex:
+                fut = ex.submit(
+                    http, "POST", f"{url}/queries.json",
+                    {"user": "u1", "num": 3},
+                )
+                assert wedged.wait(10.0), "u1 never reached the worker"
+                # 100ms budget burns entirely behind the wedged worker
+                status, body, headers = http(
+                    "POST", f"{url}/queries.json",
+                    {"user": "u2", "num": 3},
+                    headers={DEADLINE_HEADER: "100"},
+                )
+                assert fut.result()[0] == 200  # the slow batch completes
+            assert "u1" in seen
             assert status == 503
             assert "deadline" in body["message"]
             assert int(headers["retry-after"]) >= 1
             assert "u2" not in seen, "expired query must not execute"
             snap = http("GET", f"{url}/qos.json")[1]
             assert snap["shed"]["deadline"] == 1
+        finally:
+            server.stop()
+
+    def test_tighter_deadline_arriving_mid_window_dispatches_early(
+            self, app_id, monkeypatch):
+        """A member enqueued DURING the collection window with a tight
+        deadline shortens the window: the batch dispatches before that
+        member expires instead of shedding it at a wakeup computed
+        before it arrived (which a 2s window would guarantee here)."""
+        import concurrent.futures
+
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_US", "2000000")
+        monkeypatch.setenv("PIO_TPU_SERVE_MICROBATCH_ADAPTIVE", "0")
+        server, service, url = _serve(app_id, qos="rps=1000")
+        try:
+            with concurrent.futures.ThreadPoolExecutor(2) as ex:
+                # u1 (no deadline) opens the 2s collection window
+                f1 = ex.submit(
+                    http, "POST", f"{url}/queries.json",
+                    {"user": "u1", "num": 3},
+                )
+                time.sleep(0.3)  # u2 arrives mid-window
+                f2 = ex.submit(
+                    http, "POST", f"{url}/queries.json",
+                    {"user": "u2", "num": 3},
+                    {DEADLINE_HEADER: "300"},
+                )
+                s2, b2, _ = f2.result()
+                s1, b1, _ = f1.result()
+            assert s2 == 200, "tight member must be served, not shed"
+            assert len(b2["itemScores"]) == 3
+            assert s1 == 200 and len(b1["itemScores"]) == 3
+            snap = http("GET", f"{url}/qos.json")[1]
+            assert snap["shed"]["deadline"] == 0
         finally:
             server.stop()
 
@@ -678,6 +828,54 @@ class TestEventServerQoS:
             assert snap["scope"] == "eventserver"
             assert snap["shed"]["key_rate_limit"] == 1
             assert snap["keyBuckets"]["keys"] == 2
+        finally:
+            server.stop()
+
+    def test_shed_runs_before_auth_key_lookup(self, monkeypatch):
+        """The rate limiter protects the storage-backed access-key
+        lookup it used to sit behind: a shed request — even a flood of
+        unique keys that the positive auth cache can never absorb — is
+        rejected 429 before any metadata read happens."""
+        lookups = []
+        real_store = Storage.get_meta_data_access_keys()
+
+        class CountingStore:
+            def get(self, key):
+                lookups.append(key)
+                return real_store.get(key)
+
+            def __getattr__(self, name):
+                return getattr(real_store, name)
+
+        monkeypatch.setattr(
+            Storage, "get_meta_data_access_keys",
+            classmethod(lambda cls: CountingStore()),
+        )
+        server = create_event_server(
+            host="127.0.0.1", port=0, qos="rps=0.05,burst=1"
+        ).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            ev = {"event": "buy", "entityType": "user", "entityId": "u1",
+                  "eventTime": "2026-03-01T10:00:00Z"}
+            # first request drains the burst: admitted, auth does its
+            # (failing) lookup for the bogus key
+            status, _, _ = http(
+                "POST", f"{url}/events.json?accessKey=nope-1", ev
+            )
+            assert status == 401
+            assert lookups == ["nope-1"]
+            # the rest of the unique-key flood is shed with NO further
+            # metadata reads (misses are never cached, so pre-auth
+            # admission is the only thing standing in front of storage)
+            for i in range(2, 5):
+                status, body, headers = http(
+                    "POST", f"{url}/events.json?accessKey=nope-{i}", ev
+                )
+                assert status == 429
+                assert int(headers["retry-after"]) >= 1
+                assert "overloaded" in body["message"]
+            assert lookups == ["nope-1"]
         finally:
             server.stop()
 
