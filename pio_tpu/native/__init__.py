@@ -124,7 +124,8 @@ def event_log_lib():
             return _cache["event_log"]
         lib = ctypes.CDLL(build_library("event_log"))
         lib.pel_append.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int,  # do_sync (durability knob)
         ]
         lib.pel_append.restype = ctypes.c_int
         lib.pel_scan.argtypes = [

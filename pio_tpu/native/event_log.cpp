@@ -9,9 +9,9 @@
 // Python. Exposed via a C ABI consumed with ctypes
 // (pio_tpu/native/__init__.py builds this file with g++ on first use).
 //
-// Record layout (little-endian), file = 8-byte magic "PEL1\0\0\0\0" then
+// Record layout (little-endian), file = 8-byte magic "PEL2\0\0\0\0" then
 // records:
-//   u32  payload_len                  (bytes after this field)
+//   u32  payload_len                  (bytes after this field, before crc)
 //   u8   flags                        (bit0 = tombstone: event_id names the
 //                                      record to delete)
 //   i64  event_time_us
@@ -20,6 +20,13 @@
 //                target_entity_type, target_entity_id, pr_id, tags_json
 //   u32  len_props_json
 //   bytes: the 9 strings concatenated (utf-8)
+//   u32  crc32 of the payload (zlib polynomial; v2 only)
+//
+// v1 files ("PEL1" magic, no per-record crc) remain readable; pel_repair
+// upgrades them in place (atomic rewrite) before any v2-framed append.
+// The crc turns "plausible-length garbage at the tail" — a torn write the
+// length check alone can't see — into a detected torn tail, and garbage
+// anywhere else into detected corruption instead of silently-wrong scans.
 
 #include <unistd.h>
 
@@ -35,9 +42,35 @@
 
 namespace {
 
-constexpr char kMagic[8] = {'P', 'E', 'L', '1', 0, 0, 0, 0};
+constexpr char kMagicV1[8] = {'P', 'E', 'L', '1', 0, 0, 0, 0};
+constexpr char kMagicV2[8] = {'P', 'E', 'L', '2', 0, 0, 0, 0};
 constexpr int kNumStr = 9;  // 8 u16-length strings + props (u32 length)
 constexpr size_t kHeaderFixed = 1 + 8 + 8 + 8 * 2 + 4;
+
+// zlib-compatible CRC-32 (poly 0xEDB88320), so Python's zlib.crc32 frames
+// records the scanner verifies without linking -lz into the .so.
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+
+uint32_t crc32_feed(uint32_t crc, const char* data, size_t len) {
+  static const Crc32Table tbl;
+  for (size_t i = 0; i < len; ++i)
+    crc = tbl.t[(crc ^ static_cast<uint8_t>(data[i])) & 0xFFu] ^ (crc >> 8);
+  return crc;
+}
+
+uint32_t crc32_of(const char* data, size_t len) {
+  return crc32_feed(0xFFFFFFFFu, data, len) ^ 0xFFFFFFFFu;
+}
 
 struct Rec {
   uint8_t flags;
@@ -72,25 +105,44 @@ void collect_live(const std::vector<Rec>& recs,
 // Parses whole records. A *torn tail* — a trailing partial record left by a
 // crash mid-append (the bytes are a prefix of one framed record) — is NOT
 // corruption: parsing stops there and *valid_end marks the end of the last
-// whole record, so committed data stays readable. Only mid-record
+// whole record, so committed data stays readable. A v2 record whose crc
+// mismatches is a torn tail IF it is the final record (in-place garbage
+// from a failed write), and corruption otherwise. Only mid-record
 // inconsistencies (bad magic, lengths that disagree within fully-present
-// bytes) return false.
+// bytes, a mid-file crc mismatch) return false.
 // out may be null (framing/validation walk only — no Rec materialization;
 // pel_repair uses this to find valid_end without O(records) memory).
+// version_out (may be null) reports the file format: 1, or 2 (also for
+// empty/absent files, which pel_append will create as v2).
 bool parse_records(const std::vector<char>& buf, std::vector<Rec>* out,
-                   size_t* valid_end) {
+                   size_t* valid_end, int* version_out = nullptr) {
   *valid_end = 0;
-  if (buf.size() < sizeof(kMagic)) return true;  // empty or torn magic
-  if (std::memcmp(buf.data(), kMagic, sizeof(kMagic)) != 0) return false;
-  size_t pos = sizeof(kMagic);
+  int version = 2;
+  if (buf.size() >= 8) {
+    if (std::memcmp(buf.data(), kMagicV2, 8) == 0)
+      version = 2;
+    else if (std::memcmp(buf.data(), kMagicV1, 8) == 0)
+      version = 1;
+    else
+      return false;
+  }
+  if (version_out) *version_out = version;
+  if (buf.size() < 8) return true;  // empty or torn magic
+  const size_t trailer = version == 2 ? 4 : 0;  // per-record crc32
+  size_t pos = 8;
   *valid_end = pos;
   int64_t seq = 0;
   while (pos + 4 <= buf.size()) {
     uint32_t plen = read_le<uint32_t>(buf.data() + pos);
     if (plen < kHeaderFixed) return false;
-    if (pos + 4 + plen > buf.size()) return true;  // torn tail
-    pos += 4;
-    const char* p = buf.data() + pos;
+    if (pos + 4 + plen + trailer > buf.size()) return true;  // torn tail
+    const char* p = buf.data() + pos + 4;
+    if (version == 2) {
+      uint32_t want = read_le<uint32_t>(p + plen);
+      if (crc32_of(p, plen) != want)
+        // garbled final record = torn tail (truncate); earlier = corrupt
+        return pos + 4 + plen + trailer == buf.size();
+    }
     Rec r;
     r.flags = static_cast<uint8_t>(*p);
     r.time_us = read_le<int64_t>(p + 1);
@@ -113,10 +165,45 @@ bool parse_records(const std::vector<char>& buf, std::vector<Rec>* out,
     }
     r.seq = seq++;
     if (out) out->push_back(r);
-    pos += plen;
+    pos += 4 + plen + trailer;
     *valid_end = pos;
   }
   return true;
+}
+
+// Writes magic + the given records re-framed as v2 (crc per record).
+// Shared by pel_compact and pel_repair's v1 → v2 upgrade.
+bool write_records_v2(FILE* f, const std::vector<const Rec*>& recs) {
+  bool ok = std::fwrite(kMagicV2, 1, 8, f) == 8;
+  for (const Rec* r : recs) {
+    if (!ok) break;
+    uint64_t payload = kHeaderFixed;
+    for (int c = 0; c < kNumStr; ++c) payload += r->len[c];
+    uint32_t plen = static_cast<uint32_t>(payload);
+    char head[4 + kHeaderFixed];
+    std::memcpy(head, &plen, 4);
+    char* p = head + 4;
+    p[0] = static_cast<char>(r->flags);
+    std::memcpy(p + 1, &r->time_us, 8);
+    std::memcpy(p + 9, &r->ctime_us, 8);
+    size_t off = 17;
+    for (int c = 0; c < kNumStr - 1; ++c) {
+      uint16_t l16 = static_cast<uint16_t>(r->len[c]);
+      std::memcpy(p + off, &l16, 2);
+      off += 2;
+    }
+    std::memcpy(p + off, &r->len[kNumStr - 1], 4);
+    uint32_t crc = crc32_feed(0xFFFFFFFFu, head + 4, kHeaderFixed);
+    ok = std::fwrite(head, 1, sizeof(head), f) == sizeof(head);
+    for (int c = 0; ok && c < kNumStr; ++c)
+      if (r->len[c]) {
+        ok = std::fwrite(r->str[c], 1, r->len[c], f) == r->len[c];
+        crc = crc32_feed(crc, r->str[c], r->len[c]);
+      }
+    crc ^= 0xFFFFFFFFu;
+    ok = ok && std::fwrite(&crc, 1, 4, f) == 4;
+  }
+  return ok;
 }
 
 bool read_file(const char* path, std::vector<char>* buf) {
@@ -162,14 +249,18 @@ typedef struct {
 
 void pel_free_result(PelResult* r);
 
-// Appends pre-encoded record bytes (Python frames them); creates the file
-// with magic if needed. Returns 0 on success.
-int pel_append(const char* path, const uint8_t* data, int64_t len) {
+// Appends pre-encoded record bytes (Python frames them, v2 with crc);
+// creates the file with magic if needed. do_sync != 0 → fsync before
+// close (the durability knob: "commit" always, "batch" on its interval).
+// Returns 0 on success.
+int pel_append(const char* path, const uint8_t* data, int64_t len,
+               int do_sync) {
   FILE* f = std::fopen(path, "ab");
   if (!f) return -1;
   std::fseek(f, 0, SEEK_END);
   if (std::ftell(f) == 0) {
-    if (std::fwrite(kMagic, 1, sizeof(kMagic), f) != sizeof(kMagic)) {
+    if (std::fwrite(kMagicV2, 1, sizeof(kMagicV2), f) !=
+        sizeof(kMagicV2)) {
       std::fclose(f);
       return -1;
     }
@@ -179,8 +270,11 @@ int pel_append(const char* path, const uint8_t* data, int64_t len) {
   // report full length while the actual write (ENOSPC, EIO) fails at
   // flush — returning 0 then would claim persistence that never happened
   bool flushed = std::fflush(f) == 0;
+  bool synced = !do_sync || (flushed && fsync(fileno(f)) == 0);
   bool closed = std::fclose(f) == 0;
-  return (wrote == static_cast<size_t>(len) && flushed && closed) ? 0 : -1;
+  return (wrote == static_cast<size_t>(len) && flushed && synced && closed)
+             ? 0
+             : -1;
 }
 
 // Filtered scan. Empty-string filters mean "any"; event_names is a packed
@@ -322,17 +416,42 @@ int64_t pel_count(const char* path) {
 }
 
 // Truncates a torn tail (partial record left by a crash mid-append) so
-// later appends don't land after unreachable bytes. Called by the Python
-// wrapper once per file before its first append in a process. Returns the
-// number of bytes dropped (0 = clean), -1 io error, -2 corrupt file,
-// -4 oom.
+// later appends don't land after unreachable bytes, and upgrades v1 files
+// to v2 (atomic rewrite adding per-record crcs) — appends are always
+// v2-framed, so a v1 file must be converted before its first append.
+// Called by the Python wrapper once per file before its first append in a
+// process. Returns the number of torn-tail bytes dropped (0 = clean),
+// -1 io error, -2 corrupt file, -4 oom.
 int64_t pel_repair(const char* path) {
   try {
     std::vector<char> buf;
     if (!read_file(path, &buf)) return -1;
     if (buf.empty()) return 0;
+    bool v1 = buf.size() >= 8 && std::memcmp(buf.data(), kMagicV1, 8) == 0;
+    std::vector<Rec> recs;
     size_t valid_end;
-    if (!parse_records(buf, nullptr, &valid_end)) return -2;
+    int version;
+    if (!parse_records(buf, v1 ? &recs : nullptr, &valid_end, &version))
+      return -2;
+    int64_t dropped = static_cast<int64_t>(buf.size() - valid_end);
+    if (version == 1) {
+      // keep EVERY parsed record (tombstones and shadowed writes too):
+      // repair restores framing invariants, compaction is a policy call
+      std::vector<const Rec*> all;
+      all.reserve(recs.size());
+      for (const Rec& r : recs) all.push_back(&r);
+      std::string tmp = std::string(path) + ".upgrade";
+      FILE* f = std::fopen(tmp.c_str(), "wb");
+      if (!f) return -1;
+      bool ok = write_records_v2(f, all);
+      ok = ok && std::fflush(f) == 0 && fsync(fileno(f)) == 0;
+      ok = (std::fclose(f) == 0) && ok;
+      if (!ok || std::rename(tmp.c_str(), path) != 0) {
+        std::remove(tmp.c_str());
+        return -1;
+      }
+      return dropped;
+    }
     if (valid_end == buf.size()) return 0;
     FILE* f = std::fopen(path, "rb+");
     if (!f) return -1;
@@ -341,7 +460,7 @@ int64_t pel_repair(const char* path) {
                  ? 0
                  : -1;
     std::fclose(f);
-    return rc == 0 ? static_cast<int64_t>(buf.size() - valid_end) : -1;
+    return rc == 0 ? dropped : -1;
   } catch (...) {
     return -4;
   }
@@ -363,11 +482,11 @@ int64_t pel_compact(const char* path) {
 
     std::vector<const Rec*> live;
     collect_live(recs, &live);
-    int64_t live_bytes = sizeof(kMagic);
+    int64_t live_bytes = 8;  // magic
     for (const Rec* r : live) {
       uint64_t payload = kHeaderFixed;
       for (int c = 0; c < kNumStr; ++c) payload += r->len[c];
-      live_bytes += 4 + static_cast<int64_t>(payload);
+      live_bytes += 4 + static_cast<int64_t>(payload) + 4;  // len + crc
     }
     int64_t reclaimed = static_cast<int64_t>(buf.size()) - live_bytes;
     if (reclaimed <= 0) return 0;
@@ -375,30 +494,7 @@ int64_t pel_compact(const char* path) {
     std::string tmp = std::string(path) + ".compact";
     FILE* f = std::fopen(tmp.c_str(), "wb");
     if (!f) return -1;
-    bool ok = std::fwrite(kMagic, 1, sizeof(kMagic), f) == sizeof(kMagic);
-    for (const Rec* r : live) {
-      if (!ok) break;
-      uint64_t payload = kHeaderFixed;
-      for (int c = 0; c < kNumStr; ++c) payload += r->len[c];
-      uint32_t plen = static_cast<uint32_t>(payload);
-      char head[4 + kHeaderFixed];
-      std::memcpy(head, &plen, 4);
-      char* p = head + 4;
-      p[0] = static_cast<char>(r->flags);
-      std::memcpy(p + 1, &r->time_us, 8);
-      std::memcpy(p + 9, &r->ctime_us, 8);
-      size_t off = 17;
-      for (int c = 0; c < kNumStr - 1; ++c) {
-        uint16_t l16 = static_cast<uint16_t>(r->len[c]);
-        std::memcpy(p + off, &l16, 2);
-        off += 2;
-      }
-      std::memcpy(p + off, &r->len[kNumStr - 1], 4);
-      ok = std::fwrite(head, 1, sizeof(head), f) == sizeof(head);
-      for (int c = 0; ok && c < kNumStr; ++c)
-        if (r->len[c])
-          ok = std::fwrite(r->str[c], 1, r->len[c], f) == r->len[c];
-    }
+    bool ok = write_records_v2(f, live);
     // fsync BEFORE the rename: fflush only reaches the page cache, and a
     // rename-then-crash could otherwise leave a truncated file where the
     // intact original used to be (append-path fflush bounds loss to one

@@ -157,6 +157,9 @@ class EventServerService:
         self.stats = _Stats(counter=self._events_counter)
         slog.install()
         self.obs.add_collector(slog.exposition_lines)
+        from pio_tpu import faults as _faults
+
+        self.obs.add_collector(_faults.exposition_lines)
         # -- health probes (ISSUE 2) --
         self.health = HealthMonitor()
         self.health.add_liveness("group_commit", self._check_group_commit)
@@ -205,6 +208,7 @@ class EventServerService:
         r.add("GET", "/logs\\.json", self.get_logs)
         r.add("GET", "/slo\\.json", self.get_slo)
         r.add("GET", "/qos\\.json", self.get_qos)
+        r.add("GET", "/faults\\.json", self.get_faults)
         r.add("GET", "/healthz", self.healthz)
         r.add("GET", "/readyz", self.readyz)
         r.add("POST", "/webhooks/([^/]+)\\.json", self.webhook_json)
@@ -326,6 +330,12 @@ class EventServerService:
             return 200, {"enabled": False}
         return 200, self.qos.snapshot()
 
+    def get_faults(self, req: Request):
+        """Armed failpoints + trigger counts (pio_tpu.faults)."""
+        from pio_tpu import faults
+
+        return 200, faults.snapshot()
+
     def _qos_admit(self, req: Request):
         """Admission for the write paths: engine bucket, THEN the
         caller's per-access-key bucket — one chatty key exhausts its own
@@ -378,11 +388,17 @@ class EventServerService:
         return adm, app_id, channel_id, whitelist
 
     def _guarded_insert(self, fn):
-        """Run a storage write through the circuit breaker: an open
+        """Run a storage write through retry + circuit breaker: an open
         breaker fails fast with 503 + Retry-After instead of queueing
-        more work onto a dependency that is already drowning."""
+        more work onto a dependency that is already drowning, and INSIDE
+        a breaker call transient errors (SQLITE_BUSY, a blob server
+        mid-restart, injected faults) are retried with jittered backoff —
+        the breaker scores the final outcome, so a request saved by a
+        retry counts as a success, not ``attempts`` failures."""
+        from pio_tpu.storage.retry import retrying
+
         if self._storage_breaker is None:
-            return fn()
+            return retrying(fn, site="eventserver.insert")
         call = self._storage_breaker.acquire()
         if not call.allowed:
             self.qos.count_shed("breaker")
@@ -391,7 +407,7 @@ class EventServerService:
                 headers=retry_after_header(call.retry_after_s),
             )
         try:
-            out = fn()
+            out = retrying(fn, site="eventserver.insert")
             call.success()
             return out
         except Exception:

@@ -29,6 +29,7 @@ from typing import Any, List, Optional, Tuple
 from pio_tpu.controller.engine import Engine, EngineParams
 from pio_tpu.controller.params import ParamsError, params_from_dict
 from pio_tpu.data.event import Event
+from pio_tpu.faults import failpoint
 from pio_tpu.obs import (
     Heartbeat, HealthMonitor, MetricsRegistry, RequestWindow, Tracer,
     monotonic_s,
@@ -386,6 +387,9 @@ class QueryServerService:
         # CLI switches console rendering) + log-volume counter re-export
         slog.install()
         self.obs.add_collector(slog.exposition_lines)
+        from pio_tpu import faults as _faults
+
+        self.obs.add_collector(_faults.exposition_lines)
         # -- health probes (ISSUE 2) --
         self.heartbeat = Heartbeat(max_age_s=float(
             os.environ.get("PIO_TPU_HEARTBEAT_MAX_AGE_S", "30")
@@ -455,6 +459,7 @@ class QueryServerService:
         r.add("GET", "/logs\\.json", self.get_logs)
         r.add("GET", "/slo\\.json", self.get_slo)
         r.add("GET", "/qos\\.json", self.get_qos)
+        r.add("GET", "/faults\\.json", self.get_faults)
         r.add("GET", "/healthz", self.healthz)
         r.add("GET", "/readyz", self.readyz)
         r.add("POST", "/reload", self.reload)
@@ -466,7 +471,8 @@ class QueryServerService:
         engine, engine_params = build_engine(self.variant)
         instance_id = resolve_instance_id(self.variant, instance_id)
         models = load_models_for_instance(
-            instance_id, engine, engine_params, self.ctx
+            instance_id, engine, engine_params, self.ctx,
+            variant=self.variant,
         )
         pairs = engine.algorithms_with_models(engine_params, models)
         serving = engine.make_serving(engine_params)
@@ -565,6 +571,12 @@ class QueryServerService:
         if self.qos is None:
             return 200, {"enabled": False}
         return 200, self.qos.snapshot()
+
+    def get_faults(self, req: Request):
+        """Armed failpoints + trigger counts (pio_tpu.faults)."""
+        from pio_tpu import faults
+
+        return 200, faults.snapshot()
 
     def _shed(self, req: Request, reason: str, retry_after_s: float):
         """Turn a shed decision into a response: a stale-cache hit (when
@@ -811,6 +823,7 @@ class QueryServerService:
 
     def _predict_one(self, query):
         """Per-query predict + serve from one consistent snapshot."""
+        failpoint("scorer.dispatch")
         with self._swap_lock:
             pairs, serving = self.pairs, self.serving
         with self.profile_hook.capture():
@@ -820,6 +833,7 @@ class QueryServerService:
     def _predict_batch(self, queries: list):
         """One ``batch_predict`` dispatch per algorithm over the whole
         micro-batch, then per-query serving combine (micro-batcher path)."""
+        failpoint("scorer.dispatch")
         with self._swap_lock:
             pairs, serving = self.pairs, self.serving
         per_algo = []

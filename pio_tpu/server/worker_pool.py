@@ -55,6 +55,16 @@ log = logging.getLogger("pio_tpu.workerpool")
 #: processes on it
 _MAX_RESPAWNS = 3
 
+#: exponential respawn backoff: death N waits base * 2^(N-1), capped — a
+#: worker crash-looping on startup (bad model file, import error) must
+#: not hot-spin the supervisor through its whole budget in milliseconds
+_RESPAWN_BACKOFF_BASE_S = 0.5
+_RESPAWN_BACKOFF_CAP_S = 30.0
+
+#: a worker that served this long before dying was not crash-looping:
+#: reset its respawn count (and thus its backoff) on death
+_RESPAWN_RESET_AFTER_S = 60.0
+
 #: consecutive /healthz failures before the supervisor kills a worker —
 #: one failed poll is a blip (GC pause, slow scrape); K in a row on a
 #: 1 s-timeout probe is a wedge
@@ -129,7 +139,12 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt,
         # microseconds, shrinking the corruption window to ~nothing.
         # Each iteration beats the heartbeat: a wedged loop ages it out
         # and the supervisor's /healthz poll turns 503.
+        from pio_tpu.faults import failpoint
+
         while not shutdown_evt.is_set():
+            # chaos hook: `worker.serve=crash:once` kills this worker
+            # mid-serve to exercise the supervisor's respawn/backoff path
+            failpoint("worker.serve")
             service.heartbeat.beat()
             time.sleep(0.25)
     except KeyboardInterrupt:
@@ -201,6 +216,14 @@ class ServingPool:
         self.n_workers = n_workers
         self._procs: list = []
         self._respawns = [0] * n_workers
+        #: monotonic deadline before which worker i must NOT be respawned
+        #: (0.0 = no respawn scheduled); gives crash-looping workers an
+        #: exponentially growing cool-down instead of a hot spawn loop
+        self._respawn_due = [0.0] * n_workers
+        self._spawned_at = [0.0] * n_workers
+        #: why the supervisor last killed worker i ("unhealthy" when the
+        #: health sweep shot it; None → the process died on its own)
+        self._kill_reason: list = [None] * n_workers
         #: sidecar health ports, published by each worker once its
         #: loopback health server is up (0 = not yet / unavailable)
         self._health_ports = self._ctx.Array("i", [0] * n_workers)
@@ -213,6 +236,13 @@ class ServingPool:
             "Supervisor view of each pool worker "
             "(1 healthy, 0 unhealthy, -1 dead)",
             ("worker",),
+        )
+        self._respawn_counter = REGISTRY.counter(
+            "pio_tpu_worker_respawn_total",
+            "Pool workers respawned by the supervisor, by cause "
+            "(crash = process died on its own, unhealthy = killed "
+            "after failing /healthz probes)",
+            ("reason",),
         )
         # cross-worker metrics: the supervisor owns a fixed-layout
         # shared-memory segment; every worker mmaps its own stripe, so a
@@ -240,6 +270,7 @@ class ServingPool:
     def _spawn(self, idx: int):
         self._health_ports[idx] = 0  # stale port from a previous life
         self._health_fails[idx] = 0
+        self._spawned_at[idx] = time.monotonic()
         p = self._ctx.Process(
             target=_worker_main,
             args=(
@@ -338,6 +369,7 @@ class ServingPool:
                     "worker %d unhealthy %d polls in a row; killing for "
                     "respawn", i, self._health_fails[i],
                 )
+                self._kill_reason[i] = "unhealthy"
                 p.kill()
                 p.join(timeout=2.0)
 
@@ -352,9 +384,27 @@ class ServingPool:
             if time.monotonic() >= next_health:
                 next_health = time.monotonic() + health_poll_s
                 self._health_sweep()
+            now = time.monotonic()
             for i, p in enumerate(self._procs):
                 if p.is_alive() or self._shutdown.is_set():
                     continue
+                if self._respawn_due[i] > 0.0:
+                    # phase 2: a respawn is scheduled — spawn once the
+                    # backoff cool-down has elapsed
+                    if now >= self._respawn_due[i]:
+                        self._respawn_due[i] = 0.0
+                        self._procs[i] = self._spawn(i)
+                    continue
+                # phase 1: first observation of this death — account for
+                # it and schedule the (possibly delayed) respawn
+                if (
+                    self._spawned_at[i] > 0.0
+                    and now - self._spawned_at[i] >= _RESPAWN_RESET_AFTER_S
+                ):
+                    # long-lived worker: this death is not a crash loop
+                    self._respawns[i] = 0
+                reason = self._kill_reason[i] or "crash"
+                self._kill_reason[i] = None
                 if self._respawns[i] >= _MAX_RESPAWNS:
                     log.error(
                         "worker %d died %d times; not respawning",
@@ -362,14 +412,23 @@ class ServingPool:
                     )
                     continue
                 self._respawns[i] += 1
-                log.warning(
-                    "worker %d exited (code %s); respawning (%d/%d)",
-                    i, p.exitcode, self._respawns[i], _MAX_RESPAWNS,
+                self._respawn_counter.inc(reason=reason)
+                delay = min(
+                    _RESPAWN_BACKOFF_CAP_S,
+                    _RESPAWN_BACKOFF_BASE_S * 2 ** (self._respawns[i] - 1),
                 )
-                self._procs[i] = self._spawn(i)
+                self._respawn_due[i] = now + delay
+                log.warning(
+                    "worker %d exited (code %s, reason %s); respawning "
+                    "in %.1fs (%d/%d)",
+                    i, p.exitcode, reason, delay,
+                    self._respawns[i], _MAX_RESPAWNS,
+                )
             if all(
                 not p.is_alive() for p in self._procs
-            ) and all(r >= _MAX_RESPAWNS for r in self._respawns):
+            ) and all(
+                r >= _MAX_RESPAWNS for r in self._respawns
+            ) and not any(d > 0.0 for d in self._respawn_due):
                 log.error("all workers dead and out of respawn budget")
                 break
             # plain sleep, not Event.wait(): nobody ever registers as a

@@ -38,7 +38,9 @@ import tempfile
 from typing import Callable, Dict, List, Optional
 from urllib.parse import quote, urlparse
 
+from pio_tpu.faults import failpoint
 from pio_tpu.storage import base
+from pio_tpu.storage.durability import fsync_fileobj, replace_durable
 from pio_tpu.storage.records import Model
 
 #: reserved suffix for in-flight atomic-write staging files; list() hides
@@ -103,7 +105,11 @@ class FileBlobBackend(BlobBackend):
                 while chunk := src.read(chunk_size):
                     f.write(chunk)
                     n += len(chunk)
-            os.replace(tmp, p)
+                # durable rename (durability knob): bytes on disk before
+                # the rename publishes them, dir entry fsynced after
+                fsync_fileobj(f)
+            failpoint("storage.blobstore.persist")
+            replace_durable(tmp, p)
         except BaseException:
             try:
                 os.unlink(tmp)

@@ -21,13 +21,16 @@ import os
 import struct
 import threading
 import uuid
+import zlib
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from pio_tpu.data.datamap import DataMap
 from pio_tpu.data.event import Event
+from pio_tpu.faults import failpoint
 from pio_tpu.storage import base
+from pio_tpu.storage.durability import IntervalSyncer
 from pio_tpu.storage.frame import EventFrame
 from pio_tpu.utils.timeutil import from_micros as _from_us
 from pio_tpu.utils.timeutil import to_micros
@@ -91,7 +94,14 @@ def _encode_record(
         len(strings[8]),
     )
     payload = header + b"".join(strings)
-    return struct.pack("<I", len(payload)) + payload
+    # PEL2 framing: length-prefix + payload + crc32 trailer. The crc is
+    # what lets the scanner tell "plausible-length garbage at the tail"
+    # (a torn write) from committed data — length checks alone can't.
+    return (
+        struct.pack("<I", len(payload))
+        + payload
+        + struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
+    )
 
 
 class EventLogEvents(base.LEvents, base.PEvents):
@@ -105,6 +115,7 @@ class EventLogEvents(base.LEvents, base.PEvents):
 
         self._lib = event_log_lib()
         self._repaired: set = set()  # paths torn-tail-checked this handle
+        self._syncer = IntervalSyncer()  # durability knob: when to fsync
         # instance is registry-cached per root, so this coalesces across
         # concurrent requests (see insert())
         self._gc = GroupCommitter(self._flush_appends, store="eventlog")
@@ -131,8 +142,26 @@ class EventLogEvents(base.LEvents, base.PEvents):
                         f"event-log repair failed for app {app_id} ({path})"
                     )
                 self._repaired.add(path)
-            rc = self._lib.pel_append(path.encode(), data, len(data))
-            if rc != 0:
+            torn = failpoint("eventlog.append.before_write", data)
+            if torn is not None:
+                # injected torn write: persist only a prefix of the framed
+                # bytes and fail — exactly the wound a crash mid-append
+                # leaves, which the crc + repair pass must heal on reopen
+                self._lib.pel_append(path.encode(), torn, len(torn), 0)
+                self._repaired.discard(path)
+                raise base.StorageError(
+                    f"event-log append failed for app {app_id} "
+                    "(injected torn write)"
+                )
+            sync = self._syncer.due(path)
+            rc = self._lib.pel_append(
+                path.encode(), data, len(data), 1 if sync else 0
+            )
+            if rc == 0:
+                if sync:
+                    self._syncer.mark(path)
+                failpoint("eventlog.append.after_write")
+            else:
                 # a partial fwrite may have left a torn tail: force a
                 # re-repair before the next append or later writes would
                 # land behind unreachable bytes
@@ -186,6 +215,7 @@ class EventLogEvents(base.LEvents, base.PEvents):
         log)."""
         from pio_tpu.storage.groupcommit import PartialFlushOutcome
 
+        failpoint("eventlog.flush.before_write")
         groups: dict = {}
         for k, (eid, app_id, channel_id, rec) in enumerate(payloads):
             groups.setdefault((app_id, channel_id), []).append((k, rec))
@@ -277,6 +307,7 @@ class EventLogEvents(base.LEvents, base.PEvents):
             names = [n for n in names if "\0" not in n]
             if not names:
                 return self._empty_columns()
+        failpoint("eventlog.scan")
         packed = b"".join(n.encode() + b"\0" for n in names)
         res = PelResult()
         path = self._path(app_id, channel_id)

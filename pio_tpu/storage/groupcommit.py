@@ -25,6 +25,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, List, Sequence
 
+from pio_tpu.faults import failpoint
 from pio_tpu.obs import REGISTRY, monotonic_s
 
 #: leader flush duration + coalescing effectiveness, labelled by the
@@ -119,6 +120,11 @@ class GroupCommitter:
                 t_flush = monotonic_s()
                 _BATCH_SIZE.observe(len(batch), store=self._store)
                 try:
+                    # inside the try so an injected error lands in the
+                    # generic handler (exercising the solo-retry path)
+                    # and an injected crash kills the leader MID-FLUSH —
+                    # the crash-consistency suite's SIGKILL moment
+                    failpoint(f"groupcommit.flush.{self._store}")
                     # list() BEFORE the length check: a generator return
                     # would raise TypeError on len() after the flush
                     # already committed, and the generic handler's solo

@@ -9,7 +9,9 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from pio_tpu.faults import failpoint
 from pio_tpu.storage import base
+from pio_tpu.storage.durability import fsync_fileobj, replace_durable
 from pio_tpu.storage.records import Model
 
 
@@ -26,7 +28,13 @@ class LocalFSModels(base.Models):
         tmp = self._path(model.id) + ".tmp"
         with open(tmp, "wb") as f:
             f.write(model.models)
-        os.replace(tmp, self._path(model.id))
+            # durable rename, half 1: the temp file's BYTES must be on
+            # disk before the rename publishes its name — os.replace of
+            # an unsynced file can surface as an empty blob after a crash
+            fsync_fileobj(f)
+        failpoint("storage.localfs.persist")
+        # half 2: fsync the parent dir so the rename itself is durable
+        replace_durable(tmp, self._path(model.id))
 
     def get(self, model_id: str) -> Optional[Model]:
         p = self._path(model_id)

@@ -200,7 +200,20 @@ class SQLiteClient:
                     os.makedirs(parent, exist_ok=True)
                 c = sqlite3.connect(self.path, timeout=30.0)
             c.execute("PRAGMA journal_mode=WAL")
-            c.execute("PRAGMA synchronous=NORMAL")
+            # the durability knob maps onto SQLite's sync levels: commit
+            # = FULL (fsync per txn), batch = NORMAL (WAL fsyncs at
+            # checkpoint), os = OFF (page cache only)
+            from pio_tpu.storage.durability import mode as _durability
+
+            sync = {"commit": "FULL", "batch": "NORMAL", "os": "OFF"}[
+                _durability()
+            ]
+            c.execute(f"PRAGMA synchronous={sync}")
+            # in-engine busy handler alongside the connect timeout: a
+            # statement hitting SQLITE_BUSY retries inside sqlite before
+            # surfacing OperationalError (which retrying() then treats
+            # as transient)
+            c.execute("PRAGMA busy_timeout=30000")
             # default checkpoint-every-1000-pages runs mid-commit on the
             # ingest hot path (measured ~2x per-insert cost); 16384 pages
             # (~64 MB WAL ceiling) amortizes it — readers are unaffected,
@@ -257,12 +270,19 @@ class SQLiteEvents(base.LEvents, base.PEvents):
                     from pio_tpu.storage.groupcommit import GroupCommitter
 
                     def flush(payloads):
+                        from pio_tpu.faults import failpoint
+
                         conn = client.conn()
                         try:
                             conn.executemany(
                                 _EVENT_INSERT_SQL,
                                 [p[1] for p in payloads],
                             )
+                            # between executemany and commit: an error
+                            # here proves the rollback keeps the thread-
+                            # local connection clean; a crash proves WAL
+                            # recovery drops the uncommitted txn whole
+                            failpoint("storage.sqlite.commit")
                             conn.commit()
                         except Exception:
                             # leave nothing pending on the thread-local

@@ -270,8 +270,17 @@ def cmd_eval(args) -> int:
 
 # ------------------------------------------------------------------- servers
 def cmd_eventserver(args) -> int:
+    import os
+
     from pio_tpu.server import create_event_server
 
+    faults = getattr(args, "faults", None) or None
+    if faults:
+        from pio_tpu import faults as _faults
+
+        _faults.parse_faults(faults)
+        os.environ["PIO_TPU_FAULTS"] = faults
+        _faults.install(faults)
     server = create_event_server(host=args.ip, port=args.port)
     _out(f"Event Server listening on {args.ip}:{server.port}")
     try:
@@ -356,6 +365,15 @@ def cmd_deploy(args) -> int:
 
         parse_qos(qos)
         os.environ["PIO_TPU_QOS"] = qos
+    faults = getattr(args, "faults", None) or None
+    if faults:
+        # fault injection: validate, export for pool workers (spawn
+        # context re-arms from the env at import), arm this process
+        from pio_tpu import faults as _faults
+
+        _faults.parse_faults(faults)
+        os.environ["PIO_TPU_FAULTS"] = faults
+        _faults.install(faults)
     if getattr(args, "workers", 1) > 1:
         from pio_tpu.server.worker_pool import ServingPool
 
@@ -775,6 +793,13 @@ def build_parser() -> argparse.ArgumentParser:
              "is shed with 429/503 + Retry-After, state on /qos.json; "
              "with --workers>1 the rps budget is pool-wide",
     )
+    a.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="fault-injection spec (testing only), e.g. "
+             "'eventlog.flush.*=error:0.1,storage.sqlite.commit="
+             "latency:200ms,worker.serve=crash:once'; actions error, "
+             "latency, torn-write, crash; state on /faults.json",
+    )
     a.set_defaults(fn=cmd_deploy)
 
     a = sub.add_parser("undeploy", help="stop a running query server")
@@ -796,6 +821,11 @@ def build_parser() -> argparse.ArgumentParser:
     a = sub.add_parser("eventserver", help="run the event ingestion server")
     a.add_argument("--ip", default="0.0.0.0")
     a.add_argument("--port", type=int, default=7070)
+    a.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="fault-injection spec (testing only), e.g. "
+             "'storage.sqlite.commit=error:0.1'; state on /faults.json",
+    )
     a.set_defaults(fn=cmd_eventserver)
 
     a = sub.add_parser(

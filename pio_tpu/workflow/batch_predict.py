@@ -43,7 +43,9 @@ def run_batch_predict(
     ctx = ctx or ComputeContext.create()
     engine, engine_params = build_engine(variant)
     instance_id = resolve_instance_id(variant, instance_id)
-    models = load_models_for_instance(instance_id, engine, engine_params, ctx)
+    models = load_models_for_instance(
+        instance_id, engine, engine_params, ctx, variant=variant
+    )
     pairs = engine.algorithms_with_models(engine_params, models)
     serving = engine.make_serving(engine_params)
     qc = resolve_query_class(pairs)

@@ -16,6 +16,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import datetime as _dt
+import hashlib
 import json as _json
 import logging
 import pickle
@@ -58,6 +59,20 @@ TRAIN_TRACER = Tracer(
     stages=("read", "prepare", "persist"),
     buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
              300.0, 1800.0, 7200.0),
+)
+
+
+#: Models-store id suffix of the checksum manifest written next to each
+#: pickled blob: {"sha256": ..., "size": ...}. Blob first, manifest
+#: second — a crash between the two leaves a blob without a manifest,
+#: which loads unverified (the pre-manifest behavior), never a manifest
+#: promising bytes that don't exist.
+MANIFEST_SUFFIX = ".manifest"
+
+_MODEL_FALLBACK = REGISTRY.counter(
+    "pio_tpu_model_fallback_total",
+    "Deploys that fell back to an older COMPLETED instance's model "
+    "after the requested instance's blob failed verification",
 )
 
 
@@ -218,8 +233,18 @@ def run_train(
                     None if ext else m
                     for ext, m in zip(persisted_externally, models)
                 ]
-                Storage.get_model_data_models().insert(
-                    Model(id=instance_id, models=serialize_models(blob_models))
+                blob = serialize_models(blob_models)
+                models_store = Storage.get_model_data_models()
+                models_store.insert(Model(id=instance_id, models=blob))
+                manifest = _json.dumps(
+                    {
+                        "sha256": hashlib.sha256(blob).hexdigest(),
+                        "size": len(blob),
+                    },
+                    sort_keys=True,
+                ).encode()
+                models_store.insert(
+                    Model(id=instance_id + MANIFEST_SUFFIX, models=manifest)
                 )
 
             done = dataclasses.replace(
@@ -247,18 +272,91 @@ def run_train(
         raise
 
 
+def _verified_blob_models(models_store, instance_id: str) -> List[Any]:
+    """Fetch + checksum-verify + deserialize one instance's model blob.
+
+    Raises RuntimeError on a missing record, a checksum mismatch against
+    the instance's manifest, or a blob that fails to unpickle. A missing
+    manifest (pre-manifest instance, or crash between blob and manifest
+    writes) loads unverified.
+    """
+    record = models_store.get(instance_id)
+    if record is None:
+        raise RuntimeError(f"no models stored for instance {instance_id!r}")
+    manifest = models_store.get(instance_id + MANIFEST_SUFFIX)
+    if manifest is not None:
+        try:
+            want = _json.loads(manifest.models.decode("utf-8"))["sha256"]
+        except Exception as e:
+            raise RuntimeError(
+                f"unreadable model manifest for instance {instance_id!r}: {e}"
+            ) from e
+        got = hashlib.sha256(record.models).hexdigest()
+        if got != want:
+            raise RuntimeError(
+                f"model blob for instance {instance_id!r} failed checksum "
+                f"verification (manifest {want}, blob {got})"
+            )
+    try:
+        return deserialize_models(record.models)
+    except Exception as e:
+        raise RuntimeError(
+            f"model blob for instance {instance_id!r} failed to "
+            f"deserialize: {e}"
+        ) from e
+
+
 def load_models_for_instance(
     instance_id: str,
     engine: Engine,
     engine_params: EngineParams,
     ctx: ComputeContext,
+    variant: Optional[EngineVariant] = None,
 ) -> List[Any]:
     """Models-store blob + PersistentModel loads
-    (reference ``Engine.prepareDeploy``)."""
-    record = Storage.get_model_data_models().get(instance_id)
-    if record is None:
-        raise RuntimeError(f"no models stored for instance {instance_id!r}")
-    blob_models = deserialize_models(record.models)
+    (reference ``Engine.prepareDeploy``).
+
+    With ``variant`` given, a blob that fails verification (torn write,
+    bit rot, half-persisted crash) does not fail the deploy: the loader
+    falls back to the newest older COMPLETED instance of the same variant
+    whose blob verifies — last known good — and serves that instead.
+    """
+    models_store = Storage.get_model_data_models()
+    try:
+        blob_models = _verified_blob_models(models_store, instance_id)
+    except RuntimeError as primary_err:
+        if variant is None:
+            raise
+        log.error(
+            "model load for instance %s failed (%s); searching for last "
+            "known good", instance_id, primary_err,
+        )
+        blob_models = None
+        candidates = Storage.get_meta_data_engine_instances().get_completed(
+            variant.engine_id,
+            variant.engine_version,
+            variant.path or variant.engine_id,
+        )
+        for cand in candidates:
+            if cand.id == instance_id:
+                continue
+            try:
+                blob_models = _verified_blob_models(models_store, cand.id)
+            except RuntimeError as e:
+                log.warning("fallback candidate %s also bad: %s", cand.id, e)
+                continue
+            _MODEL_FALLBACK.inc()
+            log.warning(
+                "serving last known good instance %s in place of %s",
+                cand.id, instance_id,
+            )
+            # PersistentModel loads below must come from the SAME instance
+            # as the blob, or externally-persisted algorithms would mix
+            # generations
+            instance_id = cand.id
+            break
+        if blob_models is None:
+            raise primary_err
     out = []
     for (name, algo_params), blob_model in zip(
         engine_params.algorithm_params_list, blob_models

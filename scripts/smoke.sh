@@ -18,9 +18,12 @@ cd "$(dirname "$0")/.."
 
 WORKDIR="$(mktemp -d -t pio-tpu-smoke-XXXXXX)"
 SERVER_PID=""
+CHAOS_PID=""
 cleanup() {
     [ -n "$SERVER_PID" ] && kill "$SERVER_PID" 2>/dev/null || true
+    [ -n "$CHAOS_PID" ] && kill "$CHAOS_PID" 2>/dev/null || true
     [ -n "$SERVER_PID" ] && wait "$SERVER_PID" 2>/dev/null || true
+    [ -n "$CHAOS_PID" ] && wait "$CHAOS_PID" 2>/dev/null || true
     rm -rf "$WORKDIR"
 }
 trap cleanup EXIT
@@ -188,5 +191,81 @@ curl -fsS --max-time 10 "$BASE/metrics" \
     | grep -q 'pio_tpu_qos_shed_total{.*reason="rate_limit"' \
     || fail "/metrics missing pio_tpu_qos_shed_total rate_limit sample"
 echo "ok   shed accounted in /qos.json + /metrics"
+
+# ------------------------------------------------------------------ chaos
+# Fault injection: boot an EVENT server over sqlite with a low-rate
+# latency+error spec armed (10 ms latency on every group-commit flush,
+# 10 % injected errors on the sqlite commit). Every POST must still come
+# back 201 — group commit's solo retry plus the server's retrying()
+# wrapper absorb the injected errors, so no 5xx may leak — and the
+# injections must be visible on /faults.json and /metrics.
+CHAOS_PORT_FILE="$WORKDIR/chaos-port"
+CHAOS_KEY_FILE="$WORKDIR/chaos-key"
+PIO_TPU_FAULTS='groupcommit.flush.sqlite=latency:10ms,storage.sqlite.commit=error:0.1' \
+python - "$CHAOS_PORT_FILE" "$CHAOS_KEY_FILE" <<'PY' &
+import os
+import signal
+import sys
+
+os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "SQ"
+os.environ["PIO_STORAGE_SOURCES_SQ_TYPE"] = "sqlite"
+os.environ["PIO_STORAGE_SOURCES_SQ_PATH"] = os.path.join(
+    os.environ["PIO_TPU_HOME"], "chaos.db")
+os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "SQ"
+os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "SQ"
+
+from pio_tpu.server import create_event_server
+from pio_tpu.storage import AccessKey, App, Storage
+
+app_id = Storage.get_meta_data_apps().insert(App(0, "chaos"))
+key = Storage.get_meta_data_access_keys().insert(AccessKey("", app_id))
+server = create_event_server(host="127.0.0.1", port=0).start()
+with open(sys.argv[2], "w") as f:
+    f.write(key)
+with open(sys.argv[1] + ".tmp", "w") as f:
+    f.write(str(server.port))
+os.rename(sys.argv[1] + ".tmp", sys.argv[1])  # atomic publish
+signal.sigwait({signal.SIGTERM, signal.SIGINT})
+server.stop()
+PY
+CHAOS_PID=$!
+
+echo "waiting for chaos event server..."
+for _ in $(seq 1 120); do
+    [ -s "$CHAOS_PORT_FILE" ] && break
+    kill -0 "$CHAOS_PID" 2>/dev/null || {
+        echo "FAIL: chaos event server died during boot" >&2; exit 1; }
+    sleep 0.5
+done
+[ -s "$CHAOS_PORT_FILE" ] || fail "chaos event server never published its port"
+CBASE="http://127.0.0.1:$(cat "$CHAOS_PORT_FILE")"
+CKEY="$(cat "$CHAOS_KEY_FILE")"
+echo "chaos event server up, faults armed"
+
+for i in $(seq 1 30); do
+    STATUS="$(curl -s -o /dev/null -w '%{http_code}' --max-time 15 \
+        -X POST -H 'Content-Type: application/json' \
+        -d "{\"event\": \"chaos\", \"entityType\": \"user\",
+             \"entityId\": \"u$i\", \"targetEntityType\": \"item\",
+             \"targetEntityId\": \"i$i\",
+             \"eventTime\": \"2026-03-01T10:00:00Z\"}" \
+        "$CBASE/events.json?accessKey=$CKEY")"
+    [ "$STATUS" = 201 ] \
+        || fail "chaos POST $i returned $STATUS, want 201 (injected fault leaked past the retry layer)"
+done
+echo "ok   30/30 event POSTs -> 201 under injected faults"
+
+# /faults.json must report the armed spec and at least one trigger (the
+# latency rule fires on every group-commit flush, so >= 1 is guaranteed)
+curl -fsS --max-time 10 "$CBASE/faults.json" | python -c '
+import json, sys
+body = json.load(sys.stdin)
+assert body["enabled"] is True, body
+assert sum(t["count"] for t in body["triggered"]) >= 1, body
+' || fail "/faults.json missing armed spec / trigger counts"
+curl -fsS --max-time 10 "$CBASE/metrics" \
+    | grep -q 'pio_tpu_fault_triggered_total{' \
+    || fail "/metrics missing pio_tpu_fault_triggered_total sample"
+echo "ok   injections visible on /faults.json + /metrics"
 
 echo "smoke OK"

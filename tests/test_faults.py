@@ -1,0 +1,718 @@
+"""Fault-injection subsystem + crash-safety chaos suite.
+
+Covers the failpoint registry (grammar, matching, actions, counters),
+the retrying() storage wrapper, the durability knob, CRC-framed
+event-log torn-tail recovery (v1 back-compat included), last-known-good
+model fallback, the /faults.json endpoint, and subprocess crash-
+consistency scenarios: a writer killed mid group-commit flush / mid
+model persist must leave a store that reopens with every acked write.
+"""
+
+import datetime as dt
+import hashlib
+import json
+import os
+import sqlite3
+import struct
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import pytest
+
+from pio_tpu import faults
+from pio_tpu.faults import FaultError, FaultInjected
+from pio_tpu.faults.registry import CRASH_EXIT_CODE, ENV_VAR
+from pio_tpu.qos.deadline import Deadline
+from pio_tpu.storage import durability
+from pio_tpu.storage.base import StorageError
+from pio_tpu.storage.retry import is_transient, retrying
+
+
+@pytest.fixture(autouse=True)
+def clean_faults(monkeypatch):
+    monkeypatch.delenv(ENV_VAR, raising=False)
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# ---------------------------------------------------------------- grammar
+class TestSpecGrammar:
+    def test_full_spec_parses(self):
+        rules = faults.parse_faults(
+            "eventlog.flush.*=error:0.1,storage.sqlite.commit=latency:200ms,"
+            "worker.serve=crash:once"
+        )
+        assert [r.pattern for r in rules] == [
+            "eventlog.flush.*", "storage.sqlite.commit", "worker.serve",
+        ]
+        assert rules[0].action == "error" and rules[0].probability == 0.1
+        assert rules[1].action == "latency" and rules[1].delay_s == 0.2
+        assert rules[2].action == "crash" and rules[2].once
+
+    def test_torn_write_underscore_alias(self):
+        (r,) = faults.parse_faults("eventlog.append.before_write=torn_write")
+        assert r.action == "torn-write"
+
+    def test_latency_takes_modifier_after_duration(self):
+        (r,) = faults.parse_faults("p=latency:10ms:0.5")
+        assert r.delay_s == 0.01 and r.probability == 0.5
+
+    @pytest.mark.parametrize("bad", [
+        "nope",                      # not point=action
+        "p=explode",                 # unknown action
+        "p=latency",                 # latency needs a duration
+        "p=latency:soon",            # unparseable duration
+        "p=error:0",                 # probability must be > 0
+        "p=error:1.5",               # probability must be <= 1
+        "p=error:maybe",             # neither number nor 'once'
+        "p=error:0.5:once",          # too many modifiers
+        "=error",                    # empty point
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(FaultError):
+            faults.parse_faults(bad)
+
+    def test_fault_error_is_value_error(self):
+        assert issubclass(FaultError, ValueError)
+
+
+# --------------------------------------------------------------- registry
+class TestRegistry:
+    def test_inert_without_spec(self):
+        assert faults.failpoint("anything.at.all") is None
+        assert faults.trigger_counts() == {}
+        assert faults.snapshot()["enabled"] is False
+
+    def test_error_action_raises_and_counts(self):
+        faults.install("a.b=error")
+        with pytest.raises(FaultInjected) as ei:
+            faults.failpoint("a.b")
+        assert ei.value.point == "a.b" and ei.value.action == "error"
+        assert faults.trigger_counts() == {("a.b", "error"): 1}
+
+    def test_latency_action_sleeps(self):
+        faults.install("a.b=latency:60ms")
+        t0 = time.monotonic()
+        assert faults.failpoint("a.b") is None
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_once_disarms_after_first_trigger(self):
+        faults.install("a.b=error:once")
+        with pytest.raises(FaultInjected):
+            faults.failpoint("a.b")
+        assert faults.failpoint("a.b") is None  # disarmed
+        snap = faults.snapshot()
+        assert snap["rules"][0]["disarmed"] is True
+        assert snap["rules"][0]["triggered"] == 1
+
+    def test_glob_match_and_spec_order_wins(self):
+        # the glob precedes the exact rule, so it must win for a.b
+        faults.install("a.*=latency:1ms,a.b=error")
+        assert faults.failpoint("a.b") is None  # latency, not error
+        assert ("a.b", "latency") in faults.trigger_counts()
+
+    def test_unmatched_point_stays_inert(self):
+        faults.install("a.b=error")
+        assert faults.failpoint("c.d") is None
+
+    def test_install_from_env(self, monkeypatch):
+        monkeypatch.setenv(ENV_VAR, "x.y=error")
+        faults.install()
+        with pytest.raises(FaultInjected):
+            faults.failpoint("x.y")
+
+    def test_reinstall_keeps_counts_uninstall_clears(self):
+        faults.install("a.b=error")
+        with pytest.raises(FaultInjected):
+            faults.failpoint("a.b")
+        faults.install("")  # disarm via empty spec
+        assert faults.failpoint("a.b") is None
+        assert faults.trigger_counts() == {("a.b", "error"): 1}
+        faults.uninstall()
+        assert faults.trigger_counts() == {}
+
+    def test_torn_write_returns_strict_prefix(self):
+        faults.install("w=torn-write")
+        data = b"0123456789"
+        for _ in range(20):
+            torn = faults.failpoint("w", data)
+            assert torn is not None and len(torn) < len(data)
+            assert data.startswith(torn)
+
+    def test_torn_write_without_data_degrades_to_error(self):
+        faults.install("w=torn-write")
+        with pytest.raises(FaultInjected) as ei:
+            faults.failpoint("w")
+        assert ei.value.action == "torn-write"
+
+    def test_exposition_lines(self):
+        faults.install("a.b=error")
+        with pytest.raises(FaultInjected):
+            faults.failpoint("a.b")
+        lines = faults.exposition_lines()
+        assert "# TYPE pio_tpu_fault_triggered_total counter" in lines
+        assert (
+            'pio_tpu_fault_triggered_total{point="a.b",action="error"} 1'
+            in lines
+        )
+
+    def test_exposition_empty_when_never_triggered(self):
+        assert faults.exposition_lines() == []
+
+
+# --------------------------------------------------------------- retrying
+class TestRetrying:
+    def test_transient_errors_are_absorbed(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise FaultInjected("p")
+            return "ok"
+
+        assert retrying(fn, base_s=0.001, cap_s=0.002) == "ok"
+        assert len(calls) == 3
+
+    def test_non_transient_raises_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("broken")
+
+        with pytest.raises(ValueError):
+            retrying(fn, base_s=0.001)
+        assert len(calls) == 1
+
+    def test_exhausted_attempts_reraise_last(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise FaultInjected("p")
+
+        with pytest.raises(FaultInjected):
+            retrying(fn, attempts=3, base_s=0.001, cap_s=0.002)
+        assert len(calls) == 3
+
+    def test_expired_deadline_stops_retrying(self):
+        calls = []
+        deadline = Deadline(budget_ms=0.0)
+
+        def fn():
+            calls.append(1)
+            raise FaultInjected("p")
+
+        with pytest.raises(FaultInjected):
+            retrying(fn, base_s=0.001, deadline=deadline)
+        assert len(calls) == 1  # no sleep for a client that gave up
+
+    def test_is_transient_classification(self):
+        assert is_transient(FaultInjected("p"))
+        assert is_transient(sqlite3.OperationalError("database is locked"))
+        assert is_transient(sqlite3.OperationalError("database is busy"))
+        assert not is_transient(sqlite3.OperationalError("syntax error"))
+        assert is_transient(StorageError("blob server unreachable: refused"))
+        assert not is_transient(StorageError("access denied"))
+        assert not is_transient(ValueError("nope"))
+
+
+# ------------------------------------------------------------- durability
+class TestDurability:
+    def test_default_mode_is_batch(self, monkeypatch):
+        monkeypatch.delenv(durability.ENV_VAR, raising=False)
+        assert durability.mode() == "batch"
+
+    def test_unknown_mode_is_loud(self, monkeypatch):
+        monkeypatch.setenv(durability.ENV_VAR, "yolo")
+        with pytest.raises(ValueError):
+            durability.mode()
+
+    def _count_fsyncs(self, monkeypatch):
+        count = {"n": 0}
+        real = os.fsync
+
+        def counting(fd):
+            count["n"] += 1
+            return real(fd)
+
+        monkeypatch.setattr(os, "fsync", counting)
+        return count
+
+    def test_fsync_fileobj_by_mode(self, monkeypatch, tmp_path):
+        count = self._count_fsyncs(monkeypatch)
+        p = tmp_path / "f"
+        monkeypatch.setenv(durability.ENV_VAR, "commit")
+        with open(p, "wb") as f:
+            f.write(b"x")
+            durability.fsync_fileobj(f)
+        assert count["n"] == 1
+        monkeypatch.setenv(durability.ENV_VAR, "os")
+        with open(p, "wb") as f:
+            f.write(b"x")
+            durability.fsync_fileobj(f)
+        assert count["n"] == 1  # unchanged
+
+    def test_replace_durable_fsyncs_parent_dir(self, monkeypatch, tmp_path):
+        count = self._count_fsyncs(monkeypatch)
+        tmp, dst = tmp_path / "a.tmp", tmp_path / "a"
+        tmp.write_bytes(b"payload")
+        monkeypatch.setenv(durability.ENV_VAR, "batch")
+        durability.replace_durable(str(tmp), str(dst))
+        assert dst.read_bytes() == b"payload" and not tmp.exists()
+        assert count["n"] == 1  # the directory fd
+        tmp.write_bytes(b"payload2")
+        monkeypatch.setenv(durability.ENV_VAR, "os")
+        durability.replace_durable(str(tmp), str(dst))
+        assert dst.read_bytes() == b"payload2"
+        assert count["n"] == 1  # no dir fsync under os
+
+    def test_interval_syncer_modes(self, monkeypatch):
+        s = durability.IntervalSyncer(interval_s=60.0)
+        monkeypatch.setenv(durability.ENV_VAR, "commit")
+        assert s.due("k") and s.due("k")
+        monkeypatch.setenv(durability.ENV_VAR, "os")
+        assert not s.due("k")
+        monkeypatch.setenv(durability.ENV_VAR, "batch")
+        assert s.due("k")  # never synced yet
+        s.mark("k")
+        assert not s.due("k")  # within the interval
+        assert s.due("other")  # per-key schedule
+
+    def test_sqlite_pragmas(self, tmp_path, monkeypatch):
+        from pio_tpu.storage.sqlite import SQLiteClient
+
+        monkeypatch.delenv(durability.ENV_VAR, raising=False)
+        conn = SQLiteClient(str(tmp_path / "t.db")).conn()
+        assert conn.execute("PRAGMA journal_mode").fetchone()[0] == "wal"
+        assert conn.execute("PRAGMA busy_timeout").fetchone()[0] == 30000
+        # batch (default) → synchronous=NORMAL (1)
+        assert conn.execute("PRAGMA synchronous").fetchone()[0] == 1
+
+    def test_sqlite_synchronous_tracks_mode(self, tmp_path, monkeypatch):
+        from pio_tpu.storage.sqlite import SQLiteClient
+
+        monkeypatch.setenv(durability.ENV_VAR, "commit")
+        conn = SQLiteClient(str(tmp_path / "full.db")).conn()
+        assert conn.execute("PRAGMA synchronous").fetchone()[0] == 2  # FULL
+        monkeypatch.setenv(durability.ENV_VAR, "os")
+        conn = SQLiteClient(str(tmp_path / "off.db")).conn()
+        assert conn.execute("PRAGMA synchronous").fetchone()[0] == 0  # OFF
+
+
+# ------------------------------------------------- eventlog CRC + failpoints
+try:
+    from pio_tpu.native import event_log_lib
+
+    event_log_lib()
+    from pio_tpu.storage.eventlog import EventLogEvents, _encode_record
+
+    _HAVE_NATIVE = True
+except Exception:  # pragma: no cover - no toolchain
+    _HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(
+    not _HAVE_NATIVE, reason="native eventlog unavailable"
+)
+
+
+def _T(h=1):
+    return dt.datetime(2026, 1, 1, h, tzinfo=dt.timezone.utc)
+
+
+def _ev(i=0):
+    from pio_tpu.data.event import Event
+
+    return Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                 properties={"rating": float(i)}, event_time=_T())
+
+
+@needs_native
+class TestEventlogFaults:
+    def test_injected_torn_write_heals_on_reopen(self, tmp_path):
+        root = str(tmp_path / "log")
+        b = EventLogEvents(root)
+        b.insert(_ev(0), 1)
+        faults.install("eventlog.append.before_write=torn-write")
+        with pytest.raises(StorageError, match="injected torn write"):
+            b.insert(_ev(1), 1)
+        faults.uninstall()
+        b2 = EventLogEvents(root)  # fresh handle: repair on first append
+        assert b2.count(1) == 1  # torn tail tolerated by the scan
+        b2.insert(_ev(2), 1)  # repair truncates, then appends cleanly
+        assert b2.count(1) == 2
+
+    def test_flush_failpoint_fails_insert(self, tmp_path):
+        b = EventLogEvents(str(tmp_path / "log"))
+        faults.install("eventlog.flush.before_write=error")
+        with pytest.raises(FaultInjected):
+            b.insert(_ev(0), 1)
+        # triggered in the batched flush AND the solo retry
+        assert faults.trigger_counts()[
+            ("eventlog.flush.before_write", "error")
+        ] >= 2
+        faults.uninstall()
+        b.insert(_ev(1), 1)
+        assert b.count(1) == 1
+
+    def test_scan_failpoint(self, tmp_path):
+        b = EventLogEvents(str(tmp_path / "log"))
+        b.insert(_ev(0), 1)
+        faults.install("eventlog.scan=error")
+        with pytest.raises(FaultInjected):
+            b.find(1)
+
+    def test_crc_catches_tail_corruption_as_torn(self, tmp_path):
+        root = str(tmp_path / "log")
+        b = EventLogEvents(root)
+        for i in range(3):
+            b.insert(_ev(i), 1)
+        path = os.path.join(root, "app_1.pel")
+        with open(path, "r+b") as f:
+            f.seek(-1, os.SEEK_END)  # last CRC byte of the final record
+            last = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([last[0] ^ 0xFF]))
+        b2 = EventLogEvents(root)
+        # CRC failure at exact EOF = torn tail → dropped, not fatal
+        assert b2.count(1) == 2
+
+    def test_crc_catches_mid_file_corruption_as_corrupt(self, tmp_path):
+        root = str(tmp_path / "log")
+        b = EventLogEvents(root)
+        for i in range(3):
+            b.insert(_ev(i), 1)
+        path = os.path.join(root, "app_1.pel")
+        with open(path, "r+b") as f:
+            f.seek(8 + 4 + 2)  # inside the FIRST record's payload
+            byte = f.read(1)
+            f.seek(8 + 4 + 2)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        b2 = EventLogEvents(root)
+        with pytest.raises(StorageError, match="corrupt"):
+            b2.count(1)
+
+    def test_v1_file_reads_and_upgrades_on_append(self, tmp_path):
+        root = str(tmp_path / "log")
+        os.makedirs(root)
+        # hand-craft a v1 file: PEL1 magic + unchecksummed framing
+        rec_v2 = _encode_record(0, 1000, 2000, [
+            b"E1", b"rate", b"user", b"u0", b"", b"", b"", b"[]", b"{}",
+        ])
+        payload = rec_v2[4:-4]  # strip length prefix + CRC trailer
+        path = os.path.join(root, "app_1.pel")
+        with open(path, "wb") as f:
+            f.write(b"PEL1\0\0\0\0")
+            f.write(struct.pack("<I", len(payload)) + payload)
+        b = EventLogEvents(root)
+        assert b.count(1) == 1  # v1 still readable
+        b.insert(_ev(1), 1)  # first append upgrades the file in place
+        assert b.count(1) == 2
+        with open(path, "rb") as f:
+            assert f.read(4) == b"PEL2"
+        # upgraded records carry CRCs: whole-file parse must still be clean
+        assert len(EventLogEvents(root).find(1)) == 2
+
+
+# -------------------------------------------------- last-known-good models
+@pytest.fixture()
+def mem_storage(tmp_home, monkeypatch):
+    from pio_tpu.storage import Storage
+
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "MEM")
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+class _Engine:
+    algorithm_class_map: dict = {}
+
+
+class _Params:
+    algorithm_params_list = [("algo", None)]
+
+
+def _variant():
+    from pio_tpu.workflow.engine_json import EngineVariant
+
+    return EngineVariant(
+        engine_id="eng", engine_version="1", engine_factory="f",
+        variant={}, path="eng",
+    )
+
+
+class TestModelFallback:
+    def _persist(self, iid, payload, start_h, manifest=True):
+        from pio_tpu.storage import EngineInstance, Model, RunStatus, Storage
+        from pio_tpu.workflow.core_workflow import (
+            MANIFEST_SUFFIX, serialize_models,
+        )
+
+        t = _T(start_h)
+        Storage.get_meta_data_engine_instances().insert(EngineInstance(
+            id=iid, status=RunStatus.COMPLETED, start_time=t, end_time=t,
+            engine_id="eng", engine_version="1", engine_variant="eng",
+            engine_factory="f",
+        ))
+        blob = serialize_models([payload])
+        ms = Storage.get_model_data_models()
+        ms.insert(Model(id=iid, models=blob))
+        if manifest:
+            ms.insert(Model(id=iid + MANIFEST_SUFFIX, models=json.dumps({
+                "sha256": hashlib.sha256(blob).hexdigest(),
+                "size": len(blob),
+            }).encode()))
+
+    def _corrupt(self, iid):
+        from pio_tpu.storage import Model, Storage
+
+        Storage.get_model_data_models().insert(
+            Model(id=iid, models=b"\x80garbage-not-a-pickle")
+        )
+
+    def test_verified_load(self, mem_storage):
+        from pio_tpu.workflow.core_workflow import load_models_for_instance
+
+        self._persist("inst-1", "model-1", start_h=1)
+        models = load_models_for_instance(
+            "inst-1", _Engine(), _Params(), None, variant=_variant()
+        )
+        assert models == ["model-1"]
+
+    def test_missing_manifest_loads_unverified(self, mem_storage):
+        from pio_tpu.workflow.core_workflow import load_models_for_instance
+
+        self._persist("inst-1", "model-1", start_h=1, manifest=False)
+        assert load_models_for_instance(
+            "inst-1", _Engine(), _Params(), None
+        ) == ["model-1"]
+
+    def test_corrupt_blob_falls_back_to_last_known_good(self, mem_storage):
+        from pio_tpu.workflow.core_workflow import (
+            _MODEL_FALLBACK, load_models_for_instance,
+        )
+
+        self._persist("inst-old", "model-old", start_h=1)
+        self._persist("inst-new", "model-new", start_h=2)
+        self._corrupt("inst-new")  # checksum now fails
+        before = _MODEL_FALLBACK.value()
+        models = load_models_for_instance(
+            "inst-new", _Engine(), _Params(), None, variant=_variant()
+        )
+        assert models == ["model-old"]
+        assert _MODEL_FALLBACK.value() == before + 1
+
+    def test_corrupt_blob_without_manifest_still_falls_back(
+        self, mem_storage
+    ):
+        # no manifest → verification skipped, but the unpickle failure
+        # itself must trigger the same fallback
+        from pio_tpu.workflow.core_workflow import load_models_for_instance
+
+        self._persist("inst-old", "model-old", start_h=1)
+        self._persist("inst-new", "model-new", start_h=2, manifest=False)
+        self._corrupt("inst-new")
+        assert load_models_for_instance(
+            "inst-new", _Engine(), _Params(), None, variant=_variant()
+        ) == ["model-old"]
+
+    def test_corrupt_blob_without_variant_raises(self, mem_storage):
+        from pio_tpu.workflow.core_workflow import load_models_for_instance
+
+        self._persist("inst-1", "model-1", start_h=1)
+        self._corrupt("inst-1")
+        with pytest.raises(RuntimeError, match="checksum|deserialize"):
+            load_models_for_instance("inst-1", _Engine(), _Params(), None)
+
+    def test_no_intact_candidate_reraises_primary(self, mem_storage):
+        from pio_tpu.workflow.core_workflow import load_models_for_instance
+
+        self._persist("inst-1", "model-1", start_h=1)
+        self._corrupt("inst-1")
+        with pytest.raises(RuntimeError):
+            load_models_for_instance(
+                "inst-1", _Engine(), _Params(), None, variant=_variant()
+            )
+
+    def test_run_train_writes_manifest(self, mem_storage):
+        # the real persist path must produce a blob the verifier accepts
+        from pio_tpu.controller import ComputeContext
+        from pio_tpu.storage import Storage
+        from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+        from pio_tpu.workflow.core_workflow import (
+            MANIFEST_SUFFIX, _verified_blob_models,
+        )
+        from tests.fixtures import fixture_engine  # noqa: F401  (registers)
+        from tests.test_controller import variant
+
+        v = variant_from_dict(
+            variant(algos=[{"name": "algo", "params": {"id": 1, "mult": 4}}])
+        )
+        engine, ep = build_engine(v)
+        iid = run_train(engine, ep, v, ctx=ComputeContext.local())
+        ms = Storage.get_model_data_models()
+        assert ms.get(iid + MANIFEST_SUFFIX) is not None
+        # round-trips through the checksum verifier
+        assert _verified_blob_models(ms, iid)
+
+
+# ------------------------------------------------------ /faults.json + obs
+class TestFaultsEndpoint:
+    def test_faults_json_and_metrics(self, mem_storage):
+        from pio_tpu.server import create_event_server
+
+        server = create_event_server(host="127.0.0.1", port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    return r.read().decode()
+
+            body = json.loads(get("/faults.json"))
+            assert body["enabled"] is False and body["rules"] == []
+            faults.install("p.q=latency:1ms")
+            faults.failpoint("p.q")
+            body = json.loads(get("/faults.json"))
+            assert body["enabled"] is True
+            assert body["spec"] == "p.q=latency:1ms"
+            assert body["triggered"] == [
+                {"point": "p.q", "action": "latency", "count": 1}
+            ]
+            metrics = get("/metrics")
+            assert (
+                'pio_tpu_fault_triggered_total{point="p.q",'
+                'action="latency"} 1' in metrics
+            )
+        finally:
+            server.stop()
+
+
+# --------------------------------------------------- crash consistency
+_CRASH_WRITER = textwrap.dedent("""
+    import datetime as dt
+    import os
+    import sys
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PIO_TPU_DURABILITY"] = "commit"  # acked == on disk
+    root, ackfile = sys.argv[1], sys.argv[2]
+
+    from pio_tpu.data.event import Event
+    from pio_tpu.storage.eventlog import EventLogEvents
+
+    b = EventLogEvents(root)
+    t = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    ack = open(ackfile, "w")
+    for i in range(5):
+        eid = b.insert(
+            Event(event="e", entity_type="u", entity_id=f"u{i}",
+                  event_time=t),
+            1,
+        )
+        # the ack protocol: an id reaches this file only AFTER insert
+        # returned (the 201 analog), fsynced so the parent can trust it
+        ack.write(eid + "\\n")
+        ack.flush()
+        os.fsync(ack.fileno())
+
+    from pio_tpu import faults
+    faults.install("groupcommit.flush.eventlog=crash:once")
+    b.insert(
+        Event(event="e", entity_type="u", entity_id="boom", event_time=t),
+        1,
+    )
+    print("UNREACHABLE")  # the crash failpoint must have fired
+""")
+
+_PERSIST_WRITER = textwrap.dedent("""
+    import os
+    import sys
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PIO_TPU_DURABILITY"] = "commit"
+    root = sys.argv[1]
+
+    from pio_tpu.storage.localfs import LocalFSModels
+    from pio_tpu.storage.records import Model
+
+    s = LocalFSModels(root)
+    s.insert(Model("good", b"payload-1"))
+
+    from pio_tpu import faults
+    faults.install("storage.localfs.persist=crash:once")
+    s.insert(Model("doomed", b"payload-2"))
+    print("UNREACHABLE")
+""")
+
+
+def _run_writer(script, *argv):
+    env = dict(os.environ)
+    env.pop(ENV_VAR, None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-c", script, *argv],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+@needs_native
+class TestCrashConsistency:
+    def test_sigkill_mid_group_commit_flush(self, tmp_path):
+        """Writer dies (os._exit, no unwinding) inside the group-commit
+        leader, mid-flush. On reopen: the log scans clean and every
+        acked event is present — an ack under durability=commit is a
+        promise that survives the crash."""
+        root = str(tmp_path / "log")
+        ackfile = str(tmp_path / "acks")
+        proc = _run_writer(_CRASH_WRITER, root, ackfile)
+        assert proc.returncode == CRASH_EXIT_CODE, proc.stderr
+        assert "injected crash" in proc.stderr
+        assert "UNREACHABLE" not in proc.stdout
+        with open(ackfile) as f:
+            acked = [line.strip() for line in f if line.strip()]
+        assert len(acked) == 5
+        b = EventLogEvents(root)  # reopen as a recovering server would
+        events = b.find(1)  # scan must succeed (torn tail tolerated)
+        got = {e.event_id for e in events}
+        assert set(acked) <= got, f"lost acked events: {set(acked) - got}"
+        assert "boom" not in {e.entity_id for e in events}
+        # and the log accepts new writes after recovery
+        b.insert(_ev(9), 1)
+        assert b.count(1) == len(events) + 1
+
+    def test_crash_mid_model_persist(self, tmp_path):
+        """Writer dies between writing the temp file and the durable
+        rename: the previous model must be intact and the half-written
+        one invisible (temp never published)."""
+        from pio_tpu.storage.localfs import LocalFSModels
+
+        root = str(tmp_path / "models")
+        proc = _run_writer(_PERSIST_WRITER, root)
+        assert proc.returncode == CRASH_EXIT_CODE, proc.stderr
+        assert "injected crash" in proc.stderr
+        s = LocalFSModels(root)
+        good = s.get("good")
+        assert good is not None and good.models == b"payload-1"
+        assert s.get("doomed") is None  # tmp written, never published
+        assert os.path.exists(os.path.join(root, "doomed.bin.tmp"))
+
+
+# ----------------------------------------------------- worker failpoint
+def test_worker_serve_failpoint_is_wired():
+    # the serve loop calls failpoint("worker.serve") every iteration; a
+    # full pool boot is covered by test_worker_pool — here just prove the
+    # point name is armed/counted through the registry like any other
+    faults.install("worker.serve=latency:1ms")
+    assert faults.failpoint("worker.serve") is None
+    assert ("worker.serve", "latency") in faults.trigger_counts()

@@ -430,6 +430,7 @@ class TestSupervisorHealthSweep:
         pool._respawns = [0]
         pool._health_ports = [server.port]
         pool._health_fails = [0]
+        pool._kill_reason = [None]
         pool._health_gauge = REGISTRY.gauge(
             "pio_tpu_worker_health_state", "", ("worker",)
         )
