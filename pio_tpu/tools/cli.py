@@ -657,6 +657,51 @@ def cmd_shell(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Project-native static analysis (see ``pio_tpu/analysis``).
+
+    The reference system leaned on scalac + compile-time DSL checks to
+    keep its multi-component server consistent; this is the Python
+    equivalent, encoding the serving stack's concurrency and naming
+    conventions as AST rules. Exit 0 = clean, 1 = findings.
+    """
+    from pio_tpu.analysis import all_rules, run_lint
+    from pio_tpu.analysis.core import (
+        collect_files,
+        parse_module,
+        render_json,
+        render_text,
+    )
+    from pio_tpu.analysis.rules_convention import failpoint_inventory
+
+    if args.list_rules:
+        for rid, rule in sorted(all_rules().items()):
+            print(f"{rid:24s} [{rule.family}] {rule.description}")
+        return 0
+
+    paths = args.paths or ["pio_tpu", "tests"]
+    if args.dump_failpoints:
+        modules = []
+        for path in collect_files(paths):
+            parsed = parse_module(path)
+            if hasattr(parsed, "tree"):   # skip unparsable files
+                modules.append(parsed)
+        print(json.dumps(
+            {"failpoints": failpoint_inventory(modules)},
+            indent=2, sort_keys=True,
+        ))
+        return 0
+
+    rule_ids = args.rules.split(",") if args.rules else None
+    try:
+        findings = run_lint(paths, rule_ids=rule_ids)
+    except ValueError as exc:
+        print(f"pio lint: {exc}", file=sys.stderr)
+        return 2
+    print(render_json(findings) if args.json else render_text(findings))
+    return 1 if findings else 0
+
+
 # -------------------------------------------------------------------- parser
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -903,6 +948,30 @@ def build_parser() -> argparse.ArgumentParser:
              "flag-like tokens included)",
     )
     a.set_defaults(fn=cmd_run)
+
+    a = sub.add_parser(
+        "lint",
+        help="project-native static analysis (concurrency + conventions)",
+    )
+    a.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: pio_tpu tests)",
+    )
+    a.add_argument("--json", action="store_true", help="JSON findings")
+    a.add_argument(
+        "--rules", default=None, metavar="ID[,ID…]",
+        help="run only these rule ids",
+    )
+    a.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    a.add_argument(
+        "--dump-failpoints", action="store_true",
+        help="machine-readable inventory of failpoint() call sites "
+             "(cross-check chaos specs against real points)",
+    )
+    a.set_defaults(fn=cmd_lint)
     return p
 
 

@@ -1,0 +1,57 @@
+"""Hardened ``PIO_TPU_*`` environment parsing.
+
+Every numeric knob in the tree goes through these helpers (enforced by
+the ``env-hardening`` lint rule): a typo'd value must degrade to the
+documented default with a warning, not kill a server at import time.
+NaN is always rejected; ``positive=True`` additionally rejects values
+``<= 0`` (body caps, ages, rates — where zero/negative would reject or
+break everything).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+
+def _warn(name: str, raw: str, default, why: str) -> None:
+    warnings.warn(
+        f"{name}={raw!r} {why}; using default {default:g}",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
+def env_float(name: str, default: float, *, positive: bool = False) -> float:
+    """Float env knob with warn-and-default semantics."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        v = float(raw)
+    except (TypeError, ValueError):
+        _warn(name, raw, default, "is not a number")
+        return default
+    if v != v:  # NaN compares unequal to itself
+        _warn(name, raw, default, "is NaN")
+        return default
+    if positive and v <= 0:
+        _warn(name, raw, default, "must be a positive number")
+        return default
+    return v
+
+
+def env_int(name: str, default: int, *, positive: bool = False) -> int:
+    """Integer env knob with warn-and-default semantics."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        v = int(raw)
+    except (TypeError, ValueError):
+        _warn(name, raw, default, "is not an integer")
+        return default
+    if positive and v <= 0:
+        _warn(name, raw, default, "must be a positive integer")
+        return default
+    return v

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Run the project-native static analyzer over the tree (or over the
+# paths given as arguments). Exit 0 = clean, 1 = findings, 2 = usage.
+#
+#   scripts/lint.sh                 # whole tree (pio_tpu + tests)
+#   scripts/lint.sh pio_tpu/qos     # one subtree
+#   scripts/lint.sh --json          # machine-readable findings
+#
+# Flags are passed through to `pio lint` (--json, --rules ID[,ID...],
+# --list-rules, --dump-failpoints).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+args=("$@")
+have_path=0
+for a in "${args[@]:-}"; do
+    case "$a" in
+        --*) ;;
+        "") ;;
+        *) have_path=1 ;;
+    esac
+done
+if [ "$have_path" = 0 ]; then
+    args+=(pio_tpu tests)
+fi
+
+exec python -m pio_tpu.tools.cli lint "${args[@]}"
