@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional, Tuple
 
+from pio_tpu.analysis.runtime import make_lock
 from pio_tpu.obs.metrics import monotonic_s
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
@@ -99,7 +100,7 @@ class CircuitBreaker:
         self.probes = max(int(probes), 1)
         self._clock = clock
         self._on_change = on_state_change
-        self._lock = threading.Lock()
+        self._lock = make_lock("qos.breaker")
         self._state = CLOSED
         self._outcomes = []  # bounded ring of bools (True = failure)
         self._opened_at = 0.0

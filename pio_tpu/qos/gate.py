@@ -14,6 +14,7 @@ import math
 import threading
 from typing import Dict, Optional
 
+from pio_tpu.analysis.runtime import make_lock
 from pio_tpu.qos.breaker import STATE_CODES, CircuitBreaker
 from pio_tpu.qos.degrade import StaleCache
 from pio_tpu.qos.limiter import ConcurrencyLimiter, KeyedBuckets, TokenBucket
@@ -128,7 +129,7 @@ class QoSGate:
         if policy.cache:
             self.stale = StaleCache(policy.cache)
         self._breakers: Dict[str, CircuitBreaker] = {}
-        self._breaker_lock = threading.Lock()
+        self._breaker_lock = make_lock("qos.gate.breakers")
 
     # -- pool --------------------------------------------------------------
     def on_pool_bound(self) -> None:

@@ -27,6 +27,7 @@ import threading
 from contextlib import nullcontext
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from pio_tpu.analysis.runtime import make_lock
 from pio_tpu.data.event import Event, EventValidationError
 from pio_tpu.obs import (
     HealthMonitor, MetricsRegistry, RequestWindow, Tracer, monotonic_s,
@@ -73,7 +74,7 @@ class _Stats:
     and the JSON stats can never disagree."""
 
     def __init__(self, counter=None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("event.stats")
         self._counter = counter
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
         # (app_id, event, entity_type, status) -> count
@@ -140,14 +141,14 @@ class EventServerService:
         #: not cross-pollinate scrapes through a process global)
         self.obs = MetricsRegistry()
         self._events_counter = self.obs.counter(
-            "pio_events_ingested_total",
+            "pio_tpu_events_ingested_total",
             "Events by app/event/status",
             ("app_id", "event", "entity_type", "status"),
         )
         #: full-request latency of the ingest write paths — the latency
-        #: SLO source (see query_server's pio_request_seconds)
+        #: SLO source (see query_server's pio_tpu_request_seconds)
         self._request_hist = self.obs.histogram(
-            "pio_request_seconds",
+            "pio_tpu_request_seconds",
             "Full-request wall seconds of the event write paths",
             ("engine_id",),
         )
@@ -189,7 +190,7 @@ class EventServerService:
         )
         self._auth_cache: dict = {}
         self._auth_gen = 0  # bumped by invalidation; fences re-caching
-        self._auth_cache_lock = threading.Lock()
+        self._auth_cache_lock = make_lock("event.auth_cache")
         # a Storage.reset() within AUTH_CACHE_TTL_S must not keep serving
         # AccessKey records from the store that was just dropped
         Storage.add_reset_hook(self.invalidate_auth_cache)

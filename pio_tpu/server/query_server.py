@@ -26,6 +26,7 @@ import threading
 import uuid
 from typing import Any, List, Optional, Tuple
 
+from pio_tpu.analysis.runtime import make_condition, make_lock
 from pio_tpu.controller.engine import Engine, EngineParams
 from pio_tpu.controller.params import ParamsError, params_from_dict
 from pio_tpu.data.event import Event
@@ -43,6 +44,7 @@ from pio_tpu.qos import (
     Deadline, DeadlineExceeded, QoSGate, cache_key, resolve_policy,
     retry_after_header,
 )
+from pio_tpu.utils import envutil
 from pio_tpu.server.http import (
     HTTPError, JsonHTTPServer, Request, Router, float_param, int_param,
     json_response, keys_equal, metrics_response,
@@ -128,7 +130,7 @@ class _MicroBatcher:
                  adaptive: bool = True):
         self._service = service
         self._window_s = window_s
-        self._cv = threading.Condition()
+        self._cv = make_condition("query.microbatch")
         self._queue: List[list] = []
         self._stopped = False
         self.batches = 0
@@ -139,7 +141,7 @@ class _MicroBatcher:
         #: set when the probe decides "off" — query() then skips the
         #: batcher entirely (inline per-request path, no residual cost)
         self.bypassed = False
-        self._probe_lock = threading.Lock()
+        self._probe_lock = make_lock("query.microbatch.probe")
         self._probe: dict = {"batch": [], "solo": []}
         self._thread = threading.Thread(
             target=self._run, name="pio-tpu-microbatch", daemon=True
@@ -360,16 +362,16 @@ class QueryServerService:
         self.obs = MetricsRegistry()
         eng = variant.engine_id
         self._queries_total = self.obs.counter(
-            "pio_queries_total", "Queries served", ("engine_id",)
+            "pio_tpu_queries_total", "Queries served", ("engine_id",)
         )
         self._query_errors_total = self.obs.counter(
-            "pio_query_errors_total", "Queries that errored", ("engine_id",)
+            "pio_tpu_query_errors_total", "Queries that errored", ("engine_id",)
         )
         #: full-request latency histogram — the SLO engine's latency
         #: source (stage histograms cover WHERE time went; this one
         #: covers the request the client saw)
         self._request_hist = self.obs.histogram(
-            "pio_request_seconds",
+            "pio_tpu_request_seconds",
             "Full-request wall seconds of /queries.json",
             ("engine_id",),
         )
@@ -391,8 +393,8 @@ class QueryServerService:
 
         self.obs.add_collector(_faults.exposition_lines)
         # -- health probes (ISSUE 2) --
-        self.heartbeat = Heartbeat(max_age_s=float(
-            os.environ.get("PIO_TPU_HEARTBEAT_MAX_AGE_S", "30")
+        self.heartbeat = Heartbeat(max_age_s=envutil.env_float(
+            "PIO_TPU_HEARTBEAT_MAX_AGE_S", 30.0, positive=True
         ))
         self.health = HealthMonitor()
         self.health.add_liveness("http_loop", self._http_loop_alive)
@@ -426,7 +428,7 @@ class QueryServerService:
             self.qos.breaker("scorer") if self.qos is not None else None
         )
         self.profile_hook = DeviceProfileHook.from_env()
-        self._swap_lock = threading.Lock()
+        self._swap_lock = make_lock("query.model_swap")
         self._deployed = True
         #: pool mode (see server/worker_pool.py): shared reload generation
         #: + shutdown event wired in by enable_pool()
@@ -440,7 +442,7 @@ class QueryServerService:
         #: undeploy` terminates the server process, not just the flag)
         self._server = None
         self._load(instance_id)
-        window_us = float(os.environ.get("PIO_TPU_SERVE_MICROBATCH_US", "0"))
+        window_us = envutil.env_float("PIO_TPU_SERVE_MICROBATCH_US", 0.0)
         adaptive = os.environ.get(
             "PIO_TPU_SERVE_MICROBATCH_ADAPTIVE", "1"
         ) != "0"
@@ -823,7 +825,7 @@ class QueryServerService:
 
     def _predict_one(self, query):
         """Per-query predict + serve from one consistent snapshot."""
-        failpoint("scorer.dispatch")
+        failpoint("scorer.dispatch.solo")
         with self._swap_lock:
             pairs, serving = self.pairs, self.serving
         with self.profile_hook.capture():
@@ -833,7 +835,7 @@ class QueryServerService:
     def _predict_batch(self, queries: list):
         """One ``batch_predict`` dispatch per algorithm over the whole
         micro-batch, then per-query serving combine (micro-batcher path)."""
-        failpoint("scorer.dispatch")
+        failpoint("scorer.dispatch.batch")
         with self._swap_lock:
             pairs, serving = self.pairs, self.serving
         per_algo = []
@@ -907,27 +909,27 @@ class QueryServerService:
         lines = []
         if s["avgMs"] is not None:
             lines += [
-                "# TYPE pio_query_latency_ms summary",
-                f'pio_query_latency_ms{{{lab},quantile="0.5"}} '
+                "# TYPE pio_tpu_query_latency_ms summary",
+                f'pio_tpu_query_latency_ms{{{lab},quantile="0.5"}} '
                 f"{s['p50Ms']}",
-                f'pio_query_latency_ms{{{lab},quantile="0.95"}} '
+                f'pio_tpu_query_latency_ms{{{lab},quantile="0.95"}} '
                 f"{s['p95Ms']}",
-                f'pio_query_latency_ms{{{lab},quantile="0.99"}} '
+                f'pio_tpu_query_latency_ms{{{lab},quantile="0.99"}} '
                 f"{s['p99Ms']}",
                 # _sum/_count complete the summary convention so
                 # rate(_sum)/rate(_count) windowed averages work
-                f"pio_query_latency_ms_sum{{{lab}}} "
+                f"pio_tpu_query_latency_ms_sum{{{lab}}} "
                 f"{s['avgMs'] * s['requestCount']}",
-                f"pio_query_latency_ms_count{{{lab}}} "
+                f"pio_tpu_query_latency_ms_count{{{lab}}} "
                 f"{s['requestCount']}",
             ]
         if self._batcher is not None:
             mb = self._batcher.to_dict()
             lines += [
-                "# TYPE pio_microbatch_batches_total counter",
-                f"pio_microbatch_batches_total{{{lab}}} {mb['batches']}",
-                "# TYPE pio_microbatch_queries_total counter",
-                f"pio_microbatch_queries_total{{{lab}}} "
+                "# TYPE pio_tpu_microbatch_batches_total counter",
+                f"pio_tpu_microbatch_batches_total{{{lab}}} {mb['batches']}",
+                "# TYPE pio_tpu_microbatch_queries_total counter",
+                f"pio_tpu_microbatch_queries_total{{{lab}}} "
                 f"{mb['batchedQueries']}",
             ]
         return lines

@@ -26,6 +26,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from pio_tpu.analysis.runtime import make_lock, make_rlock
 from pio_tpu.data.datamap import DataMap
 from pio_tpu.data.event import Event
 from pio_tpu.faults import failpoint
@@ -44,14 +45,14 @@ _I64_MAX = 2 ** 63 - 1
 #: app's log never blocks other apps. (Cross-process access is not
 #: coordinated.)
 _file_locks: dict = {}
-_file_locks_guard = threading.Lock()
+_file_locks_guard = make_lock("eventlog.locks_guard")
 
 
 def _lock_for(path: str) -> threading.RLock:
     # re-entrant so delete() can hold it across its get + tombstone append
     key = os.path.realpath(path)
     with _file_locks_guard:
-        return _file_locks.setdefault(key, threading.RLock())
+        return _file_locks.setdefault(key, make_rlock(f"eventlog.file:{key}"))
 
 
 def _to_us(t: Optional[_dt.datetime], default: int) -> int:

@@ -25,6 +25,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, List, Sequence
 
+from pio_tpu.analysis.runtime import make_lock
 from pio_tpu.faults import failpoint
 from pio_tpu.obs import REGISTRY, monotonic_s
 
@@ -32,12 +33,12 @@ from pio_tpu.obs import REGISTRY, monotonic_s
 #: owning store (process-global registry: storage has no HTTP surface of
 #: its own — the training workflow and event server re-expose these)
 _FLUSH_SECONDS = REGISTRY.histogram(
-    "pio_groupcommit_flush_seconds",
+    "pio_tpu_groupcommit_flush_seconds",
     "Group-commit leader flush duration",
     ("store",),
 )
 _BATCH_SIZE = REGISTRY.histogram(
-    "pio_groupcommit_batch_size",
+    "pio_tpu_groupcommit_batch_size",
     "Payloads coalesced per group-commit flush",
     ("store",),
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
@@ -99,8 +100,8 @@ class GroupCommitter:
         self._flush = flush
         self._store = store
         self._q: List[_Item] = []
-        self._qlock = threading.Lock()
-        self._commit_lock = threading.Lock()
+        self._qlock = make_lock(f"groupcommit.{store}.qlock")
+        self._commit_lock = make_lock(f"groupcommit.{store}.commit")
 
     def submit(self, payload):
         item = _Item(payload)

@@ -124,7 +124,9 @@ class SQLiteClient:
         with self._init_lock:
             conn = self.conn()
             self._migrate(conn)
-            conn.commit()
+            # one-shot schema migration: serializing the commit is the
+            # point (concurrent first-openers must not race the DDL)
+            conn.commit()  # pio: disable=lock-blocking-call
 
     @staticmethod
     def _migrate(conn) -> None:
