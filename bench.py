@@ -558,26 +558,26 @@ def _metrics_delta(before, after) -> dict:
     fam_sum = lambda pm, name: sum(pm.family(name).values())
     out = {
         "queries": int(
-            fam_sum(after, "pio_queries_total")
-            - fam_sum(before, "pio_queries_total")
+            fam_sum(after, "pio_tpu_queries_total")
+            - fam_sum(before, "pio_tpu_queries_total")
         ),
         "errors": int(
-            fam_sum(after, "pio_query_errors_total")
-            - fam_sum(before, "pio_query_errors_total")
+            fam_sum(after, "pio_tpu_query_errors_total")
+            - fam_sum(before, "pio_tpu_query_errors_total")
         ),
     }
     stages: dict = {}
     for ls, cnt_after in after.family(
-        "pio_query_stage_seconds_count"
+        "pio_tpu_query_stage_seconds_count"
     ).items():
         d = dict(ls)
         stage = d.pop("stage", "?")
         d["stage"] = stage
         dn = cnt_after - (
-            before.value("pio_query_stage_seconds_count", **d) or 0.0
+            before.value("pio_tpu_query_stage_seconds_count", **d) or 0.0
         )
-        ds = (after.value("pio_query_stage_seconds_sum", **d) or 0.0) - (
-            before.value("pio_query_stage_seconds_sum", **d) or 0.0
+        ds = (after.value("pio_tpu_query_stage_seconds_sum", **d) or 0.0) - (
+            before.value("pio_tpu_query_stage_seconds_sum", **d) or 0.0
         )
         if dn > 0:  # aggregate across engine_id label values
             prev_n, prev_s = stages.get(stage, (0.0, 0.0))

@@ -40,12 +40,10 @@ class DeviceProfileHook:
 
     @classmethod
     def from_env(cls) -> "DeviceProfileHook":
+        from pio_tpu.utils.envutil import env_int
+
         directory = os.environ.get(ENV_DIR, "")
-        try:
-            first_n = int(os.environ.get(ENV_N, "8"))
-        except ValueError:
-            first_n = 8
-        return cls(directory, max(1, first_n))
+        return cls(directory, env_int(ENV_N, 8, positive=True))
 
     @property
     def enabled(self) -> bool:

@@ -212,9 +212,9 @@ def install(stream: Optional[io.TextIOBase] = None,
         if _handler is None:
             if stream is None and os.environ.get("PIO_TPU_LOG_JSON") == "1":
                 stream = sys.stderr
-            ring = LogRing(
-                int(os.environ.get("PIO_TPU_LOG_RING", DEFAULT_RING))
-            )
+            from pio_tpu.utils.envutil import env_int
+
+            ring = LogRing(env_int("PIO_TPU_LOG_RING", DEFAULT_RING))
             _handler = JsonLogHandler(ring, stream=stream, worker=worker)
             target = logging.getLogger(logger_name)
             target.addHandler(_handler)

@@ -40,7 +40,8 @@ class Trace:
     def __init__(self, trace_id: str, kind: str):
         self.trace_id = trace_id
         self.kind = kind
-        self.wall_time = time.time()
+        # display timestamp for /traces.json; durations use t0 below
+        self.wall_time = time.time()  # pio: disable=wallclock-duration
         self.t0 = monotonic_s()
         self.total_s: Optional[float] = None
         self.spans: List[Tuple[str, float, float]] = []  # (stage, rel_s, dur)
@@ -134,7 +135,7 @@ class Tracer:
         if registry is not None:
             labelnames = tuple(self._extra) + ("stage",)
             self._hist = registry.histogram(
-                f"pio_{name}_stage_seconds",
+                f"pio_tpu_{name}_stage_seconds",
                 f"Per-stage wall seconds of the {name} path",
                 labelnames,
                 buckets=buckets,
@@ -180,7 +181,7 @@ class Tracer:
     # -- inspection --------------------------------------------------------
     @property
     def stage_histogram(self):
-        """The ``pio_<name>_stage_seconds`` histogram (None when the
+        """The ``pio_tpu_<name>_stage_seconds`` histogram (None when the
         tracer was built without a registry)."""
         return self._hist
 

@@ -214,11 +214,11 @@ _PALLAS_OVER_MB_DEFAULT = 2048.0
 
 
 def _pallas_cutoff_bytes() -> float:
-    import os
+    from pio_tpu.utils.envutil import env_float
 
-    return float(os.environ.get(
+    return env_float(
         "PIO_TPU_EMBED_PALLAS_OVER_MB", _PALLAS_OVER_MB_DEFAULT
-    )) * 2 ** 20
+    ) * 2 ** 20
 
 
 def _use_pallas(table) -> bool:

@@ -65,7 +65,7 @@ class DashboardService:
         self.query_url = query_url.rstrip("/")
         self.obs = MetricsRegistry()
         self._pageviews = self.obs.counter(
-            "pio_dashboard_pageviews_total",
+            "pio_tpu_dashboard_pageviews_total",
             "Dashboard page renders",
             ("page",),
         )
@@ -325,8 +325,8 @@ class DashboardService:
                 head + f"<p>scrape failed: {_html.escape(err)}</p>"
                 "</body></html>"
             )
-        total = sum(pm.family("pio_queries_total").values())
-        errors = sum(pm.family("pio_query_errors_total").values())
+        total = sum(pm.family("pio_tpu_queries_total").values())
+        errors = sum(pm.family("pio_tpu_query_errors_total").values())
         qps = None
         if status and status.get("startTime"):
             import datetime as _dt
@@ -347,16 +347,16 @@ class DashboardService:
         )
         # per-stage latency table from the stage histograms (pool-wide)
         stages: dict = {}
-        for ls, count in pm.family("pio_query_stage_seconds_count").items():
+        for ls, count in pm.family("pio_tpu_query_stage_seconds_count").items():
             d = dict(ls)
             stage = d.get("stage", "?")
-            total_s = pm.value("pio_query_stage_seconds_sum", **d) or 0.0
+            total_s = pm.value("pio_tpu_query_stage_seconds_sum", **d) or 0.0
             row = {
                 "count": int(count),
                 "avgMs": (total_s / count * 1e3) if count else None,
             }
             for col, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
-                v = pm.histogram_quantile("pio_query_stage_seconds", q, **d)
+                v = pm.histogram_quantile("pio_tpu_query_stage_seconds", q, **d)
                 row[col] = v * 1e3 if v is not None else None
             stages[stage] = row
         fmt = lambda v: f"{v:.3f}" if v is not None else "n/a"

@@ -24,38 +24,18 @@ from http.server import ThreadingHTTPServer
 from typing import Any, BinaryIO, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs
 
+from pio_tpu.utils import envutil
+
 log = logging.getLogger("pio_tpu.server")
 
 
 def _env_float(name: str, default: float) -> float:
-    """Float from the environment, falling back (with a warning) on a
-    malformed value — a typo'd limit must degrade to the default, not
-    kill every server at import time."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        v = float(raw)
-    except (TypeError, ValueError):
-        import warnings
-
-        warnings.warn(
-            f"{name}={raw!r} is not a number; using default {default:g}",
-            RuntimeWarning,
-            stacklevel=2,
-        )
-        return default
-    if v != v or v <= 0:  # NaN / non-positive caps would reject everything
-        import warnings
-
-        warnings.warn(
-            f"{name}={raw!r} must be a positive number; "
-            f"using default {default:g}",
-            RuntimeWarning,
-            stacklevel=2,
-        )
-        return default
-    return v
+    """Positive float from the environment, falling back (with a
+    warning) on a malformed value — a typo'd limit must degrade to the
+    default, not kill every server at import time. (The general helpers
+    live in :mod:`pio_tpu.utils.envutil`; body caps are always
+    positive.)"""
+    return envutil.env_float(name, default, positive=True)
 
 
 #: Reject request bodies above this many MiB with 413 (configurable —
@@ -251,7 +231,7 @@ def _http_date() -> str:
     """RFC 9110 Date header value, recomputed at most once per second —
     ``email.utils.formatdate`` costs more than the rest of a response."""
     global _date_cache
-    now = int(time.time())
+    now = int(time.time())  # pio: disable=wallclock-duration (Date header)
     if _date_cache[0] != now:
         import email.utils
 

@@ -46,6 +46,7 @@ import tempfile
 import time
 from typing import Optional
 
+from pio_tpu.obs.metrics import monotonic_s
 from pio_tpu.workflow.engine_json import EngineVariant
 
 log = logging.getLogger("pio_tpu.workerpool")
@@ -270,7 +271,7 @@ class ServingPool:
     def _spawn(self, idx: int):
         self._health_ports[idx] = 0  # stale port from a previous life
         self._health_fails[idx] = 0
-        self._spawned_at[idx] = time.monotonic()
+        self._spawned_at[idx] = monotonic_s()
         p = self._ctx.Process(
             target=_worker_main,
             args=(
@@ -296,13 +297,13 @@ class ServingPool:
         import urllib.error
         import urllib.request
 
-        deadline = time.monotonic() + timeout
+        deadline = monotonic_s() + timeout
         last_err: Optional[BaseException] = None
         probe_host = (
             "127.0.0.1" if self._host in ("", "0.0.0.0", "::")
             else self._host
         )
-        while time.monotonic() < deadline:
+        while monotonic_s() < deadline:
             if self._shutdown.is_set():
                 raise RuntimeError("pool shut down during startup")
             try:
@@ -379,12 +380,12 @@ class ServingPool:
         within budget, kill-and-respawn workers that fail /healthz
         ``_HEALTH_FAILS_TO_KILL`` polls in a row, then reap everything
         once the event fires."""
-        next_health = time.monotonic() + health_poll_s
+        next_health = monotonic_s() + health_poll_s
         while not self._shutdown.is_set():
-            if time.monotonic() >= next_health:
-                next_health = time.monotonic() + health_poll_s
+            if monotonic_s() >= next_health:
+                next_health = monotonic_s() + health_poll_s
                 self._health_sweep()
-            now = time.monotonic()
+            now = monotonic_s()
             for i, p in enumerate(self._procs):
                 if p.is_alive() or self._shutdown.is_set():
                     continue

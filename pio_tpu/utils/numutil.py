@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-import os
+from pio_tpu.utils.envutil import env_float
 
 
 def round_up(x: int, mult: int) -> int:
@@ -16,7 +16,7 @@ def n_stream_chunks(n_bytes: int, env_var: str, default: str = "8",
     chunk_mb)`` capped at ``cap``; 1 (streaming off) when the env knob
     is ≤ 0. Shared by the ALS single-device/mesh wires and the logreg
     feature wire so the threshold semantics can't drift."""
-    mb = float(os.environ.get(env_var, default))
+    mb = env_float(env_var, float(default))
     if mb <= 0:
         return 1
     return int(min(cap, -(-n_bytes // max(1, int(mb * 2 ** 20)))))

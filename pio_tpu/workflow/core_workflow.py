@@ -50,7 +50,7 @@ from pio_tpu.workflow.params import WorkflowParams
 log = logging.getLogger("pio_tpu.workflow")
 
 #: training-run tracer (process-global registry): every run lands in the
-#: ring (inspectable in-process) and feeds pio_train_stage_seconds
+#: ring (inspectable in-process) and feeds pio_tpu_train_stage_seconds
 #: histograms — stage labels are the engine.train timing keys
 #: (read / prepare / train:<algo>) plus "persist". Wide buckets: reads
 #: are milliseconds, ALS on a real corpus is minutes.
@@ -199,7 +199,7 @@ def run_train(
             train_s = monotonic_s() - t0
             # engine.train measured the phases; turn them into spans so
             # the run shows up in the trace ring AND the per-stage
-            # training histograms (pio_train_stage_seconds). The log
+            # training histograms (pio_tpu_train_stage_seconds). The log
             # lines ride inside the trace, so each carries its trace id —
             # /logs.json?trace_id= reassembles one run's full story.
             for phase, dur in timings.items():

@@ -106,8 +106,8 @@ class TestDashboard:
         assert status == 200
         assert headers["Content-Type"].startswith("text/plain")
         pm = parse_prometheus_text(text)
-        assert pm.types["pio_dashboard_pageviews_total"] == "counter"
-        assert pm.value("pio_dashboard_pageviews_total", page="index") == 1
+        assert pm.types["pio_tpu_dashboard_pageviews_total"] == "counter"
+        assert pm.value("pio_tpu_dashboard_pageviews_total", page="index") == 1
 
     def test_serving_view_unreachable_upstream(self, dashboard):
         """/serving.html degrades gracefully when no query server is up:

@@ -175,8 +175,8 @@ class TestTracer:
                 pass
             tr.add_span("b", 0.25)
         pm = render_parse(reg)
-        assert pm.value("pio_demo_stage_seconds_count", stage="a") == 1
-        assert pm.value("pio_demo_stage_seconds_sum", stage="b") == 0.25
+        assert pm.value("pio_tpu_demo_stage_seconds_count", stage="a") == 1
+        assert pm.value("pio_tpu_demo_stage_seconds_sum", stage="b") == 0.25
         recent = tracer.recent()
         assert len(recent) == 1
         t = recent[0]
@@ -207,8 +207,8 @@ class TestTracer:
         Tracer("pre", registry=reg, stages=("x", "y"))
         pm = render_parse(reg)
         # declared stages expose zero-count cells before any traffic
-        assert pm.value("pio_pre_stage_seconds_count", stage="x") == 0
-        assert pm.value("pio_pre_stage_seconds_count", stage="y") == 0
+        assert pm.value("pio_tpu_pre_stage_seconds_count", stage="x") == 0
+        assert pm.value("pio_tpu_pre_stage_seconds_count", stage="y") == 0
 
 
 @pytest.fixture()

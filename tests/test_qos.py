@@ -16,6 +16,7 @@ import pytest
 
 import pio_tpu.templates  # noqa: F401  (registers the factory)
 from pio_tpu.controller import ComputeContext
+from pio_tpu.obs import monotonic_s
 from pio_tpu.data import Event
 from pio_tpu.qos import (
     DEADLINE_HEADER,
@@ -240,11 +241,11 @@ class TestConcurrencyLimiter:
         ta = threading.Thread(target=waiter, args=("a", 0.05))
         tb = threading.Thread(target=waiter, args=("b", 30.0))
         ta.start()
-        deadline = time.time() + 5.0
-        while lim.queued < 1 and time.time() < deadline:
+        deadline = monotonic_s() + 5.0
+        while lim.queued < 1 and monotonic_s() < deadline:
             time.sleep(0.005)
         tb.start()
-        while lim.queued < 2 and time.time() < deadline:
+        while lim.queued < 2 and monotonic_s() < deadline:
             time.sleep(0.005)
         ta.join(5.0)
         assert out.get("a") == ConcurrencyLimiter.TIMEOUT

@@ -20,6 +20,8 @@ import urllib.request
 
 import pytest
 
+from pio_tpu.obs import monotonic_s
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -49,8 +51,8 @@ def _run(args, env, timeout=300):
 
 
 def _wait_http(url, timeout=60):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
+    deadline = monotonic_s() + timeout
+    while monotonic_s() < deadline:
         try:
             with urllib.request.urlopen(url, timeout=5) as r:
                 return json.loads(r.read())

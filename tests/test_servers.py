@@ -285,7 +285,7 @@ class TestEventServer:
             assert r.status == 200
             assert r.headers["Content-Type"].startswith("text/plain")
             text = r.read().decode()
-        assert "# TYPE pio_events_ingested_total counter" in text
+        assert "# TYPE pio_tpu_events_ingested_total counter" in text
         assert 'event="rate"' in text and 'status="201"' in text
 
     def test_metrics_round_trip_and_stage_histograms(
@@ -301,12 +301,12 @@ class TestEventServer:
         http("POST", f"{eventserver}/events.json?accessKey={key}", EV)
         with urllib.request.urlopen(f"{eventserver}/metrics", timeout=10) as r:
             pm = parse_prometheus_text(r.read().decode())
-        assert pm.types["pio_events_ingested_total"] == "counter"
-        assert pm.types["pio_event_stage_seconds"] == "histogram"
+        assert pm.types["pio_tpu_events_ingested_total"] == "counter"
+        assert pm.types["pio_tpu_event_stage_seconds"] == "histogram"
         for stage in ("parse", "validate", "store"):
-            assert pm.value("pio_event_stage_seconds_count", stage=stage) >= 1
+            assert pm.value("pio_tpu_event_stage_seconds_count", stage=stage) >= 1
         # bucket counts are cumulative => monotone non-decreasing
-        buckets = pm.histogram_buckets("pio_event_stage_seconds", stage="store")
+        buckets = pm.histogram_buckets("pio_tpu_event_stage_seconds", stage="store")
         cums = [c for _, c in buckets]
         assert cums == sorted(cums) and cums[-1] >= 1
 
@@ -607,7 +607,7 @@ class TestQueryServer:
         with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
             assert r.status == 200
             text = r.read().decode()
-        assert "pio_queries_total{" in text
+        assert "pio_tpu_queries_total{" in text
         assert 'quantile="0.95"' in text
 
     def test_stage_histograms_after_query(self, queryserver):
@@ -622,20 +622,20 @@ class TestQueryServer:
         http("POST", f"{url}/queries.json", {"user": "u1", "num": 2})
         with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
             pm = parse_prometheus_text(r.read().decode())
-        assert pm.value("pio_queries_total", engine_id="rec-srv") >= 1
-        assert pm.types["pio_query_stage_seconds"] == "histogram"
+        assert pm.value("pio_tpu_queries_total", engine_id="rec-srv") >= 1
+        assert pm.types["pio_tpu_query_stage_seconds"] == "histogram"
         for stage in ("parse", "queue", "execute", "serialize"):
             assert pm.value(
-                "pio_query_stage_seconds_count",
+                "pio_tpu_query_stage_seconds_count",
                 engine_id="rec-srv", stage=stage,
             ) >= 1, f"stage {stage} never observed"
         buckets = pm.histogram_buckets(
-            "pio_query_stage_seconds", engine_id="rec-srv", stage="execute"
+            "pio_tpu_query_stage_seconds", engine_id="rec-srv", stage="execute"
         )
         cums = [c for _, c in buckets]
         assert cums == sorted(cums) and cums[-1] >= 1
         # legacy summary surface still present alongside the histograms
-        assert pm.value("pio_query_latency_ms_count", engine_id="rec-srv") >= 1
+        assert pm.value("pio_tpu_query_latency_ms_count", engine_id="rec-srv") >= 1
 
     def test_stats_stages_and_window(self, queryserver):
         url, _, _ = queryserver
@@ -694,12 +694,12 @@ class TestQueryServer:
                 pm = parse_prometheus_text(r.read().decode())
             for stage in ("queue", "execute"):
                 assert pm.value(
-                    "pio_query_stage_seconds_count",
+                    "pio_tpu_query_stage_seconds_count",
                     engine_id="rec-srv", stage=stage,
                 ) >= 4
             # queue times are real waits, not zero-stamped
             assert pm.value(
-                "pio_query_stage_seconds_sum",
+                "pio_tpu_query_stage_seconds_sum",
                 engine_id="rec-srv", stage="queue",
             ) > 0
         finally:
@@ -818,7 +818,7 @@ class TestOpsEndpoints:
     def test_slo_json_from_live_histograms(self, app_and_key):
         """Acceptance: with --slo p99=50ms:99.9 declared, /slo.json
         reports burn rate and remaining error budget computed from the
-        live pio_request_seconds histogram, and the same numbers export
+        live pio_tpu_request_seconds histogram, and the same numbers export
         as gauges on /metrics."""
         app_id, _ = app_and_key
         variant, ctx, _ = _train(app_id)

@@ -17,6 +17,7 @@ import pytest
 
 import pio_tpu.templates  # noqa: F401  (registers the engine factory)
 from pio_tpu.controller import ComputeContext
+from pio_tpu.obs import monotonic_s
 from pio_tpu.data import Event
 from pio_tpu.storage import App, Storage
 from pio_tpu.workflow import build_engine, run_train, variant_from_dict
@@ -157,7 +158,7 @@ class TestServingPool:
             finally:
                 conn.close()
 
-        base = scrape().value("pio_queries_total", engine_id="pool-e2e")
+        base = scrape().value("pio_tpu_queries_total", engine_id="pool-e2e")
         N = 20
         workers_seen = set()
         for _ in range(N):
@@ -171,13 +172,13 @@ class TestServingPool:
         for _ in range(6):
             pm = scrape()
             assert pm.value(
-                "pio_queries_total", engine_id="pool-e2e"
+                "pio_tpu_queries_total", engine_id="pool-e2e"
             ) == base + N
         assert len(workers_seen) == 2, workers_seen
         # stage histograms aggregate the same way: every request passed
         # through execute exactly once, whichever worker served it
         assert pm.value(
-            "pio_query_stage_seconds_count",
+            "pio_tpu_query_stage_seconds_count",
             engine_id="pool-e2e", stage="execute",
         ) >= base + N
         # /stats.json carries the pool block alongside per-worker stats
@@ -215,8 +216,8 @@ class TestServingPool:
         victim = pool._procs[0]
         victim.terminate()
         victim.join(10)
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline:
+        deadline = monotonic_s() + 30
+        while monotonic_s() < deadline:
             if pool._procs[0] is not victim and pool._procs[0].is_alive():
                 break
             time.sleep(0.2)
@@ -241,8 +242,8 @@ class TestServingPool:
         import threading
 
         # every worker publishes its loopback health sidecar port
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline:
+        deadline = monotonic_s() + 30
+        while monotonic_s() < deadline:
             if all(p > 0 for p in pool._health_ports):
                 break
             time.sleep(0.2)
@@ -260,8 +261,8 @@ class TestServingPool:
         sup.start()
         victim = pool._procs[1]
         os.kill(victim.pid, signal.SIGSTOP)  # wedged, not dead
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
+        deadline = monotonic_s() + 60
+        while monotonic_s() < deadline:
             if pool._procs[1] is not victim and pool._procs[1].is_alive():
                 break
             time.sleep(0.2)
@@ -279,8 +280,8 @@ class TestServingPool:
         status, out = _post(pool.port, "/undeploy", {})
         assert status == 200
         # the shared event reaches the supervisor and every worker
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline:
+        deadline = monotonic_s() + 30
+        while monotonic_s() < deadline:
             if all(not p.is_alive() for p in pool._procs):
                 break
             time.sleep(0.2)
