@@ -33,6 +33,15 @@ export PIO_TPU_HOME="$WORKDIR/home"
 mkdir -p "$PIO_TPU_HOME"
 PORT_FILE="$WORKDIR/port"
 
+fail() { echo "FAIL: $*" >&2; exit 1; }
+
+# ------------------------------------------------------------------- lint
+# The project-native static analyzer must pass clean over the tree —
+# cheapest check first, no server boot needed.
+python -m pio_tpu.tools.cli lint pio_tpu tests \
+    || fail "pio lint found violations"
+echo "ok   pio lint clean"
+
 # Boot: train the recommendation template on a tiny in-memory corpus,
 # serve it with a declared SLO, publish the ephemeral port, then park.
 python - "$PORT_FILE" <<'PY' &
@@ -103,8 +112,6 @@ PORT="$(cat "$PORT_FILE")"
 BASE="http://127.0.0.1:$PORT"
 echo "server up on :$PORT"
 
-fail() { echo "FAIL: $*" >&2; exit 1; }
-
 check_json() {  # 200 + parseable JSON
     local path="$1"
     curl -fsS --max-time 10 "$BASE$path" | python -m json.tool >/dev/null \
@@ -152,8 +159,8 @@ echo "ok   /slo.json objectives"
 # /metrics must be Prometheus text with the core families present
 METRICS="$(curl -fsS --max-time 10 "$BASE/metrics")"
 for family in \
-    '# TYPE pio_queries_total counter' \
-    '# TYPE pio_request_seconds histogram' \
+    '# TYPE pio_tpu_queries_total counter' \
+    '# TYPE pio_tpu_request_seconds histogram' \
     '# TYPE pio_tpu_slo_error_budget_remaining gauge' \
     '# TYPE pio_tpu_log_messages_total counter'; do
     grep -qF "$family" <<<"$METRICS" || fail "/metrics missing '$family'"
@@ -201,6 +208,26 @@ echo "ok   shed accounted in /qos.json + /metrics"
 # injections must be visible on /faults.json and /metrics.
 CHAOS_PORT_FILE="$WORKDIR/chaos-port"
 CHAOS_KEY_FILE="$WORKDIR/chaos-key"
+
+# Before arming the spec, cross-check its point names against the lint
+# inventory of failpoint() call sites — a renamed point would otherwise
+# silently arm nothing and the chaos stage would stop testing anything.
+python -m pio_tpu.tools.cli lint --dump-failpoints pio_tpu | python -c '
+import json, sys
+inv = json.load(sys.stdin)["failpoints"]
+wanted = ["groupcommit.flush.sqlite", "storage.sqlite.commit"]
+for name in wanted:
+    for fp in inv:
+        point = fp["point"]
+        # dynamic points carry their static f-string prefix
+        if point == name or (fp["dynamic"] and name.startswith(point)):
+            break
+    else:
+        raise SystemExit(
+            f"chaos spec targets {name!r} but no failpoint() call site "
+            f"matches it — inventory: {sorted(f['point'] for f in inv)}")
+' || fail "chaos spec references a failpoint that no longer exists"
+echo "ok   chaos spec failpoints exist in the lint inventory"
 PIO_TPU_FAULTS='groupcommit.flush.sqlite=latency:10ms,storage.sqlite.commit=error:0.1' \
 python - "$CHAOS_PORT_FILE" "$CHAOS_KEY_FILE" <<'PY' &
 import os

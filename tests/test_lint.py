@@ -1,0 +1,724 @@
+"""Golden fixtures for `pio lint` — one bad/clean pair per rule — plus
+the runtime lock-order detector's seeded-inversion tests.
+
+The bad code lives inside string literals written out to tmp files, so
+the linter parsing THIS file (the tier-1 clean gate runs over tests/)
+only sees string constants and stays clean.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from pio_tpu.analysis import run_lint
+from pio_tpu.analysis.core import all_rules
+
+
+def lint_src(tmp_path, source, *, name="fixture.py", rules=None, catalog=None):
+    """Write ``source`` to a tmp module and lint it, returning findings."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return run_lint([str(p)], rule_ids=rules, catalog=catalog)
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# framework basics
+
+
+class TestFramework:
+    def test_rule_registry_has_at_least_eight_rules(self):
+        rules = all_rules().values()
+        assert len(rules) >= 8
+        families = {r.family for r in rules}
+        assert families == {"concurrency", "convention"}
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        findings = lint_src(tmp_path, "def broken(:\n")
+        assert rule_ids(findings) == ["parse-error"]
+
+    def test_unknown_rule_id_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            lint_src(tmp_path, "x = 1\n", rules=["no-such-rule"])
+
+    def test_line_suppression(self, tmp_path):
+        src = """
+        import time
+
+        def f():
+            t = time.time()  # pio: disable=wallclock-duration
+            return t
+        """
+        assert lint_src(tmp_path, src, rules=["wallclock-duration"]) == []
+
+    def test_standalone_comment_suppresses_next_line(self, tmp_path):
+        src = """
+        import time
+
+        def f():
+            # pio: disable=wallclock-duration
+            return time.time()
+        """
+        assert lint_src(tmp_path, src, rules=["wallclock-duration"]) == []
+
+    def test_whole_file_suppression(self, tmp_path):
+        src = """
+        # pio: disable-file=wallclock-duration
+        import time
+
+        def f():
+            return time.time()
+        """
+        assert lint_src(tmp_path, src, rules=["wallclock-duration"]) == []
+
+    def test_suppression_marker_inside_string_is_inert(self, tmp_path):
+        src = '''
+        import time
+
+        def f():
+            s = "# pio: disable=wallclock-duration"
+            return time.time(), s
+        '''
+        findings = lint_src(tmp_path, src, rules=["wallclock-duration"])
+        assert rule_ids(findings) == ["wallclock-duration"]
+
+    def test_json_reporter_round_trips(self, tmp_path):
+        from pio_tpu.analysis.core import render_json
+
+        src = "import time\n\nx = time.time()\n"
+        findings = lint_src(tmp_path, src, rules=["wallclock-duration"])
+        doc = json.loads(render_json(findings))
+        assert doc["count"] == len(findings) == 1
+        assert doc["findings"][0]["rule"] == "wallclock-duration"
+
+    def test_cli_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\nx = time.time()\n")
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        rc_bad = subprocess.run(
+            [sys.executable, "-m", "pio_tpu.tools.cli", "lint", str(bad)],
+            capture_output=True,
+        ).returncode
+        rc_good = subprocess.run(
+            [sys.executable, "-m", "pio_tpu.tools.cli", "lint", str(good)],
+            capture_output=True,
+        ).returncode
+        assert (rc_bad, rc_good) == (1, 0)
+
+
+# ---------------------------------------------------------------------------
+# concurrency family
+
+
+class TestLockBlockingCall:
+    BAD = """
+    import threading
+    import time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def f(self):
+            with self._lock:
+                time.sleep(1.0)
+    """
+
+    CLEAN = """
+    import threading
+    import time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def f(self):
+            with self._lock:
+                x = 1
+            time.sleep(1.0)
+            return x
+    """
+
+    def test_bad_flagged(self, tmp_path):
+        findings = lint_src(tmp_path, self.BAD, rules=["lock-blocking-call"])
+        assert rule_ids(findings) == ["lock-blocking-call"]
+
+    def test_clean_passes(self, tmp_path):
+        assert lint_src(tmp_path, self.CLEAN,
+                        rules=["lock-blocking-call"]) == []
+
+    def test_subprocess_under_lock_flagged(self, tmp_path):
+        src = """
+        import subprocess
+        import threading
+
+        guard = threading.Lock()
+
+        def f():
+            with guard:
+                subprocess.run(["true"])
+        """
+        findings = lint_src(tmp_path, src, rules=["lock-blocking-call"])
+        assert rule_ids(findings) == ["lock-blocking-call"]
+
+    def test_nested_def_resets_lock_context(self, tmp_path):
+        # the closure is DEFINED under the lock but runs later
+        src = """
+        import threading
+        import time
+
+        guard = threading.Lock()
+
+        def f():
+            with guard:
+                def later():
+                    time.sleep(1.0)
+            return later
+        """
+        assert lint_src(tmp_path, src, rules=["lock-blocking-call"]) == []
+
+
+class TestCvWaitOutsideLoop:
+    BAD = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self.ready = False
+
+        def f(self):
+            with self._cv:
+                if not self.ready:
+                    self._cv.wait()
+    """
+
+    CLEAN = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self.ready = False
+
+        def f(self):
+            with self._cv:
+                while not self.ready:
+                    self._cv.wait()
+    """
+
+    def test_bad_flagged(self, tmp_path):
+        findings = lint_src(tmp_path, self.BAD, rules=["cv-wait-outside-loop"])
+        assert rule_ids(findings) == ["cv-wait-outside-loop"]
+
+    def test_clean_passes(self, tmp_path):
+        assert lint_src(tmp_path, self.CLEAN,
+                        rules=["cv-wait-outside-loop"]) == []
+
+    def test_wait_for_is_exempt(self, tmp_path):
+        src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def f(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self.ready)
+        """
+        assert lint_src(tmp_path, src, rules=["cv-wait-outside-loop"]) == []
+
+
+class TestCvNotifyUnlocked:
+    BAD = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._cv = threading.Condition()
+
+        def f(self):
+            self._cv.notify_all()
+    """
+
+    CLEAN = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._cv = threading.Condition()
+
+        def f(self):
+            with self._cv:
+                self._cv.notify_all()
+    """
+
+    def test_bad_flagged(self, tmp_path):
+        findings = lint_src(tmp_path, self.BAD, rules=["cv-notify-unlocked"])
+        assert rule_ids(findings) == ["cv-notify-unlocked"]
+
+    def test_clean_passes(self, tmp_path):
+        assert lint_src(tmp_path, self.CLEAN,
+                        rules=["cv-notify-unlocked"]) == []
+
+
+class TestLockOrderCycle:
+    BAD = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def ab(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def ba(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """
+
+    CLEAN = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def ab(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def ab_again(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+    """
+
+    def test_same_module_ab_ba_flagged(self, tmp_path):
+        findings = lint_src(tmp_path, self.BAD, rules=["lock-order-cycle"])
+        assert rule_ids(findings) == ["lock-order-cycle"]
+
+    def test_consistent_order_passes(self, tmp_path):
+        assert lint_src(tmp_path, self.CLEAN,
+                        rules=["lock-order-cycle"]) == []
+
+    def test_cycle_through_call_edge_flagged(self, tmp_path):
+        # ab() holds A and calls helper() which takes B; ba() nests B->A
+        # directly — the cycle only exists through the call summary.
+        src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def helper(self):
+                with self._b_lock:
+                    pass
+
+            def ab(self):
+                with self._a_lock:
+                    self.helper()
+
+            def ba(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """
+        findings = lint_src(tmp_path, src, rules=["lock-order-cycle"])
+        assert rule_ids(findings) == ["lock-order-cycle"]
+
+    def test_two_module_import_cycle_flagged(self, tmp_path):
+        # the cycle only exists across the import boundary: moda holds A
+        # and calls into modb (which takes B); modb holds B and calls
+        # back into moda (which takes A)
+        (tmp_path / "moda.py").write_text(textwrap.dedent("""
+            import threading
+
+            import modb
+
+            a_lock = threading.Lock()
+
+            def take_a():
+                with a_lock:
+                    pass
+
+            def a_then_b():
+                with a_lock:
+                    modb.take_b()
+        """))
+        (tmp_path / "modb.py").write_text(textwrap.dedent("""
+            import threading
+
+            import moda
+
+            b_lock = threading.Lock()
+
+            def take_b():
+                with b_lock:
+                    pass
+
+            def b_then_a():
+                with b_lock:
+                    moda.take_a()
+        """))
+        findings = run_lint([str(tmp_path)], rule_ids=["lock-order-cycle"])
+        assert rule_ids(findings) == ["lock-order-cycle"]
+
+
+# ---------------------------------------------------------------------------
+# convention family
+
+
+class TestReleaseInFinally:
+    BAD = """
+    def handler(gate, req):
+        admission = gate.admit(req)
+        do_work(req)
+        admission.release()
+    """
+
+    CLEAN = """
+    def handler(gate, req):
+        admission = gate.admit(req)
+        try:
+            do_work(req)
+        finally:
+            admission.release()
+    """
+
+    TRANSFER = """
+    def admit_then_auth(gate, req):
+        admission = gate.admit(req)
+        check_auth(req)
+        return admission
+    """
+
+    def test_bad_flagged(self, tmp_path):
+        findings = lint_src(tmp_path, self.BAD, rules=["release-in-finally"])
+        assert rule_ids(findings) == ["release-in-finally"]
+
+    def test_clean_passes(self, tmp_path):
+        assert lint_src(tmp_path, self.CLEAN,
+                        rules=["release-in-finally"]) == []
+
+    def test_ownership_transfer_passes(self, tmp_path):
+        assert lint_src(tmp_path, self.TRANSFER,
+                        rules=["release-in-finally"]) == []
+
+
+class TestMetricName:
+    CATALOG = {"pio_tpu_good_total", "pio_tpu_depth"}
+
+    def test_bad_prefix_flagged(self, tmp_path):
+        src = """
+        def setup(reg):
+            return reg.counter("requests_total", "desc")
+        """
+        findings = lint_src(tmp_path, src, rules=["metric-name"],
+                            catalog=self.CATALOG)
+        assert rule_ids(findings) == ["metric-name"]
+
+    def test_counter_missing_total_suffix_flagged(self, tmp_path):
+        src = """
+        def setup(reg):
+            return reg.counter("pio_tpu_requests", "desc")
+        """
+        findings = lint_src(tmp_path, src, rules=["metric-name"],
+                            catalog=self.CATALOG)
+        assert rule_ids(findings) == ["metric-name"]
+
+    def test_gauge_with_total_suffix_flagged(self, tmp_path):
+        src = """
+        def setup(reg):
+            return reg.gauge("pio_tpu_depth_total", "desc")
+        """
+        findings = lint_src(tmp_path, src, rules=["metric-name"],
+                            catalog=self.CATALOG)
+        assert rule_ids(findings) == ["metric-name"]
+
+    def test_uncatalogued_name_flagged(self, tmp_path):
+        src = """
+        def setup(reg):
+            return reg.counter("pio_tpu_undocumented_total", "desc")
+        """
+        findings = lint_src(tmp_path, src, rules=["metric-name"],
+                            catalog=self.CATALOG)
+        assert rule_ids(findings) == ["metric-name"]
+
+    def test_catalogued_names_pass(self, tmp_path):
+        src = """
+        def setup(reg):
+            c = reg.counter("pio_tpu_good_total", "desc")
+            g = reg.gauge("pio_tpu_depth", "desc")
+            return c, g
+        """
+        assert lint_src(tmp_path, src, rules=["metric-name"],
+                        catalog=self.CATALOG) == []
+
+
+class TestFailpointName:
+    def test_duplicate_name_flagged(self, tmp_path):
+        src = """
+        from pio_tpu.faults import failpoint
+
+        def a():
+            failpoint("storage.write")
+
+        def b():
+            failpoint("storage.write")
+        """
+        findings = lint_src(tmp_path, src, rules=["failpoint-name"])
+        assert rule_ids(findings) == ["failpoint-name"]
+
+    def test_bad_namespace_flagged(self, tmp_path):
+        src = """
+        from pio_tpu.faults import failpoint
+
+        def a():
+            failpoint("mystuff.write")
+        """
+        findings = lint_src(tmp_path, src, rules=["failpoint-name"])
+        assert rule_ids(findings) == ["failpoint-name"]
+
+    def test_unique_namespaced_names_pass(self, tmp_path):
+        src = """
+        from pio_tpu.faults import failpoint
+
+        def a():
+            failpoint("storage.write")
+
+        def b(store):
+            failpoint(f"groupcommit.flush.{store}")
+        """
+        assert lint_src(tmp_path, src, rules=["failpoint-name"]) == []
+
+
+class TestEnvHardening:
+    BAD = """
+    import os
+
+    def knob():
+        return float(os.environ.get("PIO_TPU_KNOB", "1.5"))
+    """
+
+    CLEAN = """
+    from pio_tpu.utils.envutil import env_float
+
+    def knob():
+        return env_float("PIO_TPU_KNOB", 1.5)
+    """
+
+    def test_bad_flagged(self, tmp_path):
+        findings = lint_src(tmp_path, self.BAD, rules=["env-hardening"])
+        assert rule_ids(findings) == ["env-hardening"]
+
+    def test_clean_passes(self, tmp_path):
+        assert lint_src(tmp_path, self.CLEAN, rules=["env-hardening"]) == []
+
+
+class TestWallclockDuration:
+    BAD = """
+    import time
+
+    def elapsed(fn):
+        t0 = time.monotonic()
+        fn()
+        return time.monotonic() - t0
+    """
+
+    CLEAN = """
+    from pio_tpu.obs import monotonic_s
+
+    def elapsed(fn):
+        t0 = monotonic_s()
+        fn()
+        return monotonic_s() - t0
+    """
+
+    def test_bad_flagged(self, tmp_path):
+        findings = lint_src(tmp_path, self.BAD, rules=["wallclock-duration"])
+        assert len(findings) == 2
+        assert rule_ids(findings) == ["wallclock-duration"]
+
+    def test_clean_passes(self, tmp_path):
+        assert lint_src(tmp_path, self.CLEAN,
+                        rules=["wallclock-duration"]) == []
+
+
+# ---------------------------------------------------------------------------
+# envutil behaviour backing the env-hardening rule
+
+
+class TestEnvUtil:
+    def test_garbage_warns_and_defaults(self, monkeypatch):
+        from pio_tpu.utils.envutil import env_float
+
+        monkeypatch.setenv("PIO_TPU_LINT_T_KNOB", "banana")
+        with pytest.warns(RuntimeWarning, match="PIO_TPU_LINT_T_KNOB"):
+            assert env_float("PIO_TPU_LINT_T_KNOB", 2.5) == 2.5
+
+    def test_positive_rejects_nonpositive(self, monkeypatch):
+        from pio_tpu.utils.envutil import env_int
+
+        monkeypatch.setenv("PIO_TPU_LINT_T_KNOB", "-3")
+        with pytest.warns(RuntimeWarning):
+            assert env_int("PIO_TPU_LINT_T_KNOB", 7, positive=True) == 7
+
+    def test_good_value_parses_silently(self, monkeypatch):
+        from pio_tpu.utils.envutil import env_float
+
+        monkeypatch.setenv("PIO_TPU_LINT_T_KNOB", "0.25")
+        assert env_float("PIO_TPU_LINT_T_KNOB", 9.0) == 0.25
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order detector
+
+
+class TestRuntimeDetector:
+    @pytest.fixture(autouse=True)
+    def armed(self, monkeypatch):
+        from pio_tpu.analysis.runtime import sync_debugger
+
+        monkeypatch.setenv("PIO_TPU_DEBUG_SYNC", "1")
+        sync_debugger().reset()
+        yield
+        sync_debugger().reset()
+
+    def test_seeded_ab_ba_inversion_raises(self):
+        from pio_tpu.analysis.runtime import (
+            LockOrderInversion, make_lock,
+        )
+
+        a = make_lock("lint_t.a")
+        b = make_lock("lint_t.b")
+        with a:
+            with b:
+                pass
+        with pytest.raises(LockOrderInversion, match="lint_t"):
+            with b:
+                with a:
+                    pass
+
+    def test_inversion_backs_out_the_lock(self):
+        from pio_tpu.analysis.runtime import (
+            LockOrderInversion, make_lock,
+        )
+
+        a = make_lock("lint_t.a")
+        b = make_lock("lint_t.b")
+        with a:
+            with b:
+                pass
+        with pytest.raises(LockOrderInversion):
+            with b:
+                with a:
+                    pass
+        # the raising acquire must not strand either lock
+        assert a.acquire(blocking=False)
+        a.release()
+        assert b.acquire(blocking=False)
+        b.release()
+
+    def test_log_mode_records_without_raising(self, monkeypatch):
+        from pio_tpu.analysis.runtime import make_lock, sync_debugger
+
+        monkeypatch.setenv("PIO_TPU_DEBUG_SYNC", "log")
+        a = make_lock("lint_t.a")
+        b = make_lock("lint_t.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert any("lint_t" in s for s in sync_debugger().inversions())
+
+    def test_consistent_order_is_silent(self):
+        from pio_tpu.analysis.runtime import make_lock, sync_debugger
+
+        a = make_lock("lint_t.a")
+        b = make_lock("lint_t.b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert sync_debugger().inversions() == []
+
+    def test_cross_thread_inversion_detected(self):
+        from pio_tpu.analysis.runtime import (
+            LockOrderInversion, make_lock, sync_debugger,
+        )
+
+        a = make_lock("lint_t.a")
+        b = make_lock("lint_t.b")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=ab)
+        t.start()
+        t.join()
+        with pytest.raises(LockOrderInversion):
+            with b:
+                with a:
+                    pass
+        assert len(sync_debugger().inversions()) == 1
+
+    def test_condition_wait_tracks_through_wrapper(self):
+        from pio_tpu.analysis.runtime import make_condition, sync_debugger
+
+        cv = make_condition("lint_t.cv")
+        done = []
+
+        def waiter():
+            with cv:
+                while not done:
+                    cv.wait(timeout=5.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cv:
+            done.append(True)
+            cv.notify_all()
+        t.join()
+        assert sync_debugger().inversions() == []
+
+    def test_rlock_reentry_records_nothing(self):
+        from pio_tpu.analysis.runtime import make_rlock, sync_debugger
+
+        r = make_rlock("lint_t.r")
+        with r:
+            with r:
+                pass
+        assert sync_debugger().edges() == []
+
+    def test_disarmed_returns_plain_primitives(self, monkeypatch):
+        from pio_tpu.analysis.runtime import make_lock, make_rlock
+
+        monkeypatch.setenv("PIO_TPU_DEBUG_SYNC", "0")
+        assert type(make_lock("x")) is type(threading.Lock())
+        assert type(make_rlock("x")) is type(threading.RLock())

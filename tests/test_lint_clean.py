@@ -1,0 +1,25 @@
+"""Tier-1 gate: the repo's own code must pass `pio lint` clean.
+
+This is the whole point of a project-native linter — every rule ships
+with the tree already conforming, so any finding here is a regression
+introduced by the change under test (or a rule bug; either way it
+blocks).
+"""
+
+from __future__ import annotations
+
+import os
+
+from pio_tpu.analysis import run_lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_is_lint_clean():
+    findings = run_lint([
+        os.path.join(REPO_ROOT, "pio_tpu"),
+        os.path.join(REPO_ROOT, "tests"),
+    ])
+    assert findings == [], "pio lint findings:\n" + "\n".join(
+        f.render() for f in findings
+    )
