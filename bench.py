@@ -305,6 +305,17 @@ def _bench_server_p50(factors, n_users: int, n_items: int,
         out["concurrent"] = _with_metrics_delta(
             server.port, lambda: _concurrent_stage(server.port, n_users)
         )
+        # per-stage latency budget of everything served above: where the
+        # e2e milliseconds went (accept→…→write), and how much of the
+        # average the stage spans actually attribute (the residual is the
+        # instrumentation's blind spot — the acceptance bar is ≥95%)
+        import urllib.request as _ur
+
+        with _ur.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/hotpath.json",
+            timeout=10,
+        ) as resp:
+            out["latency_budget"] = json.loads(resp.read().decode("utf-8"))
     finally:
         post.close()
         server.stop()
@@ -1436,6 +1447,9 @@ def build_summary(full: dict, full_path: str = "BENCH_FULL.json") -> dict:
         "pool_qps": get("serving", "pool", "qps"),
         "pool_workers": get("serving", "pool", "workers"),
         "host_cores": get("serving", "pool", "host_cores"),
+        "serving_attributed": get(
+            "serving", "latency_budget", "attributedFraction"
+        ),
     }
     sec = full.get("secondary") or {}
     configs: dict = {}

@@ -1,5 +1,6 @@
 """Convention rules: metric naming/catalog agreement, failpoint
-uniqueness + namespaces, hardened env parsing, and the one-clock rule.
+uniqueness + namespaces, hardened env parsing, the one-clock rule, and
+the span-name convention.
 
 These encode project conventions that no general-purpose linter knows:
 
@@ -12,7 +13,10 @@ These encode project conventions that no general-purpose linter knows:
   default on garbage) instead of ``float(os.environ.get(...))``;
 * durations are measured with ``pio_tpu.obs.monotonic_s`` — raw
   ``time.time()`` / ``time.monotonic()`` calls are flagged (suppress
-  the rare true wall-clock use, e.g. an HTTP Date header).
+  the rare true wall-clock use, e.g. an HTTP Date header);
+* trace span/stage names are dot-scoped ``stage`` / ``stage.substage``
+  atoms of ``[a-z0-9_]`` — the /debug/hotpath.json budget math keys on
+  exactly this shape (top-level stages tile; dotted substages nest).
 """
 
 from __future__ import annotations
@@ -260,3 +264,66 @@ class WallclockDurationRule(Rule):
                 f"for durations (suppress if this is a true wall-clock "
                 f"read)",
             )
+
+
+# ---------------------------------------------------------------------------
+# rule: span-name convention
+
+_SPAN_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+#: span-recording entry points whose first positional arg is a name
+_SPAN_METHODS = ("span", "add_span", "add_active_span")
+
+
+@register
+class SpanNameRule(Rule):
+    id = "span-name"
+    family = "convention"
+    skip_tests = True
+    description = (
+        "Trace span/stage names must be dot-scoped [a-z0-9_] atoms "
+        "(`stage` or `stage.substage`) — /debug/hotpath.json budget "
+        "math treats undotted names as tiling top-level stages and "
+        "dotted ones as nested substages, so a stray name silently "
+        "corrupts the attribution sums. Checked at .span()/.add_span()/"
+        "add_active_span() literal call sites and *_STAGES/*_SUBSTAGES "
+        "tuple declarations."
+    )
+
+    def check(self, module: ModuleInfo, ctx: LintContext) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                if fname not in _SPAN_METHODS or not node.args:
+                    continue
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and not _SPAN_NAME_RE.match(arg.value)):
+                    yield Finding(
+                        self.id, module.display, node.lineno,
+                        node.col_offset,
+                        f"span name `{arg.value}` breaks the "
+                        f"`stage.substage` convention "
+                        f"([a-z0-9_] atoms joined by dots)",
+                    )
+            elif isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if not any(n.endswith(("_STAGES", "_SUBSTAGES"))
+                           for n in names):
+                    continue
+                if not isinstance(node.value, ast.Tuple):
+                    continue
+                for elt in node.value.elts:
+                    if (isinstance(elt, ast.Constant)
+                            and isinstance(elt.value, str)
+                            and not _SPAN_NAME_RE.match(elt.value)):
+                        yield Finding(
+                            self.id, module.display, elt.lineno,
+                            elt.col_offset,
+                            f"declared stage `{elt.value}` breaks the "
+                            f"`stage.substage` convention "
+                            f"([a-z0-9_] atoms joined by dots)",
+                        )

@@ -33,6 +33,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 import weakref
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -175,6 +176,23 @@ def _armed() -> bool:
     return _mode() not in ("", "0", "off")
 
 
+#: lock waits shorter than this never become spans — an uncontended
+#: acquire costs ~1 µs and would bury real stages in lock.* noise.
+LOCK_SPAN_MIN_S = 100e-6
+
+
+def _report_lock_wait(name: str, wait_s: float) -> None:
+    """Attach a ``lock.<name>`` span to the active request trace (armed
+    runs only — disarmed factories hand out plain primitives, so this
+    costs nothing in production). Lazy import: analysis must stay
+    importable without the obs stack."""
+    try:
+        from pio_tpu.obs.tracing import add_active_span
+    except Exception:
+        return
+    add_active_span(f"lock.{name}", wait_s)
+
+
 class _DebugBase:
     """Common acquire/release bookkeeping over an inner primitive."""
 
@@ -184,8 +202,12 @@ class _DebugBase:
         _DEBUGGER.register(self)
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t_req = time.perf_counter()
         ok = self._inner.acquire(blocking, timeout)
         if ok:
+            wait_s = time.perf_counter() - t_req
+            if wait_s >= LOCK_SPAN_MIN_S:
+                _report_lock_wait(self.name, wait_s)
             inversion = _DEBUGGER.on_acquired(self)
             if inversion is not None and _mode() != "log":
                 # back out so the raising thread doesn't strand the lock

@@ -55,8 +55,17 @@ from pio_tpu.obs.metrics import (
     monotonic_s,
 )
 from pio_tpu.obs.health import Heartbeat, HealthMonitor
+from pio_tpu.obs.hotpath import hotpath_payload
 from pio_tpu.obs.slo import SLOEngine, SLObjective, parse_duration_s, parse_slo
-from pio_tpu.obs.tracing import Trace, Tracer
+from pio_tpu.obs.tracing import (
+    TRACE_HEADER,
+    Trace,
+    Tracer,
+    active_trace,
+    add_active_span,
+    format_trace_header,
+    parse_trace_header,
+)
 
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS",
@@ -70,11 +79,17 @@ __all__ = [
     "RequestWindow",
     "SLOEngine",
     "SLObjective",
+    "TRACE_HEADER",
     "Trace",
     "Tracer",
+    "active_trace",
+    "add_active_span",
     "escape_help",
     "escape_label_value",
+    "format_trace_header",
+    "hotpath_payload",
     "monotonic_s",
-    "parse_duration_s",
+    "parse_trace_header",
     "parse_slo",
+    "parse_duration_s",
 ]

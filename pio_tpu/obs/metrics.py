@@ -208,10 +208,16 @@ class Gauge(_ScalarMetric):
 
 class _HistogramCell:
     """Fixed cumulative buckets + sum + count, with optional shm mirror
-    (buckets, sum and count each take one slot)."""
+    (buckets, sum and count each take one slot).
+
+    Each bucket also remembers the most recent *exemplar* — a trace id
+    and the observed value — so the text exposition can point at a
+    concrete ``/traces.json`` entry per latency band. Exemplars are
+    strings and stay LOCAL (the shm stripe is float64-only); in pool
+    mode each worker exposes its own."""
 
     __slots__ = ("_lock", "_edges", "_buckets", "_sum", "_count",
-                 "_seg", "_widx", "_slot0")
+                 "_seg", "_widx", "_slot0", "_exemplars")
 
     def __init__(self, edges: Tuple[float, ...]):
         self._lock = threading.Lock()
@@ -222,6 +228,7 @@ class _HistogramCell:
         self._seg = None
         self._widx = None
         self._slot0 = None
+        self._exemplars: Dict[int, Tuple[str, float]] = {}  # idx -> (id, v)
 
     def n_slots(self) -> int:
         return len(self._buckets) + 2  # buckets + sum + count
@@ -243,12 +250,14 @@ class _HistogramCell:
         self._seg.set(self._widx, self._slot0 + nb, self._sum)
         self._seg.set(self._widx, self._slot0 + nb + 1, float(self._count))
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         idx = bisect.bisect_left(self._edges, v)
         with self._lock:
             self._buckets[idx] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                self._exemplars[idx] = (str(exemplar), v)
             if self._seg is not None:
                 nb = len(self._buckets)
                 self._seg.set(
@@ -258,6 +267,10 @@ class _HistogramCell:
                 self._seg.set(
                     self._widx, self._slot0 + nb + 1, float(self._count)
                 )
+
+    def _exemplar_snapshot(self) -> Dict[int, Tuple[str, float]]:
+        with self._lock:
+            return dict(self._exemplars)
 
     def _snapshot(self, pool: bool) -> Tuple[List[int], float, int]:
         if pool and self._seg is not None:
@@ -328,20 +341,33 @@ class Histogram(_Metric):
     def _make_cell(self):
         return _HistogramCell(self.buckets)
 
-    def observe(self, v: float, **labels) -> None:
-        (self.labels(**labels) if labels else self._default_cell()).observe(v)
+    def observe(self, v: float, exemplar: Optional[str] = None,
+                **labels) -> None:
+        (self.labels(**labels) if labels
+         else self._default_cell()).observe(v, exemplar=exemplar)
 
     def samples(self, pool: bool = True) -> List[str]:
         out = []
         for values, cell in list(self._cells.items()):
             buckets, sum_, count = cell._snapshot(pool)
+            exemplars = cell._exemplar_snapshot()
             cum = 0
-            for edge, c in zip(self._edge_strs(), buckets):
+            for k, (edge, c) in enumerate(zip(self._edge_strs(), buckets)):
                 cum += c
                 ls = _label_str(
                     self.labelnames + ("le",), values + (edge,)
                 )
-                out.append(f"{self.name}_bucket{ls} {cum}")
+                line = f"{self.name}_bucket{ls} {cum}"
+                ex = exemplars.get(k)
+                if ex is not None:
+                    # OpenMetrics-style exemplar: the most recent trace
+                    # id observed into THIS bucket (non-cumulative)
+                    eid, ev = ex
+                    line += (
+                        f' # {{trace_id="{escape_label_value(eid)}"}}'
+                        f" {_fmt(ev)}"
+                    )
+                out.append(line)
             base = _label_str(self.labelnames, values)
             out.append(f"{self.name}_sum{base} {_fmt(sum_)}")
             out.append(f"{self.name}_count{base} {count}")

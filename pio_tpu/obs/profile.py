@@ -6,7 +6,12 @@ drown the trace and tax the hot path. This hook captures ONE
 ``jax.profiler`` trace covering the first N device executions after
 deploy (N from ``PIO_TPU_PROFILE_EXECUTIONS``, default 8: enough to see
 both the bucket-compile execution and warm steady-state dispatches),
-then gets out of the way permanently. View with tensorboard/xprof.
+then gets out of the way. On a long-lived deploy the interesting window
+is rarely the first N executions, so the hook can be re-armed at
+runtime: :meth:`DeviceProfileHook.restart` rotates the output into a
+numbered subdirectory (``capture-0001`` …) and captures the NEXT N
+executions — exposed as ``POST /debug/profile.json?restart=1`` on the
+query server. View with tensorboard/xprof.
 """
 
 from __future__ import annotations
@@ -37,6 +42,7 @@ class DeviceProfileHook:
         self._seen = 0
         self._active = False
         self._done = not directory
+        self._captures = 0  # completed/aborted capture windows
 
     @classmethod
     def from_env(cls) -> "DeviceProfileHook":
@@ -48,6 +54,51 @@ class DeviceProfileHook:
     @property
     def enabled(self) -> bool:
         return bool(self.directory) and not self._done
+
+    def to_dict(self) -> dict:
+        """Status for ``GET /debug/profile.json``."""
+        with self._lock:
+            return {
+                "configured": bool(self.directory),
+                "directory": self.directory,
+                "firstN": self.first_n,
+                "seen": self._seen,
+                "active": self._active,
+                "armed": bool(self.directory) and not self._done,
+                "captures": self._captures,
+            }
+
+    def restart(self, first_n: int = 0) -> dict:
+        """Re-arm for the next ``first_n`` (default: the configured N)
+        device executions, rotating output into a fresh numbered
+        subdirectory so earlier captures survive. Safe while a capture
+        is mid-flight — the active trace is stopped first."""
+        with self._lock:
+            if not self.directory:
+                return {"restarted": False,
+                        "message": f"{ENV_DIR} not configured"}
+            if self._active:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception:
+                    log.exception("profile stop during restart failed")
+                self._active = False
+            if first_n > 0:
+                self.first_n = first_n
+            self._captures += 1
+            base = self.directory.rstrip("/").rsplit("/capture-", 1)[0]
+            self.directory = os.path.join(
+                base, f"capture-{self._captures:04d}"
+            )
+            self._seen = 0
+            self._done = False
+            log.info(
+                "profile hook re-armed: next %d executions -> %s",
+                self.first_n, self.directory,
+            )
+        return self.to_dict() | {"restarted": True}
 
     @contextmanager
     def capture(self):

@@ -4,7 +4,9 @@ Shared by the test suite (round-tripping every ``/metrics`` endpoint),
 ``bench.py`` (server-side metric deltas embedded in the bench artifact)
 and the dashboard's serving view. Parses the subset the exposition
 spec defines for text format 0.0.4: ``# HELP``/``# TYPE`` comment lines
-and ``name{labels} value`` samples with escaped label values.
+and ``name{labels} value`` samples with escaped label values, plus the
+OpenMetrics-style exemplar suffix our histograms append to bucket lines
+(``... 42 # {trace_id="query-7"} 0.0042``).
 """
 
 from __future__ import annotations
@@ -21,11 +23,28 @@ class ParsedMetrics:
         self.samples: Dict[Tuple[str, LabelSet], float] = {}
         self.types: Dict[str, str] = {}
         self.helps: Dict[str, str] = {}
+        #: exemplars keyed like samples: (exemplar labels, exemplar value)
+        self.exemplars: Dict[
+            Tuple[str, LabelSet], Tuple[LabelSet, Optional[float]]
+        ] = {}
 
     def value(self, name: str, **labels) -> Optional[float]:
         return self.samples.get((name, frozenset(
             (k, str(v)) for k, v in labels.items()
         )))
+
+    def exemplar(self, name: str, **labels
+                 ) -> Optional[Tuple[Dict[str, str], Optional[float]]]:
+        """The exemplar attached to one sample line (bucket lines carry
+        them), as ``({label: value}, observed_value)`` — e.g.
+        ``({"trace_id": "query-7"}, 0.0042)``."""
+        got = self.exemplars.get((name, frozenset(
+            (k, str(v)) for k, v in labels.items()
+        )))
+        if got is None:
+            return None
+        ls, v = got
+        return dict(ls), v
 
     def family(self, name: str) -> Dict[LabelSet, float]:
         """Every sample of one metric name, keyed by label set."""
@@ -118,7 +137,18 @@ def parse_prometheus_text(text: str) -> ParsedMetrics:
             elif len(parts) >= 4 and parts[1] == "TYPE":
                 out.types[parts[2]] = parts[3]
             continue
-        # sample: name[{labels}] value [timestamp]
+        # sample: name[{labels}] value [timestamp] [# {exemplar} value]
+        exemplar = None
+        if " # " in line:
+            base, ex_str = line.split(" # ", 1)
+            if ex_str.startswith("{") and "}" in ex_str:
+                line = base.rstrip()
+                ex_labels_str, ex_rest = ex_str[1:].split("}", 1)
+                ex_parts = ex_rest.split()
+                exemplar = (
+                    _parse_labels(ex_labels_str),
+                    float(ex_parts[0]) if ex_parts else None,
+                )
         if "{" in line:
             name, rest = line.split("{", 1)
             labels_str, rest = rest.rsplit("}", 1)
@@ -133,4 +163,6 @@ def parse_prometheus_text(text: str) -> ParsedMetrics:
             else float(value_str)
         )
         out.samples[(name.strip(), labels)] = value
+        if exemplar is not None:
+            out.exemplars[(name.strip(), labels)] = exemplar
     return out

@@ -33,15 +33,20 @@ class Admission:
     :meth:`release` exactly once after the request finishes; when shed,
     ``reason`` names the cause and ``retry_after_s`` hints the client."""
 
-    __slots__ = ("ok", "reason", "retry_after_s", "_gate", "_released")
+    __slots__ = ("ok", "reason", "retry_after_s", "_gate", "_released",
+                 "queue_wait_s")
 
     def __init__(self, ok: bool, reason: Optional[str] = None,
-                 retry_after_s: float = 0.0, gate: "QoSGate" = None):
+                 retry_after_s: float = 0.0, gate: "QoSGate" = None,
+                 queue_wait_s: float = 0.0):
         self.ok = ok
         self.reason = reason
         self.retry_after_s = retry_after_s
         self._gate = gate
         self._released = False
+        #: seconds spent blocked in the concurrency limiter's admission
+        #: queue — the server turns this into an ``admit.queue`` span
+        self.queue_wait_s = queue_wait_s
 
     def release(self) -> None:
         if self.ok and not self._released and self._gate is not None:
@@ -183,11 +188,14 @@ class QoSGate:
             ok, retry = self.key_buckets.try_acquire(key, floor=floor)
             if not ok:
                 return Admission(False, "key_rate_limit", retry, self)
+        queue_wait_s = 0.0
         if self.limiter is not None:
             self.queue_gauge.set(
                 self.limiter.queued + 1, scope=self.scope
             )
+            t_enter = self._clock()
             outcome = self.limiter.enter(timeout_s)
+            queue_wait_s = self._clock() - t_enter
             self.queue_gauge.set(self.limiter.queued, scope=self.scope)
             if outcome != ConcurrencyLimiter.OK:
                 reason = (
@@ -197,9 +205,10 @@ class QoSGate:
                 )
                 # a full queue drains at roughly max_inflight per
                 # service time; 1s is an honest coarse hint
-                return Admission(False, reason, 1.0, self)
+                return Admission(False, reason, 1.0, self,
+                                 queue_wait_s=queue_wait_s)
             self.inflight_gauge.set(self.limiter.inflight, scope=self.scope)
-        return Admission(True, gate=self)
+        return Admission(True, gate=self, queue_wait_s=queue_wait_s)
 
     def _release(self) -> None:
         if self.limiter is not None:

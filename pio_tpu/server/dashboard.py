@@ -284,6 +284,44 @@ class DashboardService:
             )
         return "".join(parts)
 
+    def _hotpath_panel(self) -> str:
+        """Latency-attribution waterfall from /debug/hotpath.json: the
+        per-stage budget of the average request and how much of the e2e
+        latency the stages attribute (the residual is the
+        instrumentation's blind spot)."""
+        data = self._fetch_json("/debug/hotpath.json")
+        if not data or not data.get("stages"):
+            return (
+                "<h2>Hot-path budget</h2><p>no attributed requests yet "
+                "(<code>GET /debug/hotpath.json</code>)</p>"
+            )
+        fmt = lambda v: f"{v:.3f}" if v is not None else "n/a"
+        entries = [(s, "") for s in data["stages"]] + [
+            (s, "&nbsp;&nbsp;&#8627; ") for s in data.get("substages", [])
+        ]
+        rows = "".join(
+            f"<tr><td>{indent}{_html.escape(s['stage'])}</td>"
+            f"<td>{s['count']}</td>"
+            f"<td>{fmt(s.get('avgMs'))}</td><td>{fmt(s.get('p50Ms'))}</td>"
+            f"<td>{fmt(s.get('p95Ms'))}</td></tr>"
+            for s, indent in entries
+        )
+        frac = data.get("attributedFraction")
+        e2e = data.get("e2e") or {}
+        budget_line = (
+            f"<p>e2e avg {fmt(e2e.get('avgMs'))} ms &middot; attributed "
+            f"{fmt(data.get('attributedMsPerRequest'))} ms"
+            + (f" ({frac * 100:.1f}%)" if frac is not None else "")
+            + f" &middot; residual {fmt(data.get('residualMsPerRequest'))}"
+            f" ms over {data.get('requestCount', 0)} requests</p>"
+        )
+        return (
+            "<h2>Hot-path budget</h2>" + budget_line
+            + "<table><tr><th>stage</th><th>count</th>"
+            "<th>avg/req</th><th>p50</th><th>p95</th></tr>"
+            + rows + "</table>"
+        )
+
     def _log_panel(self, n: int = 25) -> str:
         """Live tail of the query server's structured log ring."""
         data = self._fetch_json(f"/logs.json?n={n}")
@@ -374,8 +412,9 @@ class DashboardService:
             + "</table>"
         )
         return 200, _html_response(
-            head + summary + stage_table + self._slo_panel()
-            + self._qos_panel() + self._log_panel() + "</body></html>"
+            head + summary + stage_table + self._hotpath_panel()
+            + self._slo_panel() + self._qos_panel() + self._log_panel()
+            + "</body></html>"
         )
 
 

@@ -30,7 +30,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from pio_tpu.analysis.runtime import make_lock
 from pio_tpu.data.event import Event, EventValidationError
 from pio_tpu.obs import (
-    HealthMonitor, MetricsRegistry, RequestWindow, Tracer, monotonic_s,
+    HealthMonitor, MetricsRegistry, RequestWindow, TRACE_HEADER, Tracer,
+    hotpath_payload, monotonic_s, parse_trace_header,
 )
 from pio_tpu.obs import slog
 from pio_tpu.obs.slo import engine_for_specs
@@ -39,7 +40,7 @@ from pio_tpu.qos import (
 )
 from pio_tpu.server.http import (
     HTTPError, JsonHTTPServer, Request, Router, float_param, int_param,
-    metrics_response,
+    json_response, metrics_response,
 )
 from pio_tpu.server.webhooks import (
     FORM_CONNECTORS,
@@ -58,9 +59,17 @@ MAX_BATCH = 50
 INPUT_BLOCKERS: List[Callable] = []
 INPUT_SNIFFERS: List[Callable] = []
 
-#: ingest-path trace stages, in request order (ISSUE 1): JSON → Event
-#: binding, whitelist + input blockers, storage insert/group-commit
-EVENT_STAGES = ("parse", "validate", "store")
+#: ingest-path trace stages, in request order: socket read + body parse
+#: (HTTP layer), QoS admission + auth, JSON → Event binding, whitelist +
+#: input blockers, storage insert/group-commit, response write. Top-level
+#: stages TILE the request (their durations sum to the end-to-end time);
+#: /debug/hotpath.json budgets against exactly that.
+EVENT_STAGES = ("accept", "admit", "parse", "validate", "store", "write")
+
+#: dotted substages attribute time WITHIN the store stage: queueing
+#: behind another leader's group-commit flush, and the flush that
+#: carried this event (both measured submitter-side in groupcommit).
+EVENT_SUBSTAGES = ("store.commit_wait", "store.flush")
 
 
 def _ms(v):
@@ -153,7 +162,21 @@ class EventServerService:
             ("engine_id",),
         )
         self._request_cell = self._request_hist.labels("eventserver")
-        self.tracer = Tracer("event", registry=self.obs, stages=EVENT_STAGES)
+        #: end-to-end latency (accept→write, from the post-write hook) —
+        #: the denominator of the /debug/hotpath.json attribution budget
+        self._e2e_hist = self.obs.histogram(
+            "pio_tpu_e2e_seconds",
+            "End-to-end wall seconds of the event write paths (socket "
+            "read through response write)",
+            ("engine_id",),
+        )
+        self._e2e_cell = self._e2e_hist.labels("eventserver")
+        self.tracer = Tracer(
+            "event", registry=self.obs,
+            stages=EVENT_STAGES + EVENT_SUBSTAGES,
+        )
+        # tail-based slow-trace capture (see query_server's twin)
+        self.tracer.slow_threshold_fn = self._slow_threshold_s
         self.req_window = RequestWindow()
         self.stats = _Stats(counter=self._events_counter)
         slog.install()
@@ -206,6 +229,7 @@ class EventServerService:
         r.add("GET", "/stats\\.json", self.get_stats)
         r.add("GET", "/metrics", self.get_metrics)
         r.add("GET", "/traces\\.json", self.get_traces)
+        r.add("GET", "/debug/hotpath\\.json", self.get_hotpath)
         r.add("GET", "/logs\\.json", self.get_logs)
         r.add("GET", "/slo\\.json", self.get_slo)
         r.add("GET", "/qos\\.json", self.get_qos)
@@ -448,22 +472,65 @@ class EventServerService:
     def _ingest_one(self, d: Any, app_id: int, channel_id, whitelist,
                     tr=None) -> str:
         event = self._validate_one(d, app_id, channel_id, whitelist, tr)
-        sp = tr.span if tr is not None else (lambda stage: nullcontext())
-        with sp("store"):
-            event_id = self._guarded_insert(
-                lambda: Storage.get_levents().insert(
-                    event, app_id, channel_id
-                )
+        rel_store = tr.elapsed_s if tr is not None else 0.0
+        event_id = self._guarded_insert(
+            lambda: Storage.get_levents().insert(
+                event, app_id, channel_id
             )
+        )
         self._post_ingest(d, event, app_id, channel_id)
+        if tr is not None:
+            # end-aligned through the post-ingest hooks (sniffers,
+            # per-app stats) so store tiles flush against write
+            tr.add_span(
+                "store", tr.elapsed_s - rel_store, rel_start_s=rel_store
+            )
         return event_id
 
+    def _begin_waterfall(self, tr, req: Request, t_start: float,
+                         t_admitted: float) -> None:
+        """Head of every write-path waterfall: the trace opens only
+        AFTER admission + auth, so rebase it to the socket read and
+        record the accept/admit window it missed."""
+        tr.rebase(req.read_s + (t_admitted - t_start))
+        tr.add_span("accept", req.read_s, rel_start_s=0.0)
+        # end-aligned to NOW, so the trace-open/rebase work just done
+        # stays inside the budget instead of leaking between spans
+        tr.add_span(
+            "admit", tr.elapsed_s - req.read_s, rel_start_s=req.read_s
+        )
+
+    def _arm_write_span(self, tr, req: Request) -> None:
+        """Tail of the waterfall: record the response write + the TRUE
+        end-to-end latency once the bytes hit the socket. The span is
+        anchored at HANDLER completion (arm time), not the socket write
+        — the return path between them is request time the top-level
+        stages must keep tiling."""
+        rel_done_s = tr.elapsed_s
+
+        def _written(write_s: float, _tr=tr, _rel=rel_done_s):
+            _tr.add_span("write", _tr.elapsed_s - _rel, rel_start_s=_rel)
+            _tr.extend_total()
+            self._e2e_cell.observe(_tr.elapsed_s, exemplar=_tr.trace_id)
+
+        req.on_written = _written
+
     def create_event(self, req: Request):
+        t_start = monotonic_s()
+        # cross-process propagation: a traced caller (e.g. the query
+        # server's feedback loop, or a bench client) names the trace this
+        # ingest joins — one id spans client, server, and commit leader
+        in_tid, in_parent = parse_trace_header(req.header(TRACE_HEADER))
         adm, app_id, channel_id, whitelist = self._admit_then_auth(req)
         t0 = monotonic_s()
         error = True
+        trace_id = None
         try:
-            with self.tracer.trace("event") as tr:
+            with self.tracer.trace(
+                "event", trace_id=in_tid, parent=in_parent
+            ) as tr:
+                trace_id = tr.trace_id
+                self._begin_waterfall(tr, req, t_start, t0)
                 try:
                     event_id = self._ingest_one(
                         req.body, app_id, channel_id, whitelist, tr
@@ -473,15 +540,20 @@ class EventServerService:
                     self.stats.tick(app_id, "<invalid>", "<invalid>", 400)
                     return 400, {"message": str(e)}
                 error = False
-                return 201, {"eventId": event_id}
+                self._arm_write_span(tr, req)
+                return 201, json_response(
+                    {"eventId": event_id}, {TRACE_HEADER: tr.trace_id}
+                )
         finally:
             if adm is not None:
                 adm.release()
             dur_s = monotonic_s() - t0
             self.req_window.record(dur_s * 1e3, error)
-            self._request_cell.observe(dur_s)
+            self._request_cell.observe(dur_s, exemplar=trace_id)
 
     def batch_events(self, req: Request):
+        t_start = monotonic_s()
+        in_tid, in_parent = parse_trace_header(req.header(TRACE_HEADER))
         adm, app_id, channel_id, whitelist = self._admit_then_auth(req)
         try:
             if not isinstance(req.body, list):
@@ -493,19 +565,26 @@ class EventServerService:
                 }
             t0 = monotonic_s()
             error = True
+            trace_id = None
             try:
                 with self.tracer.trace(
-                    "batch", batchSize=len(req.body)
+                    "batch", trace_id=in_tid, parent=in_parent,
+                    batchSize=len(req.body),
                 ) as tr:
-                    out = self._batch_events(
+                    trace_id = tr.trace_id
+                    self._begin_waterfall(tr, req, t_start, t0)
+                    status, results = self._batch_events(
                         req, app_id, channel_id, whitelist, tr
                     )
                     error = False
-                    return out
+                    self._arm_write_span(tr, req)
+                    return status, json_response(
+                        results, {TRACE_HEADER: tr.trace_id}
+                    )
             finally:
                 dur_s = monotonic_s() - t0
                 self.req_window.record(dur_s * 1e3, error)
-                self._request_cell.observe(dur_s)
+                self._request_cell.observe(dur_s, exemplar=trace_id)
         finally:
             if adm is not None:
                 adm.release()
@@ -660,14 +739,70 @@ class EventServerService:
     def get_metrics(self, req: Request):
         return 200, metrics_response(self.obs.render())
 
+    def _slow_threshold_s(self) -> Optional[float]:
+        """Slow-trace capture threshold in seconds (see the query
+        server's twin): env override, tightest latency SLO, or the live
+        p99 once the distribution has enough mass."""
+        from pio_tpu.utils import envutil
+
+        ms = envutil.env_float("PIO_TPU_SLOW_TRACE_MS", 0.0)
+        if ms > 0:
+            return ms / 1e3
+        slo = self.slo
+        if slo is not None:
+            thresholds = [
+                o.threshold_s for o in slo.objectives
+                if o.kind == "latency" and o.threshold_s
+            ]
+            if thresholds:
+                return min(thresholds)
+        cell = self._e2e_cell
+        if cell.count >= 64:
+            return cell.quantile(0.99, pool=False)
+        return None
+
+    def get_hotpath(self, req: Request):
+        """Per-stage latency budget of the ingest write paths (count/
+        avg/p50/p95 + attributed fraction of the end-to-end average)."""
+        return 200, hotpath_payload(
+            self.tracer, self._e2e_cell,
+            stage_order=EVENT_STAGES + EVENT_SUBSTAGES, pool=False,
+            slow_threshold_s=self._slow_threshold_s(),
+        )
+
     def get_traces(self, req: Request):
+        """Recent ingest traces, slowest first, MERGED with the group-
+        commit leader's flush traces (each links the member requests it
+        carried — the cross-process join of the event path). ``?slow=1``
+        serves the tail-capture ring; ``?id=`` looks up one trace across
+        the request, slow, and commit rings; ``?commits=0`` restricts to
+        request traces."""
+        from pio_tpu.storage.groupcommit import COMMIT_TRACER
+
         n = int_param(req.params, "n", 20, lo=0, hi=self.tracer._ring_cap)
+        tid = req.params.get("id")
+        if tid:
+            found = self.tracer.find(tid) or COMMIT_TRACER.find(tid)
+            if found is None:
+                raise HTTPError(404, f"trace {tid} not in any ring")
+            return 200, {"traces": [found]}
+        if req.params.get("slow") in ("1", "true"):
+            return 200, {"traces": self.tracer.slow(n)}
         order = req.params.get("order", "slowest")
-        return 200, {
-            "traces": self.tracer.recent(n, slowest=(order != "recent")),
-        }
+        slowest = order != "recent"
+        traces = self.tracer.recent(n, slowest=slowest)
+        if req.params.get("commits", "1") != "0":
+            traces += COMMIT_TRACER.recent(n, slowest=slowest)
+            key = (
+                (lambda t: t.get("totalMs") or 0.0) if slowest
+                else (lambda t: t.get("wallTime") or 0.0)
+            )
+            traces = sorted(traces, key=key, reverse=True)[:n]
+        return 200, {"traces": traces}
 
     def webhook_json(self, req: Request):
+        t_start = monotonic_s()
+        in_tid, in_parent = parse_trace_header(req.header(TRACE_HEADER))
         adm, app_id, channel_id, whitelist = self._admit_then_auth(req)
         try:
             connector = JSON_CONNECTORS.get(req.path_args[0])
@@ -681,8 +816,13 @@ class EventServerService:
                 }
             t0 = monotonic_s()
             error = True
+            trace_id = None
             try:
-                with self.tracer.trace("webhook") as tr:
+                with self.tracer.trace(
+                    "webhook", trace_id=in_tid, parent=in_parent
+                ) as tr:
+                    trace_id = tr.trace_id
+                    self._begin_waterfall(tr, req, t_start, t0)
                     try:
                         d = connector.to_event_dict(req.body or {})
                         event_id = self._ingest_one(
@@ -692,16 +832,21 @@ class EventServerService:
                         tr.mark_error()
                         return 400, {"message": str(e)}
                     error = False
-                    return 201, {"eventId": event_id}
+                    self._arm_write_span(tr, req)
+                    return 201, json_response(
+                        {"eventId": event_id}, {TRACE_HEADER: tr.trace_id}
+                    )
             finally:
                 dur_s = monotonic_s() - t0
                 self.req_window.record(dur_s * 1e3, error)
-                self._request_cell.observe(dur_s)
+                self._request_cell.observe(dur_s, exemplar=trace_id)
         finally:
             if adm is not None:
                 adm.release()
 
     def webhook_form(self, req: Request):
+        t_start = monotonic_s()
+        in_tid, in_parent = parse_trace_header(req.header(TRACE_HEADER))
         adm, app_id, channel_id, whitelist = self._admit_then_auth(req)
         try:
             connector = FORM_CONNECTORS.get(req.path_args[0])
@@ -716,8 +861,13 @@ class EventServerService:
             )
             t0 = monotonic_s()
             error = True
+            trace_id = None
             try:
-                with self.tracer.trace("webhook") as tr:
+                with self.tracer.trace(
+                    "webhook", trace_id=in_tid, parent=in_parent
+                ) as tr:
+                    trace_id = tr.trace_id
+                    self._begin_waterfall(tr, req, t_start, t0)
                     try:
                         d = connector.to_event_dict(form)
                         event_id = self._ingest_one(
@@ -727,11 +877,14 @@ class EventServerService:
                         tr.mark_error()
                         return 400, {"message": str(e)}
                     error = False
-                    return 201, {"eventId": event_id}
+                    self._arm_write_span(tr, req)
+                    return 201, json_response(
+                        {"eventId": event_id}, {TRACE_HEADER: tr.trace_id}
+                    )
             finally:
                 dur_s = monotonic_s() - t0
                 self.req_window.record(dur_s * 1e3, error)
-                self._request_cell.observe(dur_s)
+                self._request_cell.observe(dur_s, exemplar=trace_id)
         finally:
             if adm is not None:
                 adm.release()

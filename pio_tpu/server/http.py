@@ -24,6 +24,7 @@ from http.server import ThreadingHTTPServer
 from typing import Any, BinaryIO, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs
 
+from pio_tpu.obs.metrics import monotonic_s
 from pio_tpu.utils import envutil
 
 log = logging.getLogger("pio_tpu.server")
@@ -80,6 +81,14 @@ class Request:
     #: socket — for actions that must not race the reply (e.g. /undeploy
     #: stopping the server)
     after_response: Optional[Callable[[], None]] = None
+    #: seconds spent reading + parsing this request off the socket (first
+    #: request-line byte → body parsed) — the "accept" stage of a latency
+    #: waterfall. Excludes keep-alive idle wait before the request line.
+    read_s: float = 0.0
+    #: handler-settable hook called with the response-write duration in
+    #: seconds once the reply is flushed — the "write" stage (the handler
+    #: has long returned by then, so tracing needs a callback)
+    on_written: Optional[Callable[[float], None]] = None
 
     def header(self, name: str, default: Optional[str] = None):
         return self.headers.get(name.lower(), default)
@@ -355,6 +364,9 @@ def _make_handler_class(
             line = self.rfile.readline(65537)
             if not line:
                 return False  # client closed the keep-alive connection
+            # the accept clock starts once the request line has arrived —
+            # keep-alive idle time between requests is not request latency
+            t_accept = monotonic_s()
             if len(line) > 65536:
                 return self._reject(400, "request line too long")
             line = line.strip()
@@ -412,10 +424,12 @@ def _make_handler_class(
                 self.close_connection = "keep-alive" not in conn_tok
             else:
                 self.close_connection = "close" in conn_tok
-            self._dispatch(method, target, headers)
+            self._dispatch(method, target, headers, t_accept)
             return not self.close_connection
 
-        def _dispatch(self, method: str, target: str, headers: Dict[str, str]):
+        def _dispatch(self, method: str, target: str,
+                      headers: Dict[str, str],
+                      t_accept: Optional[float] = None):
             path, _, query = target.partition("?")
             params = (
                 {k: v[0] for k, v in parse_qs(query).items()}
@@ -535,6 +549,8 @@ def _make_handler_class(
                 headers=headers,
                 client_addr=self.client_address[0],
             )
+            if t_accept is not None:
+                req.read_s = monotonic_s() - t_accept
             try:
                 status, out = router.dispatch(req)
             except HTTPError as e:
@@ -549,7 +565,17 @@ def _make_handler_class(
             finally:
                 if body_file is not None:
                     body_file.close()
+            t_write = monotonic_s()
             self._respond(status, out)
+            if req.on_written is not None:
+                try:
+                    self.wfile.flush()
+                except OSError:
+                    pass
+                try:
+                    req.on_written(monotonic_s() - t_write)
+                except Exception:
+                    log.exception("on_written hook failed")
             if req.after_response is not None:
                 try:
                     self.wfile.flush()

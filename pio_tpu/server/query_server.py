@@ -32,8 +32,9 @@ from pio_tpu.controller.params import ParamsError, params_from_dict
 from pio_tpu.data.event import Event
 from pio_tpu.faults import failpoint
 from pio_tpu.obs import (
-    Heartbeat, HealthMonitor, MetricsRegistry, RequestWindow, Tracer,
-    monotonic_s,
+    Heartbeat, HealthMonitor, MetricsRegistry, RequestWindow, TRACE_HEADER,
+    Tracer, add_active_span, hotpath_payload, monotonic_s,
+    parse_trace_header,
 )
 from pio_tpu.obs import slog
 from pio_tpu.obs.profile import DeviceProfileHook
@@ -68,10 +69,20 @@ QUERY_SNIFFERS: List = []
 #: runs the per-query fallback itself (see _MicroBatcher.submit)
 _BATCH_FAILED = object()
 
-#: query-path trace stages, in request order (ISSUE 1): JSON binding +
+#: query-path trace stages, in request order: socket read + body parse
+#: (measured by the HTTP layer), QoS admission, JSON binding +
 #: serving.supplement, micro-batch queue wait, device/model execute,
-#: response serialization (to_jsonable + hooks + feedback)
-QUERY_STAGES = ("parse", "queue", "execute", "serialize")
+#: response serialization (to_jsonable + hooks + feedback), response
+#: write. Top-level stages TILE the request — their durations sum to the
+#: end-to-end latency — which is what /debug/hotpath.json budgets against.
+QUERY_STAGES = (
+    "accept", "admit", "parse", "queue", "execute", "serialize", "write",
+)
+
+#: dotted substages attribute time WITHIN a top-level stage (excluded
+#: from budget sums — the microseconds are already counted above).
+#: Pre-declared so their histogram cells exist at pool-bind time.
+QUERY_SUBSTAGES = ("admit.queue", "execute.device")
 
 
 def _q_ms(cell, q: float):
@@ -178,8 +189,9 @@ class _MicroBatcher:
             return out
         t0 = monotonic_s()
         # q, result, exc, done, enqueue_t, stage timings (worker-filled),
-        # deadline
-        pend = [query, None, None, threading.Event(), t0, {}, deadline]
+        # deadline, member trace id (the batch trace links its members)
+        pend = [query, None, None, threading.Event(), t0, {}, deadline,
+                span_sink.trace_id if span_sink is not None else None]
         with self._cv:
             if self._stopped:
                 raise HTTPError(503, "undeployed")
@@ -190,6 +202,10 @@ class _MicroBatcher:
             self._note_probe("batch", monotonic_s() - t0)
         if span_sink is not None and "queue_s" in pend[5]:
             span_sink.add_span("queue", pend[5]["queue_s"])
+        if span_sink is not None and "batch_id" in pend[5]:
+            # back-link: the member's waterfall names the batch trace
+            # whose execute span it shared
+            span_sink.note(microbatch=pend[5]["batch_id"])
         if pend[2] is _BATCH_FAILED:
             t1 = monotonic_s()
             out = self._service._predict_one(pend[0])
@@ -317,13 +333,23 @@ class _MicroBatcher:
             self.batched_queries += len(batch)
             self.max_batch = max(self.max_batch, len(batch))
             try:
-                results = self._service._predict_batch(
-                    [p[0] for p in batch]
-                )
+                # the batch dispatch gets ONE trace linking every member
+                # request trace — "which requests shared this dispatch"
+                # becomes answerable from /traces.json. Device time lands
+                # on it as execute.device via the active-trace contextvar.
+                with self._service.tracer.trace(
+                    "microbatch",
+                    links=[p[7] for p in batch if p[7]],
+                    batch=len(batch),
+                ) as btr:
+                    results = self._service._predict_batch(
+                        [p[0] for p in batch]
+                    )
                 exec_s = monotonic_s() - t_drain
                 for p, r in zip(batch, results):
                     p[1] = r
                     p[5]["execute_s"] = exec_s
+                    p[5]["batch_id"] = btr.trace_id
             except Exception:
                 log.exception(
                     "micro-batch dispatch failed; per-query fallback "
@@ -375,14 +401,29 @@ class QueryServerService:
             "Full-request wall seconds of /queries.json",
             ("engine_id",),
         )
+        #: end-to-end latency histogram (accept→write, stamped from the
+        #: post-write hook): what the CLIENT saw, and the denominator of
+        #: the /debug/hotpath.json attribution budget
+        self._e2e_hist = self.obs.histogram(
+            "pio_tpu_e2e_seconds",
+            "End-to-end wall seconds of /queries.json (socket read "
+            "through response write)",
+            ("engine_id",),
+        )
         # pre-create the cells so pool-mode slot layout sees them at init
         self._queries_total.labels(eng)
         self._query_errors_total.labels(eng)
         self._request_cell = self._request_hist.labels(eng)
+        self._e2e_cell = self._e2e_hist.labels(eng)
         self.tracer = Tracer(
-            "query", registry=self.obs, stages=QUERY_STAGES,
+            "query", registry=self.obs,
+            stages=QUERY_STAGES + QUERY_SUBSTAGES,
             extra_labels={"engine_id": eng},
         )
+        # tail-based slow-trace capture: threshold from (in order) the
+        # PIO_TPU_SLOW_TRACE_MS override, the tightest latency SLO, or
+        # the live p99 estimate once there is enough signal
+        self.tracer.slow_threshold_fn = self._slow_threshold_s
         self.stats = RequestWindow()
         self.obs.add_collector(self._compat_metric_lines)
         # structured-log ring (process-wide install is record-only; the
@@ -436,6 +477,7 @@ class QueryServerService:
         self._pool_size = None
         self._pool_gen = None
         self._pool_shutdown = None
+        self._sidecar_ports = None
         self._seen_gen = 0
         #: set via attach_server(); when present, /undeploy also stops the
         #: HTTP server shortly after responding (reference parity: `pio
@@ -462,6 +504,9 @@ class QueryServerService:
         r.add("GET", "/slo\\.json", self.get_slo)
         r.add("GET", "/qos\\.json", self.get_qos)
         r.add("GET", "/faults\\.json", self.get_faults)
+        r.add("GET", "/debug/hotpath\\.json", self.get_hotpath)
+        r.add("GET", "/debug/profile\\.json", self.get_profile)
+        r.add("POST", "/debug/profile\\.json", self.post_profile)
         r.add("GET", "/healthz", self.healthz)
         r.add("GET", "/readyz", self.readyz)
         r.add("POST", "/reload", self.reload)
@@ -617,8 +662,55 @@ class QueryServerService:
 
         return 200, installed_plugins()
 
+    def _slow_threshold_s(self) -> Optional[float]:
+        """The slow-trace capture threshold in seconds, or None while
+        there is no basis for one (fresh server, no SLO declared)."""
+        ms = envutil.env_float("PIO_TPU_SLOW_TRACE_MS", 0.0)
+        if ms > 0:
+            return ms / 1e3
+        slo = self.slo
+        if slo is not None:
+            thresholds = [
+                o.threshold_s for o in slo.objectives
+                if o.kind == "latency" and o.threshold_s
+            ]
+            if thresholds:
+                return min(thresholds)
+        # no declared objective: estimate p99 from the live distribution
+        # once it has enough mass to mean something
+        cell = self._e2e_cell
+        if cell.count >= 64:
+            return cell.quantile(0.99, pool=False)
+        return None
+
+    def get_hotpath(self, req: Request):
+        """Per-stage latency budget (count/avg/p50/p95 + attributed
+        fraction of the end-to-end average). ``?pool=0`` restricts a
+        pool worker's answer to its own stripe."""
+        pool = req.params.get("pool", "1") != "0"
+        return 200, hotpath_payload(
+            self.tracer, self._e2e_cell,
+            stage_order=QUERY_STAGES + QUERY_SUBSTAGES, pool=pool,
+            slow_threshold_s=self._slow_threshold_s(),
+        )
+
+    def get_profile(self, req: Request):
+        """Device-profiler hook status (captures, armed, directory)."""
+        return 200, self.profile_hook.to_dict()
+
+    def post_profile(self, req: Request):
+        """``?restart=1`` re-arms the first-N device-execution profiler
+        for another capture window (admin-gated: profiling taxes the hot
+        path and writes server-side files)."""
+        self._check_admin(req)
+        if req.params.get("restart") in ("1", "true"):
+            n = int_param(req.params, "n", 0, lo=0)
+            return 200, self.profile_hook.restart(n)
+        return 200, self.profile_hook.to_dict()
+
     def enable_pool(self, idx: int, size: int, gen, shutdown_evt,
-                    metrics_path: Optional[str] = None) -> None:
+                    metrics_path: Optional[str] = None,
+                    sidecar_ports=None) -> None:
         """Wire this worker into a serving pool: ``gen`` is a shared
         multiprocessing generation counter (a /reload on ANY worker bumps
         it; the others lazily reload before their next query), and
@@ -635,11 +727,18 @@ class QueryServerService:
         self._pool_gen = gen
         self._pool_shutdown = shutdown_evt
         self._seen_gen = gen.value
+        #: loopback sidecar ports of EVERY pool worker (shared array,
+        #: published as each worker's sidecar comes up) — the fan-out
+        #: path that lets /traces.json merge all workers' private rings
+        self._sidecar_ports = sidecar_ports
         # pool-mode probes: worker main loop beats the heartbeat; the
         # supervisor's /healthz poll catches a wedged loop. Readiness
         # additionally requires the shared metrics stripe (without it
         # this worker silently under-reports every pool-wide scrape).
         slog.set_worker(str(idx))
+        # pool-unique trace ids (query-w2-17): SO_REUSEPORT workers would
+        # otherwise mint colliding ids, making the merged view ambiguous
+        self.tracer.set_worker(idx)
         self.health.add_liveness("event_loop", self.heartbeat.check)
         self.health.add_readiness("pool_stripe", self._check_pool_stripe)
         if metrics_path:
@@ -683,6 +782,10 @@ class QueryServerService:
         adm = None
         deadline = None
         bcall = None
+        trace_id = None
+        # cross-process propagation: adopt the caller's trace id (and the
+        # span that issued the call) so one id names the whole waterfall
+        in_tid, in_parent = parse_trace_header(req.header(TRACE_HEADER))
         try:
             if self.qos is not None:
                 # deadline clock starts at receipt; a malformed header is
@@ -712,18 +815,52 @@ class QueryServerService:
                         out = self._shed(req, "breaker", bcall.retry_after_s)
                         error = False
                         return out
-            with self.tracer.trace("query") as tr:
-                # one consistent snapshot — a concurrent /reload must not
-                # mix the old engine's query class with the new engine's
-                # models. (The micro-batch path re-snapshots in the
-                # worker; the batch is served from that snapshot.)
+            t_admitted = monotonic_s()
+            with self.tracer.trace(
+                "query", trace_id=in_tid, parent=in_parent
+            ) as tr:
+                trace_id = tr.trace_id
+                # the trace opens only AFTER admission, but the request
+                # began at socket read: rebase so the waterfall shows
+                # accept at offset 0 instead of pretending the request
+                # started at parse
+                pre_s = req.read_s + (t_admitted - t0)
+                tr.rebase(pre_s)
+                tr.add_span("accept", req.read_s, rel_start_s=0.0)
+                # admit runs from read-end to NOW (not to t_admitted):
+                # the trace-open and rebase work just done is request
+                # time, and end-aligning the span to the parse start
+                # keeps the top-level stages tiling without overlap
+                if adm is not None and adm.queue_wait_s > 0:
+                    # time blocked in the concurrency limiter's queue —
+                    # the tail end of the admit window
+                    tr.add_span(
+                        "admit.queue", adm.queue_wait_s,
+                        rel_start_s=max(pre_s - adm.queue_wait_s, 0.0),
+                    )
+                rel_admit_end = tr.elapsed_s
+                tr.add_span(
+                    "admit", rel_admit_end - req.read_s,
+                    rel_start_s=req.read_s,
+                )
+                # one consistent snapshot — a concurrent /reload must
+                # not mix the old engine's query class with the new
+                # engine's models. (The micro-batch path re-snapshots
+                # in the worker; the batch is served from that
+                # snapshot.) Inside the parse span: swap-lock wait is
+                # request preparation time, and leaving it between
+                # spans would leak it from the budget.
                 with self._swap_lock:
                     pairs, serving, qc = (
                         self.pairs, self.serving, self.query_class
                     )
-                with tr.span("parse"):
-                    query = self._parse_query(req.body, qc)
-                    query = serving.supplement(query)
+                query = self._parse_query(req.body, qc)
+                query = serving.supplement(query)
+                rel_parse_end = tr.elapsed_s
+                tr.add_span(
+                    "parse", rel_parse_end - rel_admit_end,
+                    rel_start_s=rel_admit_end,
+                )
                 try:
                     if deadline is not None and deadline.expired():
                         # budget burned before execution (queue wait /
@@ -735,14 +872,29 @@ class QueryServerService:
                             query, span_sink=tr, deadline=deadline
                         )
                     else:
-                        tr.add_span("queue", 0.0)
-                        with tr.span("execute"):
-                            with self.profile_hook.capture():
-                                predictions = [
-                                    algo.predict(m, query)
-                                    for algo, m in pairs
-                                ]
-                            result = serving.serve(query, predictions)
+                        # no batcher: "queue" is just the pre-dispatch
+                        # bookkeeping (deadline check) between parse end
+                        # and execute start — end-aligned so the stages
+                        # tile with no gap in the hotpath budget
+                        rel_exec = tr.elapsed_s
+                        tr.add_span(
+                            "queue", rel_exec - rel_parse_end,
+                            rel_start_s=rel_parse_end,
+                        )
+                        t_dev = monotonic_s()
+                        with self.profile_hook.capture():
+                            predictions = [
+                                algo.predict(m, query)
+                                for algo, m in pairs
+                            ]
+                        tr.add_span(
+                            "execute.device", monotonic_s() - t_dev
+                        )
+                        result = serving.serve(query, predictions)
+                        tr.add_span(
+                            "execute", tr.elapsed_s - rel_exec,
+                            rel_start_s=rel_exec,
+                        )
                 except DeadlineExceeded:
                     out = self._shed(req, "deadline", 0.0)
                     error = False
@@ -753,28 +905,28 @@ class QueryServerService:
                     if bcall is not None:
                         bcall.failure()
                     raise
+                rel_ser = tr.elapsed_s
                 if bcall is not None:
                     bcall.success()
-                with tr.span("serialize"):
-                    out = _to_jsonable(result)
-                    for blocker in QUERY_BLOCKERS:
-                        try:
-                            # output blockers see (query, prediction) and
-                            # veto the response with ValueError → client 400
-                            blocker(req.body, out)
-                        except ValueError as e:
-                            raise HTTPError(400, str(e))
-                    pr_id = None
-                    if self.feedback:
-                        pr_id = uuid.uuid4().hex
-                        if isinstance(out, dict):
-                            out = {**out, "prId": pr_id}
-                        self._log_feedback(req.body, out, pr_id)
-                    for sniffer in QUERY_SNIFFERS:
-                        try:
-                            sniffer(req.body, out)
-                        except Exception:
-                            log.exception("query sniffer failed")
+                out = _to_jsonable(result)
+                for blocker in QUERY_BLOCKERS:
+                    try:
+                        # output blockers see (query, prediction) and
+                        # veto the response with ValueError → client 400
+                        blocker(req.body, out)
+                    except ValueError as e:
+                        raise HTTPError(400, str(e))
+                pr_id = None
+                if self.feedback:
+                    pr_id = uuid.uuid4().hex
+                    if isinstance(out, dict):
+                        out = {**out, "prId": pr_id}
+                    self._log_feedback(req.body, out, pr_id)
+                for sniffer in QUERY_SNIFFERS:
+                    try:
+                        sniffer(req.body, out)
+                    except Exception:
+                        log.exception("query sniffer failed")
                 if self.qos is not None and self.qos.stale is not None \
                         and req.body is not None:
                     # feed the degradation cache with the fresh answer
@@ -786,7 +938,40 @@ class QueryServerService:
                     "served query engine=%s ms=%.3f", eng,
                     (monotonic_s() - t0) * 1e3,
                 )
-                return 200, out
+                # serialize covers everything between the model result
+                # and handing the response to the writer — JSON
+                # conversion, blockers/sniffers, the stale-cache feed
+                # and the served-query log line — end-aligned so it
+                # tiles flush against both execute and write. The same
+                # mark anchors the write span at HANDLER completion,
+                # not at the socket write: the return path between them
+                # (router unwind, the finally block's accounting) is
+                # real request time, and leaving it between spans would
+                # break the tiling the hotpath budget sums over
+                rel_done_s = tr.elapsed_s
+                tr.add_span(
+                    "serialize", rel_done_s - rel_ser,
+                    rel_start_s=rel_ser,
+                )
+
+                def _written(write_s: float, _tr=tr, _rel=rel_done_s):
+                    # fires after the response bytes hit the socket: the
+                    # last stage of the waterfall, and the only moment
+                    # the TRUE end-to-end latency (accept→write) exists
+                    _tr.add_span(
+                        "write", _tr.elapsed_s - _rel, rel_start_s=_rel
+                    )
+                    _tr.extend_total()
+                    self._e2e_cell.observe(
+                        _tr.elapsed_s, exemplar=_tr.trace_id
+                    )
+
+                req.on_written = _written
+                # echo the id so an untraced caller learns which trace
+                # its request minted (and a traced one confirms adoption)
+                return 200, json_response(
+                    out, {TRACE_HEADER: tr.trace_id}
+                )
         finally:
             if bcall is not None:
                 # exits that never reached the scorer (parse 400,
@@ -799,7 +984,7 @@ class QueryServerService:
                 adm.release()
             dur_s = monotonic_s() - t0
             self.stats.record(dur_s * 1e3, error)
-            self._request_cell.observe(dur_s)
+            self._request_cell.observe(dur_s, exemplar=trace_id)
             self._queries_total.inc(engine_id=eng)
             if error:
                 self._query_errors_total.inc(engine_id=eng)
@@ -828,8 +1013,12 @@ class QueryServerService:
         failpoint("scorer.dispatch.solo")
         with self._swap_lock:
             pairs, serving = self.pairs, self.serving
+        t_dev = monotonic_s()
         with self.profile_hook.capture():
             predictions = [algo.predict(m, query) for algo, m in pairs]
+        # lands on whatever trace is active here: the request trace
+        # (solo/fallback path) — no-op when called untraced
+        add_active_span("execute.device", monotonic_s() - t_dev)
         return serving.serve(query, predictions)
 
     def _predict_batch(self, queries: list):
@@ -839,10 +1028,16 @@ class QueryServerService:
         with self._swap_lock:
             pairs, serving = self.pairs, self.serving
         per_algo = []
+        t_dev = monotonic_s()
         with self.profile_hook.capture():
             for algo, m in pairs:
                 got = dict(algo.batch_predict(m, list(enumerate(queries))))
                 per_algo.append([got[i] for i in range(len(queries))])
+        # one device observation per BATCH (on the microbatch trace via
+        # the active-trace contextvar) — per-member device cost is the
+        # amortization the batcher exists to buy, so attributing it once
+        # is the honest accounting
+        add_active_span("execute.device", monotonic_s() - t_dev)
         return [
             serving.serve(q, [pa[i] for pa in per_algo])
             for i, q in enumerate(queries)
@@ -943,12 +1138,81 @@ class QueryServerService:
 
     def get_traces(self, req: Request):
         """Recent request traces (ring buffer), slowest first. ``n`` is
-        clamped to the ring capacity; negatives/non-ints are a 400."""
+        clamped to the ring capacity; negatives/non-ints are a 400.
+
+        ``?slow=1`` serves the tail-capture ring (threshold breaches
+        only); ``?id=<trace_id>`` looks up ONE trace across both rings.
+        In pool mode every worker holds a private ring, so the answer is
+        merged across the pool via each sibling's loopback sidecar;
+        ``?local=1`` restricts to this worker (and is what the fan-out
+        itself sends, so forwarding cannot recurse)."""
         n = int_param(req.params, "n", 20, lo=0, hi=self.tracer._ring_cap)
-        order = req.params.get("order", "slowest")
-        return 200, {
-            "traces": self.tracer.recent(n, slowest=(order != "recent")),
-        }
+        local_only = req.params.get("local") == "1"
+        tid = req.params.get("id")
+        if tid:
+            found = self.tracer.find(tid)
+            if found is None and not local_only:
+                for t in self._pool_traces(req.params):
+                    if t.get("id") == tid:
+                        found = t
+                        break
+            if found is None:
+                raise HTTPError(404, f"trace {tid} not in any ring")
+            return 200, {"traces": [found]}
+        slow = req.params.get("slow") in ("1", "true")
+        if slow:
+            traces = self.tracer.slow(n)
+        else:
+            order = req.params.get("order", "slowest")
+            traces = self.tracer.recent(n, slowest=(order != "recent"))
+        if not local_only:
+            siblings = self._pool_traces(req.params)
+            if siblings:
+                merged = {t["id"]: t for t in traces}
+                for t in siblings:
+                    merged.setdefault(t.get("id"), t)
+                key = (
+                    (lambda t: t.get("wallTime") or 0.0)
+                    if (not slow and req.params.get("order") == "recent")
+                    else (lambda t: t.get("totalMs") or 0.0)
+                )
+                traces = sorted(
+                    merged.values(), key=key, reverse=True
+                )[:n]
+        return 200, {"traces": traces}
+
+    def _pool_traces(self, params) -> list:
+        """Fan ``/traces.json`` out to every SIBLING pool worker's
+        loopback sidecar and return their traces (empty outside pool
+        mode). The forwarded query carries ``local=1`` so a sibling
+        answers from its own ring instead of fanning out again. A worker
+        whose sidecar is still coming up (port 0) or mid-restart is
+        skipped — a partial merged view beats a 500."""
+        ports = self._sidecar_ports
+        if ports is None:
+            return []
+        import json as _json
+        from urllib.parse import urlencode
+        from urllib.request import urlopen
+
+        fwd = {k: v for k, v in dict(params).items() if k != "local"}
+        fwd["local"] = "1"
+        qs = urlencode(fwd)
+        out = []
+        for i in range(len(ports)):
+            port = ports[i]
+            if i == self._pool_idx or port <= 0:
+                continue
+            try:
+                with urlopen(
+                    f"http://127.0.0.1:{port}/traces.json?{qs}",
+                    timeout=0.5,
+                ) as resp:
+                    payload = _json.loads(resp.read().decode("utf-8"))
+                out.extend(payload.get("traces", []))
+            except Exception:
+                continue
+        return out
 
     def _check_admin(self, req: Request):
         if self.admin_key is not None:

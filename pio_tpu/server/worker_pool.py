@@ -109,6 +109,7 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt,
     service.enable_pool(
         idx, spec["n_workers"], gen, shutdown_evt,
         metrics_path=spec.get("metrics_path"),
+        sidecar_ports=health_ports,
     )
     service.attach_server(server)
     server.start()

@@ -27,7 +27,8 @@ from typing import Any, Callable, List, Sequence
 
 from pio_tpu.analysis.runtime import make_lock
 from pio_tpu.faults import failpoint
-from pio_tpu.obs import REGISTRY, monotonic_s
+from pio_tpu.obs import REGISTRY, Tracer, active_trace, monotonic_s
+from pio_tpu.obs.slog import current_trace_id
 
 #: leader flush duration + coalescing effectiveness, labelled by the
 #: owning store (process-global registry: storage has no HTTP surface of
@@ -42,6 +43,15 @@ _BATCH_SIZE = REGISTRY.histogram(
     "Payloads coalesced per group-commit flush",
     ("store",),
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+)
+
+#: one trace per leader flush, LINKING the member request traces — the
+#: cross-process join point of the event path's waterfall ("which
+#: requests rode this flush, and which flush did request X wait on").
+#: Feeds ``pio_tpu_commit_stage_seconds``; the event server merges this
+#: ring into its ``/traces.json``.
+COMMIT_TRACER = Tracer(
+    "commit", registry=REGISTRY, stages=("store.flush",), ring=64,
 )
 
 
@@ -72,13 +82,20 @@ class FlushProtocolError(RuntimeError):
 
 
 class _Item:
-    __slots__ = ("payload", "done", "result", "exc")
+    __slots__ = ("payload", "done", "result", "exc", "trace_id",
+                 "t_submit", "flush_s", "commit_id")
 
     def __init__(self, payload):
         self.payload = payload
         self.done = threading.Event()
         self.result = None
         self.exc: Any = None
+        # trace propagation: the submitting request's trace id rides the
+        # item so the leader's flush trace can link its batch-mates
+        self.trace_id = current_trace_id()
+        self.t_submit = monotonic_s()
+        self.flush_s = 0.0          # stamped by the leader
+        self.commit_id = None       # the flush trace that carried us
 
 
 class GroupCommitter:
@@ -120,52 +137,80 @@ class GroupCommitter:
                     self._q = []
                 t_flush = monotonic_s()
                 _BATCH_SIZE.observe(len(batch), store=self._store)
-                try:
-                    # inside the try so an injected error lands in the
-                    # generic handler (exercising the solo-retry path)
-                    # and an injected crash kills the leader MID-FLUSH —
-                    # the crash-consistency suite's SIGKILL moment
-                    failpoint(f"groupcommit.flush.{self._store}")
-                    # list() BEFORE the length check: a generator return
-                    # would raise TypeError on len() after the flush
-                    # already committed, and the generic handler's solo
-                    # retry would then duplicate every payload
-                    results = list(
-                        self._flush([i.payload for i in batch])
-                    )
-                    if len(results) != len(batch):
-                        raise FlushProtocolError(len(results), len(batch))
-                    for i, r in zip(batch, results):
-                        i.result = r
-                except FlushProtocolError as proto:
-                    for i in batch:
-                        i.exc = proto
-                except PartialFlushOutcome as partial:
-                    if len(partial.outcomes) != len(batch):
-                        proto = FlushProtocolError(
-                            len(partial.outcomes), len(batch)
+                # the leader's flush gets its own trace LINKING every
+                # member request — the event path's cross-process join
+                member_ids = [i.trace_id for i in batch if i.trace_id]
+                with COMMIT_TRACER.trace(
+                    "commit", links=member_ids,
+                    store=self._store, batch=len(batch),
+                ) as ctr:
+                    try:
+                        # inside the try so an injected error lands in the
+                        # generic handler (exercising the solo-retry path)
+                        # and an injected crash kills the leader MID-FLUSH —
+                        # the crash-consistency suite's SIGKILL moment
+                        failpoint(f"groupcommit.flush.{self._store}")
+                        # list() BEFORE the length check: a generator return
+                        # would raise TypeError on len() after the flush
+                        # already committed, and the generic handler's solo
+                        # retry would then duplicate every payload
+                        results = list(
+                            self._flush([i.payload for i in batch])
                         )
+                        if len(results) != len(batch):
+                            raise FlushProtocolError(
+                                len(results), len(batch)
+                            )
+                        for i, r in zip(batch, results):
+                            i.result = r
+                    except FlushProtocolError as proto:
                         for i in batch:
                             i.exc = proto
-                    else:
-                        for i, outcome in zip(batch, partial.outcomes):
-                            if isinstance(outcome, Exception):
-                                i.exc = outcome
-                            else:
-                                i.result = outcome
-                except Exception:
-                    for i in batch:  # isolate the poisoned payload
-                        try:
-                            i.result = self._flush([i.payload])[0]
-                        except Exception as exc:
-                            i.exc = exc
-                _FLUSH_SECONDS.observe(
-                    monotonic_s() - t_flush, store=self._store
-                )
+                    except PartialFlushOutcome as partial:
+                        if len(partial.outcomes) != len(batch):
+                            proto = FlushProtocolError(
+                                len(partial.outcomes), len(batch)
+                            )
+                            for i in batch:
+                                i.exc = proto
+                        else:
+                            for i, outcome in zip(batch, partial.outcomes):
+                                if isinstance(outcome, Exception):
+                                    i.exc = outcome
+                                else:
+                                    i.result = outcome
+                    except Exception:
+                        for i in batch:  # isolate the poisoned payload
+                            try:
+                                i.result = self._flush([i.payload])[0]
+                            except Exception as exc:
+                                i.exc = exc
+                    flush_s = monotonic_s() - t_flush
+                    ctr.add_span("store.flush", flush_s, rel_start_s=0.0)
+                    if any(i.exc is not None for i in batch):
+                        ctr.mark_error()
+                _FLUSH_SECONDS.observe(flush_s, store=self._store)
                 for i in batch:
+                    i.flush_s = flush_s
+                    i.commit_id = ctr.trace_id
                     i.done.set()
             finally:
                 self._commit_lock.release()
+        # attribute the submit on the SUBMITTING request's waterfall:
+        # commit_wait (queued behind another leader's flush) + flush
+        handle = active_trace()
+        if handle is not None:
+            total_s = monotonic_s() - item.t_submit
+            flush_s = min(item.flush_s, total_s)
+            wait_s = max(total_s - flush_s, 0.0)
+            rel = handle.elapsed_s - total_s
+            if wait_s >= 100e-6:
+                handle.add_span("store.commit_wait", wait_s,
+                                rel_start_s=rel)
+            handle.add_span("store.flush", flush_s,
+                            rel_start_s=rel + wait_s)
+            if item.commit_id:
+                handle.note(commit=item.commit_id)
         if item.exc is not None:
             raise item.exc
         return item.result

@@ -172,6 +172,50 @@ STATUS="$(curl -s -o /dev/null -w '%{http_code}' --max-time 10 "$BASE/logs.json?
 [ "$STATUS" = 400 ] || fail "/logs.json?n=-5 returned $STATUS, want 400"
 echo "ok   /logs.json?n=-5 -> 400"
 
+# ------------------------------------------------- latency attribution
+# an X-Pio-Trace we send must be adopted verbatim and echoed back, and
+# the adopted trace's full waterfall must be retrievable by id
+HDR="$(curl -fsS --max-time 10 -D - -o /dev/null \
+    -X POST -H 'Content-Type: application/json' \
+    -H 'X-Pio-Trace: smoke-trace-1' \
+    -d '{"user": "u1", "num": 3}' "$BASE/queries.json")" \
+    || fail "traced /queries.json POST failed"
+grep -qi '^X-Pio-Trace: smoke-trace-1' <<<"$HDR" \
+    || fail "response did not echo the adopted trace id (headers: $HDR)"
+curl -fsS --max-time 10 "$BASE/traces.json?id=smoke-trace-1" | python -c '
+import json, sys
+body = json.load(sys.stdin)
+stages = {s["stage"] for t in body["traces"] for s in t["spans"]}
+assert {"accept", "parse", "execute", "write"} <= stages, stages
+' || fail "/traces.json?id= did not return the adopted trace's waterfall"
+echo "ok   X-Pio-Trace adopted + waterfall retrievable by id"
+
+# the hot-path budget must attribute (stage sum ≈ e2e): the declared
+# bar is >=95% on the bench's steady-state load; this smoke run is a
+# cold server, so warm the average over a few extra requests (a single
+# cold request's scheduling noise can dominate its ~1 ms budget) and
+# gate at 80% — enough to catch a stage that silently stopped reporting
+for _ in 1 2 3 4 5 6; do
+    curl -fsS --max-time 10 -o /dev/null -X POST \
+        -H 'Content-Type: application/json' \
+        -d '{"user": "u1", "num": 3}' "$BASE/queries.json" \
+        || fail "hotpath warm-up POST failed"
+done
+sleep 0.3  # e2e lands in the post-write hook; let the last one settle
+curl -fsS --max-time 10 "$BASE/debug/hotpath.json" | python -c '
+import json, sys
+body = json.load(sys.stdin)
+assert body["requestCount"] >= 5, body
+stages = {s["stage"] for s in body["stages"]}
+assert {"accept", "admit", "parse", "queue", "execute", "serialize",
+        "write"} <= stages, stages
+frac = body["attributedFraction"]
+assert frac is not None and frac >= 0.80, (
+    f"hot-path stages attribute only {frac!r} of the e2e average "
+    f"(want >= 0.80): {json.dumps(body, indent=1)[:2000]}")
+' || fail "/debug/hotpath.json stage sum does not match e2e latency"
+echo "ok   /debug/hotpath.json attributes >=80% of e2e latency"
+
 # admission control: rapid-fire past the rps=2,burst=8 budget (LAST, so
 # drained tokens can't starve the checks above) and require at least one
 # 429 carrying a Retry-After hint

@@ -1,0 +1,483 @@
+"""Latency attribution (docs/observability.md "Latency attribution"):
+cross-process trace propagation via X-Pio-Trace, hot-path budget math,
+histogram exemplars round-tripped through promparse, slow-trace capture,
+the group-commit trace join, and profiler re-arming.
+
+Unit tiers run against bare Tracer/GroupCommitter instances; the HTTP
+tier uses the (cheap, training-free) event server. The query-server and
+pool-mode propagation paths are covered in test_servers.py and
+test_worker_pool.py, which already pay for model training."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pio_tpu.obs import MetricsRegistry, Tracer, monotonic_s
+from pio_tpu.obs.hotpath import hotpath_payload
+from pio_tpu.obs.profile import DeviceProfileHook
+from pio_tpu.obs.promparse import parse_prometheus_text
+from pio_tpu.obs.tracing import (
+    TRACE_HEADER,
+    add_active_span,
+    format_trace_header,
+    parse_trace_header,
+)
+from pio_tpu.storage import AccessKey, App, Storage
+from pio_tpu.storage.groupcommit import COMMIT_TRACER, GroupCommitter
+
+
+class TestTraceHeader:
+    def test_round_trip(self):
+        assert format_trace_header("query-7") == "query-7"
+        assert parse_trace_header("query-7") == ("query-7", None)
+
+    def test_parent_span_round_trip(self):
+        v = format_trace_header("query-7", "execute")
+        assert v == "query-7/execute"
+        assert parse_trace_header(v) == ("query-7", "execute")
+
+    @pytest.mark.parametrize("raw", [
+        None, "", "   ", "has space", "-leads-with-punct", "a" * 200,
+        'inject="label"',
+    ])
+    def test_malformed_is_fresh_trace_not_400(self, raw):
+        assert parse_trace_header(raw) == (None, None)
+
+    def test_bad_parent_dropped_id_kept(self):
+        assert parse_trace_header("ok-1/bad parent") == ("ok-1", None)
+        assert parse_trace_header("ok-1/") == ("ok-1", None)
+
+
+class TestTracerPropagation:
+    def test_adopts_inherited_id_and_parent(self):
+        tracer = Tracer("query")
+        with tracer.trace("query", trace_id="up-1", parent="dispatch") as tr:
+            assert tr.trace_id == "up-1"
+        d = tracer.find("up-1")
+        assert d is not None and d["parent"] == "dispatch"
+
+    def test_worker_namespaced_minted_ids(self):
+        tracer = Tracer("query")
+        tracer.set_worker(3)
+        with tracer.trace("query") as tr:
+            assert tr.trace_id.startswith("query-w3-")
+        assert tracer.recent(1)[0]["worker"] == 3
+
+    def test_rebase_extends_waterfall_backward(self):
+        tracer = Tracer("query")
+        with tracer.trace("query") as tr:
+            tr.add_span("parse", 0.001, rel_start_s=0.0)
+            tr.rebase(0.5)  # 500 ms of accept/admit before the trace
+            tr.add_span("accept", 0.5, rel_start_s=0.0)
+        d = tracer.recent(1)[0]
+        spans = {s["stage"]: s for s in d["spans"]}
+        assert spans["accept"]["startMs"] == 0.0
+        assert spans["parse"]["startMs"] == pytest.approx(500, abs=5)
+        assert d["totalMs"] >= 500
+
+    def test_extend_total_restamps_after_close(self):
+        tracer = Tracer("query")
+        with tracer.trace("query") as tr:
+            pass
+        closed_ms = tracer.recent(1)[0]["totalMs"]
+        time.sleep(0.01)
+        tr.add_span("write", 0.01)  # the post-flush response write
+        tr.extend_total()
+        assert tracer.recent(1)[0]["totalMs"] > closed_ms
+
+    def test_add_active_span_reaches_open_trace(self):
+        tracer = Tracer("query")
+        add_active_span("execute.device", 1.0)  # no active trace: no-op
+        with tracer.trace("query"):
+            add_active_span("execute.device", 0.002)
+        spans = [s["stage"] for s in tracer.recent(1)[0]["spans"]]
+        assert spans == ["execute.device"]
+
+    def test_links_and_meta(self):
+        tracer = Tracer("query")
+        with tracer.trace("microbatch", links=["m-1", "m-2"], batch=2) as tr:
+            tr.link("m-3")
+        d = tracer.recent(1)[0]
+        assert d["links"] == ["m-1", "m-2", "m-3"]
+        assert d["meta"]["batch"] == 2
+
+
+class TestSlowRing:
+    def test_breaches_are_captured_and_findable(self):
+        tracer = Tracer("query")
+        tracer.slow_threshold_fn = lambda: 0.0  # everything breaches
+        with tracer.trace("query", trace_id="slow-1"):
+            pass
+        got = tracer.slow(5)
+        assert [t["id"] for t in got] == ["slow-1"]
+        assert got[0]["slow"] is True
+        assert tracer.find("slow-1")["id"] == "slow-1"
+
+    def test_no_threshold_no_capture(self):
+        tracer = Tracer("query")
+        with tracer.trace("query"):
+            pass
+        assert tracer.slow(5) == []
+
+    def test_extend_total_rechecks_threshold(self):
+        # fast at close, slow once the response write is accounted
+        tracer = Tracer("query")
+        tracer.slow_threshold_fn = lambda: 10.0
+        with tracer.trace("query") as tr:
+            pass
+        assert tracer.slow(5) == []
+        tracer.slow_threshold_fn = lambda: 0.0
+        tr.extend_total()
+        assert len(tracer.slow(5)) == 1
+
+    def test_ring_bounded(self):
+        tracer = Tracer("query", slow_ring=4)
+        tracer.slow_threshold_fn = lambda: 0.0
+        for _ in range(9):
+            with tracer.trace("query"):
+                pass
+        assert len(tracer.slow(100)) == 4
+
+
+class TestExemplars:
+    def test_exposition_and_promparse_round_trip(self):
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            "pio_tpu_ex_seconds", "test", ("stage",),
+            buckets=(0.005, 0.05),
+        )
+        h.labels("parse").observe(0.004, exemplar="query-42")
+        h.labels("parse").observe(0.004)  # exemplar-less keeps the last id
+        text = "\n".join(reg.render())
+        assert '# {trace_id="query-42"} 0.004' in text
+        parsed = parse_prometheus_text(text)
+        got = parsed.exemplar(
+            "pio_tpu_ex_seconds_bucket", stage="parse", le="0.005"
+        )
+        assert got == ({"trace_id": "query-42"}, 0.004)
+        # the sample value itself still parses normally
+        assert parsed.value(
+            "pio_tpu_ex_seconds_bucket", stage="parse", le="0.005"
+        ) == 2
+
+    def test_no_exemplar_no_suffix(self):
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            "pio_tpu_noex_seconds", "test", buckets=(0.005, 0.05)
+        )
+        h.observe(0.004)
+        text = "\n".join(reg.render())
+        assert "trace_id" not in text
+        assert parse_prometheus_text(text).exemplar(
+            "pio_tpu_noex_seconds_bucket", le="0.005"
+        ) is None
+
+    def test_hostile_exemplar_id_escaped(self):
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            "pio_tpu_esc_seconds", "test", buckets=(0.005,)
+        )
+        h.observe(0.001, exemplar='a"b\\c')
+        got = parse_prometheus_text("\n".join(reg.render())).exemplar(
+            "pio_tpu_esc_seconds_bucket", le="0.005"
+        )
+        assert got[0] == {"trace_id": 'a"b\\c'}
+
+
+class TestHotpathPayload:
+    def _observed_path(self, n=10):
+        reg = MetricsRegistry()
+        tracer = Tracer("query", registry=reg,
+                        stages=("parse", "execute", "execute.device"))
+        e2e = reg.histogram(
+            "pio_tpu_e2e_seconds", "test", ("engine_id",)
+        ).labels("e")
+        for _ in range(n):
+            with tracer.trace("query") as tr:
+                tr.add_span("parse", 0.002, rel_start_s=0.0)
+                tr.add_span("execute", 0.008, rel_start_s=0.002)
+                tr.add_span("execute.device", 0.006, rel_start_s=0.003)
+            e2e.observe(0.010)
+        return tracer, e2e
+
+    def test_budget_attributes_stage_sums(self):
+        tracer, e2e = self._observed_path()
+        p = hotpath_payload(tracer, e2e, stage_order=("parse", "execute"),
+                            pool=False)
+        assert p["requestCount"] == 10
+        assert p["e2e"]["avgMs"] == pytest.approx(10.0)
+        by = {s["stage"]: s for s in p["stages"]}
+        assert list(by) == ["parse", "execute"]  # declared order kept
+        assert by["parse"]["avgMs"] == pytest.approx(2.0)
+        assert by["execute"]["avgMs"] == pytest.approx(8.0)
+        assert p["attributedMsPerRequest"] == pytest.approx(10.0)
+        assert p["attributedFraction"] == pytest.approx(1.0, abs=0.01)
+        assert p["residualMsPerRequest"] == pytest.approx(0.0, abs=0.1)
+
+    def test_substages_reported_but_excluded_from_sum(self):
+        tracer, e2e = self._observed_path()
+        p = hotpath_payload(tracer, e2e, pool=False)
+        subs = {s["stage"] for s in p["substages"]}
+        assert subs == {"execute.device"}
+        # counting execute.device would push attribution to 1.6
+        assert p["attributedFraction"] == pytest.approx(1.0, abs=0.01)
+
+    def test_partial_stage_amortized_over_all_requests(self):
+        # a stage that ran for 5 of 10 requests costs half per request
+        reg = MetricsRegistry()
+        tracer = Tracer("query", registry=reg, stages=("queue",))
+        e2e = reg.histogram("pio_tpu_e2e_seconds", "test")._default_cell()
+        for i in range(10):
+            with tracer.trace("query") as tr:
+                if i % 2 == 0:
+                    tr.add_span("queue", 0.004, rel_start_s=0.0)
+            e2e.observe(0.010)
+        p = hotpath_payload(tracer, e2e, pool=False)
+        by = {s["stage"]: s for s in p["stages"]}
+        assert by["queue"]["count"] == 5
+        assert by["queue"]["avgMs"] == pytest.approx(2.0)
+
+    def test_empty_path_and_threshold_passthrough(self):
+        reg = MetricsRegistry()
+        tracer = Tracer("query", registry=reg, stages=("parse",))
+        e2e = reg.histogram("pio_tpu_e2e_seconds", "test")._default_cell()
+        p = hotpath_payload(tracer, e2e, pool=False, slow_threshold_s=0.25)
+        assert p["requestCount"] == 0
+        assert p["e2e"]["avgMs"] is None
+        assert p["slowThresholdMs"] == 250.0
+        assert "attributedFraction" not in p
+
+
+class TestGroupCommitTraceJoin:
+    def test_submitter_and_leader_waterfalls_join(self):
+        tracer = Tracer("event")
+        gc = GroupCommitter(lambda batch: list(range(len(batch))),
+                            store="attr-test")
+        with tracer.trace("event", trace_id="evt-join-1"):
+            assert gc.submit({"n": 1}) == 0
+        d = tracer.find("evt-join-1")
+        stages = [s["stage"] for s in d["spans"]]
+        assert "store.flush" in stages
+        commit_id = d["meta"]["commit"]
+        cd = COMMIT_TRACER.find(commit_id)
+        assert cd is not None
+        assert "evt-join-1" in cd["links"]
+        assert [s["stage"] for s in cd["spans"]] == ["store.flush"]
+        assert cd["meta"]["store"] == "attr-test"
+
+    def test_commit_wait_attributed_behind_leader(self):
+        entered, release = threading.Event(), threading.Event()
+
+        def flush(batch):
+            if not entered.is_set():
+                entered.set()
+                release.wait(5)
+            return [None] * len(batch)
+
+        gc = GroupCommitter(flush, store="attr-wait")
+        tracer = Tracer("event")
+        leader = threading.Thread(target=gc.submit, args=("a",))
+        leader.start()
+        assert entered.wait(5)
+
+        def follower():
+            with tracer.trace("event", trace_id="evt-follow-1"):
+                gc.submit("b")
+
+        f = threading.Thread(target=follower)
+        f.start()
+        time.sleep(0.15)  # let the follower queue behind the held lock
+        release.set()
+        leader.join(5)
+        f.join(5)
+        d = tracer.find("evt-follow-1")
+        spans = {s["stage"]: s for s in d["spans"]}
+        assert "store.commit_wait" in spans
+        assert spans["store.commit_wait"]["durMs"] >= 100
+        assert "store.flush" in spans
+
+
+class TestDeviceProfileRestart:
+    def test_restart_unconfigured_refuses(self):
+        out = DeviceProfileHook("").restart()
+        assert out["restarted"] is False
+
+    def test_restart_rotates_and_rearms(self, tmp_path):
+        hook = DeviceProfileHook(str(tmp_path / "prof"), first_n=2)
+        hook._seen, hook._done = 2, True  # first window spent
+        assert not hook.enabled
+        out = hook.restart()
+        assert out["restarted"] and out["armed"]
+        assert out["captures"] == 1
+        assert hook.directory.endswith("capture-0001")
+        assert hook._seen == 0 and hook.enabled
+        out2 = hook.restart(first_n=5)
+        assert out2["firstN"] == 5
+        # rotation replaces the capture suffix instead of nesting it
+        assert hook.directory.endswith("capture-0002")
+        assert "capture-0001" not in hook.directory
+
+
+# ---------------------------------------------------------------------------
+# HTTP tier: the event server end to end (memory storage, no training)
+
+@pytest.fixture()
+def mem_storage(tmp_home, monkeypatch):
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "MEM")
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+@pytest.fixture()
+def eventserver(mem_storage):
+    from pio_tpu.server import create_event_server
+
+    server = create_event_server(host="127.0.0.1", port=0).start()
+    yield f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+@pytest.fixture()
+def access_key(mem_storage):
+    app_id = Storage.get_meta_data_apps().insert(App(0, "attr-test"))
+    return Storage.get_meta_data_access_keys().insert(AccessKey("", app_id))
+
+
+EV = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 4.5},
+    "eventTime": "2026-03-01T10:00:00Z",
+}
+
+
+def _http(method, url, body=None, headers=None):
+    """(status, json_body, response_headers_lowercased)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return (resp.status, json.loads(resp.read() or b"null"),
+                    {k.lower(): v for k, v in resp.getheaders()})
+    except urllib.error.HTTPError as e:
+        return (e.code, json.loads(e.read() or b"null"),
+                {k.lower(): v for k, v in e.headers.items()})
+
+
+def _find_trace(base_url, trace_id, want_stage=None, tries=100):
+    """Poll /traces.json?id= until the trace (and optionally one stage
+    recorded by the post-flush write hook) is visible."""
+    for _ in range(tries):
+        status, body, _ = _http("GET", f"{base_url}/traces.json?id={trace_id}")
+        if status == 200:
+            t = body["traces"][0]
+            stages = {s["stage"] for s in t["spans"]}
+            if want_stage is None or want_stage in stages:
+                return t
+        time.sleep(0.01)
+    raise AssertionError(f"trace {trace_id} (stage {want_stage}) "
+                         f"never became visible")
+
+
+class TestEventServerLatencyAttribution:
+    def test_inbound_header_adopted_and_echoed(self, eventserver, access_key):
+        status, body, hdrs = _http(
+            "POST", f"{eventserver}/events.json?accessKey={access_key}",
+            EV, {TRACE_HEADER: "up-evt-7/dispatch"},
+        )
+        assert status == 201 and "eventId" in body
+        assert hdrs.get(TRACE_HEADER.lower()) == "up-evt-7"
+        t = _find_trace(eventserver, "up-evt-7", want_stage="write")
+        assert t["parent"] == "dispatch"
+        stages = {s["stage"] for s in t["spans"]}
+        assert {"accept", "admit", "parse", "store", "write"} <= stages
+        # accept opens the waterfall at offset zero
+        accepts = [s for s in t["spans"] if s["stage"] == "accept"]
+        assert accepts[0]["startMs"] == 0.0
+
+    def test_malformed_header_mints_fresh_id(self, eventserver, access_key):
+        status, _, hdrs = _http(
+            "POST", f"{eventserver}/events.json?accessKey={access_key}",
+            EV, {TRACE_HEADER: "not a valid id!"},
+        )
+        assert status == 201
+        minted = hdrs.get(TRACE_HEADER.lower())
+        assert minted and minted != "not a valid id!"
+        assert minted.startswith("event-")
+
+    def test_hotpath_budget_over_live_requests(self, eventserver, access_key):
+        for _ in range(5):
+            status, _, hdrs = _http(
+                "POST", f"{eventserver}/events.json?accessKey={access_key}", EV
+            )
+            assert status == 201
+        # e2e lands in the post-flush write hook — poll until counted
+        for _ in range(100):
+            _, p, _ = _http("GET", f"{eventserver}/debug/hotpath.json")
+            if p["requestCount"] >= 5:
+                break
+            time.sleep(0.01)
+        assert p["requestCount"] >= 5
+        stages = {s["stage"] for s in p["stages"]}
+        assert {"accept", "admit", "parse", "store", "write"} <= stages
+        assert not any("." in s for s in stages)
+        assert all("." in s["stage"] for s in p["substages"])
+        assert p["e2e"]["avgMs"] > 0
+        assert 0 < p["attributedFraction"] <= 1.5
+
+    def test_slow_ring_capture_via_env_threshold(self, eventserver,
+                                                 access_key, monkeypatch):
+        # 1e-4 ms = 100 ns: every request breaches (read per trace)
+        monkeypatch.setenv("PIO_TPU_SLOW_TRACE_MS", "0.0001")
+        status, _, hdrs = _http(
+            "POST", f"{eventserver}/events.json?accessKey={access_key}",
+            EV, {TRACE_HEADER: "evt-slow-1"},
+        )
+        assert status == 201
+        for _ in range(100):
+            _, body, _ = _http("GET", f"{eventserver}/traces.json?slow=1")
+            ids = {t["id"] for t in body["traces"]}
+            if "evt-slow-1" in ids:
+                break
+            time.sleep(0.01)
+        assert "evt-slow-1" in ids
+        got = next(t for t in body["traces"] if t["id"] == "evt-slow-1")
+        assert got["slow"] is True
+
+    def test_commit_ring_merged_into_traces(self, eventserver, access_key):
+        with COMMIT_TRACER.trace(
+            "commit", trace_id="commit-merge-1", links=["evt-x"],
+            store="attr-merge", batch=1,
+        ) as ctr:
+            ctr.add_span("store.flush", 0.001, rel_start_s=0.0)
+        _, body, _ = _http("GET", f"{eventserver}/traces.json?n=64")
+        assert "commit-merge-1" in {t["id"] for t in body["traces"]}
+        # ?commits=0 restricts to request traces
+        _, body, _ = _http("GET", f"{eventserver}/traces.json?n=64&commits=0")
+        assert "commit-merge-1" not in {t["id"] for t in body["traces"]}
+        # by-id lookup reaches into the commit ring
+        status, body, _ = _http(
+            "GET", f"{eventserver}/traces.json?id=commit-merge-1"
+        )
+        assert status == 200
+        assert body["traces"][0]["links"] == ["evt-x"]
+
+    def test_unknown_trace_id_404(self, eventserver):
+        status, body, _ = _http(
+            "GET", f"{eventserver}/traces.json?id=never-existed"
+        )
+        assert status == 404

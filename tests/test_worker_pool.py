@@ -85,13 +85,13 @@ def _get(port, path, timeout=30):
         conn.close()
 
 
-def _post_h(port, path, body, timeout=30):
+def _post_h(port, path, body, timeout=30, headers=None):
     """Like _post but also returns the response headers (lowercased)."""
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
     try:
         conn.request(
             "POST", path, body=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **(headers or {})},
         )
         r = conn.getresponse()
         return (r.status, json.loads(r.read()),
@@ -355,3 +355,82 @@ class TestPoolQoS:
         # the pool survived the burst
         status, got = _get(qos_pool.port, "/healthz")
         assert status == 200
+
+
+@pytest.fixture()
+def traced_pool(tmp_home, monkeypatch):
+    from pio_tpu.server.worker_pool import ServingPool
+
+    # 100 ns slow threshold: every request breaches, so both workers'
+    # slow rings fill deterministically (workers inherit the env)
+    monkeypatch.setenv("PIO_TPU_SLOW_TRACE_MS", "0.0001")
+    Storage.reset()
+    variant = _seed_and_train()
+    pool = ServingPool(variant, host="127.0.0.1", port=0, n_workers=2)
+    pool.start()
+    pool.wait_ready(timeout=120)
+    yield pool
+    pool.stop()
+    Storage.reset()
+
+
+class TestPoolTraceAttribution:
+    def test_pool_unique_ids_merged_rings_and_slow_capture(self, traced_pool):
+        """ISSUE 6 acceptance: in pool mode, minted trace ids are
+        worker-namespaced (query-wN-...), /traces.json?id= resolves a
+        trace whichever worker holds it (sidecar fan-out), and a slow
+        request's waterfall is retrievable by id from ?slow=1 on ANY
+        worker's merged view."""
+        pool = traced_pool
+        # sidecar ports must be published before fan-out can merge
+        deadline = monotonic_s() + 30
+        while monotonic_s() < deadline:
+            if all(p > 0 for p in pool._health_ports):
+                break
+            time.sleep(0.2)
+        assert all(p > 0 for p in pool._health_ports)
+
+        ids = set()
+        for i in range(12):
+            status, body, headers = _post_h(
+                pool.port, "/queries.json", {"user": f"u{i % 8}", "num": 2}
+            )
+            assert status == 200
+            tid = headers.get("x-pio-trace")
+            assert tid and tid.startswith("query-w"), tid
+            ids.add(tid)
+        assert len(ids) == 12  # pool-unique: no cross-worker collisions
+
+        # by-id lookup crosses workers: whichever worker answers the GET
+        # must resolve ids minted by EITHER worker
+        for tid in sorted(ids)[:6]:
+            status, got = _get(pool.port, f"/traces.json?id={tid}")
+            assert status == 200, tid
+            t = got["traces"][0]
+            assert t["id"] == tid
+            stages = {s["stage"] for s in t["spans"]}
+            assert {"accept", "parse", "execute"} <= stages, stages
+
+        # inbound header adoption still works under the pool
+        status, body, headers = _post_h(
+            pool.port, "/queries.json", {"user": "u1", "num": 2},
+            headers={"X-Pio-Trace": "pool-client-1/dispatch"},
+        )
+        assert status == 200
+        assert headers.get("x-pio-trace") == "pool-client-1"
+        ids.add("pool-client-1")
+
+        # every request breached the 100 ns threshold: the MERGED slow
+        # view on any worker eventually covers ids from both workers
+        deadline = monotonic_s() + 15
+        seen = set()
+        while monotonic_s() < deadline and not ids <= seen:
+            status, got = _get(pool.port, "/traces.json?slow=1&n=128")
+            assert status == 200
+            seen = {t["id"] for t in got["traces"]}
+            time.sleep(0.2)
+        assert ids <= seen, ids - seen
+        slow = {t["id"]: t for t in got["traces"]}
+        assert all(slow[tid].get("slow") for tid in ids)
+        # worker index rides the trace for the merged view
+        assert all("worker" in slow[tid] for tid in ids)
