@@ -263,6 +263,17 @@ def _bench_server_p50(factors, n_users: int, n_items: int,
                 model, query, model.user_index, model.item_index
             )
 
+        def batch_predict(self, model, indexed_queries):
+            from pio_tpu.templates.recommendation import batched_user_topn
+
+            return batched_user_topn(
+                self, model, indexed_queries, model.user_index,
+                model.item_index, model.scorer,
+            )
+
+        def warmup_query(self, model):
+            return Query(user="u0")
+
         def prepare_for_serving(self, model):
             model.scorer(warmup=True)
             return model
@@ -341,6 +352,23 @@ def _bench_server_p50(factors, n_users: int, n_items: int,
                 mb["batchedQueries"] / max(1, mb["batches"]), 2
             )
             out["concurrent_microbatch"]["max_batch"] = mb["maxBatch"]
+            # shape-bucket accounting: per-bucket dispatch counts, the
+            # retrace counter (steady state should be flat — every count
+            # beyond the warmup sweep is a lost compile on the hot path)
+            # and the cache's own view (generation, warmed ladder)
+            eng = service.variant.engine_id
+            out["concurrent_microbatch"]["bucket_dispatches"] = {
+                str(b): int(
+                    service._bucket_dispatch_total.labels(eng, str(b)).value
+                )
+                for b in service._buckets.buckets
+            }
+            out["concurrent_microbatch"]["bucket_retraces"] = int(
+                service._bucket_retrace_total.labels(eng).value
+            )
+            out["concurrent_microbatch"]["buckets"] = (
+                service._buckets.to_dict()
+            )
         finally:
             post.close()
             server.stop()
@@ -768,6 +796,10 @@ def _bench_pool_serving(factors, n_users: int, n_items: int) -> dict:
 
     cores = len(os.sched_getaffinity(0))
     n_workers = max(2, min(4, cores))
+    # no device_worker here: the headline pool number measures independent
+    # per-worker serving, which is the fast path on a homogeneous pool —
+    # funneling through one lane drainer serializes dispatch. The lane's
+    # end-to-end behavior is asserted in the smoke pooled stage instead.
     pool = ServingPool(
         variant, host="127.0.0.1", port=0, n_workers=n_workers
     )

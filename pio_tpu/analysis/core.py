@@ -88,6 +88,9 @@ class LintContext:
         self.repo_root = repo_root or _default_repo_root()
         self._catalog = catalog
         self._catalog_loaded = catalog is not None
+        self._catalog_kinds: Optional[Dict[str, str]] = None
+        # an injected catalog (tests) has no type info: skip kind checks
+        self._catalog_kinds_loaded = catalog is not None
 
     @property
     def metric_catalog(self) -> Optional[Set[str]]:
@@ -98,6 +101,19 @@ class LintContext:
             self._catalog = _load_catalog(self.repo_root)
             self._catalog_loaded = True
         return self._catalog
+
+    @property
+    def metric_catalog_kinds(self) -> Optional[Dict[str, str]]:
+        """Documented metric type per catalog name, parsed from the
+        ``| `name` | type | ...`` table rows of docs/observability.md —
+        lets the metric-name rule flag a registration whose kind
+        disagrees with its documented row (e.g. a counter documented as
+        a gauge), not just an undocumented name. ``None`` when the doc
+        is absent."""
+        if not self._catalog_kinds_loaded:
+            self._catalog_kinds = _load_catalog_kinds(self.repo_root)
+            self._catalog_kinds_loaded = True
+        return self._catalog_kinds
 
 
 class Rule:
@@ -167,6 +183,23 @@ def _load_catalog(repo_root: str) -> Optional[Set[str]]:
     except OSError:
         return None
     return set(re.findall(r"`(pio_tpu_[a-z0-9_]+)`", text))
+
+
+#: catalog table row: ``| `pio_tpu_x` | counter | ... |`` — first two
+#: cells are the name and the documented type
+_CATALOG_ROW_RE = re.compile(
+    r"^\|\s*`(pio_tpu_[a-z0-9_]+)`\s*\|\s*([a-z]+)\s*\|", re.MULTILINE
+)
+
+
+def _load_catalog_kinds(repo_root: str) -> Optional[Dict[str, str]]:
+    doc = os.path.join(repo_root, "docs", "observability.md")
+    try:
+        with open(doc, "r", encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    return dict(_CATALOG_ROW_RE.findall(text))
 
 
 def _is_test_path(path: str) -> bool:

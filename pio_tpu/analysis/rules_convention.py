@@ -55,6 +55,7 @@ class MetricNameRule(Rule):
 
     def check(self, module: ModuleInfo, ctx: LintContext) -> Iterable[Finding]:
         catalog = ctx.metric_catalog
+        kinds = ctx.metric_catalog_kinds
         for node in ast.walk(module.tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
@@ -67,13 +68,14 @@ class MetricNameRule(Rule):
                 continue  # dynamic names are out of scope
             name = first.value
             kind = node.func.attr
-            msg = self._bad(name, kind, catalog)
+            msg = self._bad(name, kind, catalog, kinds)
             if msg:
                 yield Finding(self.id, module.display, node.lineno,
                               node.col_offset, msg)
 
     @staticmethod
-    def _bad(name: str, kind: str, catalog) -> Optional[str]:
+    def _bad(name: str, kind: str, catalog,
+             kinds: Optional[Dict[str, str]] = None) -> Optional[str]:
         if not _METRIC_NAME_RE.match(name):
             return (f"metric `{name}` must match pio_tpu_[a-z0-9_]+ "
                     f"(project namespace prefix)")
@@ -85,6 +87,15 @@ class MetricNameRule(Rule):
         if catalog is not None and name not in catalog:
             return (f"metric `{name}` is not in the docs/observability.md "
                     f"catalog; add a row (or fix the name)")
+        # kind agreement with the catalog's Type column: a name whose row
+        # documents a different type is a doc/code drift bug (names only
+        # mentioned in prose, with no table row, are skipped)
+        if kinds is not None:
+            doc_kind = kinds.get(name)
+            if doc_kind is not None and doc_kind != kind:
+                return (f"{kind} `{name}` is documented as `{doc_kind}` in "
+                        f"the docs/observability.md catalog; fix the row "
+                        f"or the registration")
         return None
 
 
@@ -99,6 +110,7 @@ FAILPOINT_NAMESPACES = (
     "groupcommit.",
     "scorer.",
     "worker.",
+    "batchlane.",
 )
 
 

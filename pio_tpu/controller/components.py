@@ -114,6 +114,14 @@ class Algorithm(Component, Generic[PD, M, Q, P]):
         return the model unchanged."""
         return model
 
+    def warmup_query(self, model: M) -> Optional[Q]:
+        """A representative query the serving layer can replicate to warm
+        its shape-bucket executables at deploy (see
+        ``pio_tpu/server/bucketcache.py``). Return None (the default) to
+        opt out — buckets then compile lazily on first live dispatch,
+        counted as retraces."""
+        return None
+
 
 # Reference-parity aliases (see module docstring): the P/L/P2L distinction is
 # a Spark artifact; on a mesh all algorithms are "distributed".

@@ -35,9 +35,10 @@ from typing import List
 MAGIC = b"PIOMETR1"
 HEADER_BYTES = 32
 #: default stripe width — the query server's pool-bound families
-#: (request/error counters + four stage histogram cells + latency
-#: histogram) need ~120 slots; 256 leaves headroom for growth
-DEFAULT_SLOTS = 256
+#: (request/error counters + stage histogram cells + latency histogram
+#: + the shape-bucket dispatch/retrace + batch-lane counters) need
+#: ~150 slots; 384 leaves headroom for growth
+DEFAULT_SLOTS = 384
 
 
 class PoolMetricsSegment:

@@ -1,0 +1,188 @@
+"""Shape-bucket execution cache for the serving hot path.
+
+JAX specializes a compiled executable per input SHAPE: every fresh batch
+size that reaches ``algo.batch_predict`` pays an XLA trace+compile
+(seconds-scale) before the first byte of useful work. Under a
+micro-batching server the batch size is whatever concurrency happened to
+produce — a stream of fresh shapes — so batching loses exactly where it
+should win (the round-4 probe measured batched p50 10.7 ms vs 0.4 ms
+per-query, all of it retrace jitter).
+
+The fix is the oldest trick in serving systems: quantize. Batches are
+padded up to a small, fixed set of bucket sizes (default 1/2/4/8/16/32,
+env-tunable via ``PIO_TPU_BATCH_BUCKETS``), every bucket's executable is
+compiled ONCE by a warmup sweep at deploy/hot-swap, and the hot path
+only ever dispatches bucket-shaped batches — a pure cache hit in jit's
+shape-keyed executable cache, never a retrace. Oversized batches chunk
+into max-bucket pieces.
+
+The cache itself holds no executables (those live in the per-scorer /
+per-model jit caches, keyed by shape); it owns the POLICY and the
+ACCOUNTING: which bucket a batch lands in, which buckets are warmed for
+the currently deployed model generation, and the retrace/dispatch/
+occupancy counters that make "steady-state dispatches never retrace"
+an assertable property (smoke asserts it; the bench records it).
+
+Hot-swap semantics: a /reload warms the NEW model's buckets before the
+swap is visible (the sweep runs on the incoming pairs while the old
+model keeps serving), then :meth:`install` atomically replaces the
+warmed set — the old generation's entries are evicted with it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from pio_tpu.analysis.runtime import make_lock
+
+log = logging.getLogger("pio_tpu.bucketcache")
+
+#: default bucket ladder — powers of two up to the micro-batcher's
+#: practical occupancy; matches ops/topn.py's internal pow2 bucketing so
+#: serving-layer buckets and scorer-layer buckets coincide
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def buckets_from_env(env: str = "PIO_TPU_BATCH_BUCKETS") -> Tuple[int, ...]:
+    """Bucket ladder from the environment: a comma-separated list of
+    positive ints (``"1,4,16"``). Malformed values fall back to the
+    default with a warning — a typo'd ladder must degrade, not take the
+    server down at boot."""
+    raw = os.environ.get(env, "")
+    if not raw.strip():
+        return DEFAULT_BUCKETS
+    try:
+        vals = sorted({int(tok) for tok in raw.split(",") if tok.strip()})
+        if not vals or any(v < 1 for v in vals):
+            raise ValueError(raw)
+        return tuple(vals)
+    except ValueError:
+        log.warning(
+            "malformed %s=%r; using default buckets %s",
+            env, raw, DEFAULT_BUCKETS,
+        )
+        return DEFAULT_BUCKETS
+
+
+class BucketExecutionCache:
+    """Bucket policy + warmed-generation bookkeeping for one engine.
+
+    Thread-safe: the warmed set is read on every dispatch (hot path) and
+    replaced wholesale on hot-swap; a lock guards the mutations, reads
+    go through an immutable frozenset snapshot.
+    """
+
+    def __init__(self, buckets: Optional[Sequence[int]] = None):
+        self.buckets: Tuple[int, ...] = (
+            tuple(sorted(set(buckets))) if buckets else buckets_from_env()
+        )
+        if any(b < 1 for b in self.buckets):
+            raise ValueError(f"bucket sizes must be >= 1: {self.buckets}")
+        self.max_bucket = self.buckets[-1]
+        self._lock = make_lock("query.bucket_cache")
+        #: buckets whose executable the CURRENT model generation compiled
+        self._warmed: frozenset = frozenset()
+        self.generation = 0
+        self.evictions = 0
+        self.retraces = 0
+
+    # -- policy ------------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (chunk-sized inputs; n > max never
+        reaches here — see :meth:`chunks`)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_bucket
+
+    def chunks(self, n: int) -> List[int]:
+        """Split a batch of ``n`` into max-bucket-sized chunk lengths."""
+        out = []
+        while n > self.max_bucket:
+            out.append(self.max_bucket)
+            n -= self.max_bucket
+        if n:
+            out.append(n)
+        return out
+
+    def pad(self, queries: list) -> Tuple[list, int]:
+        """Pad a chunk (len <= max bucket) up to its bucket by
+        replicating the last query — the padding rows ride the same
+        compiled program and their results are sliced off. Returns
+        ``(padded, bucket)``."""
+        b = self.bucket_for(len(queries))
+        if len(queries) == b:
+            return queries, b
+        return queries + [queries[-1]] * (b - len(queries)), b
+
+    # -- warm/evict lifecycle ---------------------------------------------
+    def note_dispatch(self, bucket: int) -> bool:
+        """Record a hot-path dispatch into ``bucket``. Returns True when
+        the bucket was NOT warmed for the current generation — a retrace:
+        the dispatch is paying a compile the warmup sweep should have
+        absorbed. The bucket is marked warmed so each shape retraces at
+        most once per generation."""
+        if bucket in self._warmed:
+            return False
+        with self._lock:
+            if bucket in self._warmed:
+                return False
+            self._warmed = self._warmed | {bucket}
+            self.retraces += 1
+        return True
+
+    def install(self, warmed: Sequence[int]) -> None:
+        """Atomically swap in a new generation's warmed set (hot-swap
+        eviction: whatever the old generation had compiled is dead —
+        the new model's shapes/weights own the jit caches now)."""
+        with self._lock:
+            if self._warmed:
+                self.evictions += len(self._warmed)
+            self._warmed = frozenset(warmed)
+            self.generation += 1
+
+    @property
+    def warmed(self) -> frozenset:
+        return self._warmed
+
+    def to_dict(self) -> dict:
+        return {
+            "buckets": list(self.buckets),
+            "warmed": sorted(self._warmed),
+            "generation": self.generation,
+            "retraces": self.retraces,
+            "evictions": self.evictions,
+        }
+
+
+def dispatch_bucketed(
+    cache: BucketExecutionCache,
+    queries: list,
+    run_batch: Callable[[list], list],
+    on_dispatch: Optional[Callable[[int, int, bool], None]] = None,
+) -> Tuple[list, bool]:
+    """Serve ``queries`` through bucket-shaped ``run_batch`` calls.
+
+    Chunks to the max bucket, pads each chunk to its bucket, slices the
+    padding rows back off, and reports ``(results, fresh)`` where
+    ``fresh`` is True when ANY chunk hit a cold bucket (the caller —
+    the micro-batcher's probe — discards such samples as compile
+    transients). ``on_dispatch(n, bucket, fresh)`` fires per chunk for
+    metric accounting.
+    """
+    results: list = []
+    fresh_any = False
+    pos = 0
+    for n in cache.chunks(len(queries)):
+        chunk = queries[pos:pos + n]
+        pos += n
+        padded, bucket = cache.pad(chunk)
+        fresh = cache.note_dispatch(bucket)
+        fresh_any = fresh_any or fresh
+        got = run_batch(padded)
+        results.extend(got[:n])
+        if on_dispatch is not None:
+            on_dispatch(n, bucket, fresh)
+    return results, fresh_any

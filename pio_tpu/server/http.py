@@ -248,6 +248,32 @@ def _http_date() -> str:
     return _date_cache[1]
 
 
+_date_line_cache: Tuple[int, bytes] = (0, b"")
+
+
+def _http_date_line() -> bytes:
+    """Pre-encoded ``Date: ...\\r\\n`` header line, same 1 s cache."""
+    global _date_line_cache
+    now = int(time.time())  # pio: disable=wallclock-duration (Date header)
+    if _date_line_cache[0] != now:
+        _date_line_cache = (
+            now, b"Date: " + _http_date().encode("latin-1") + b"\r\n"
+        )
+    return _date_line_cache[1]
+
+
+#: pre-encoded Content-Type lines — the JSON type covers ~every response
+_ctype_line_cache: dict = {}
+
+
+def _ctype_line(ctype: str) -> bytes:
+    got = _ctype_line_cache.get(ctype)
+    if got is None:
+        got = f"Content-Type: {ctype}\r\n".encode("latin-1")
+        _ctype_line_cache[ctype] = got
+    return got
+
+
 def _make_handler_class(
     router: Router,
     server_name: str,
@@ -265,6 +291,21 @@ def _make_handler_class(
     response, TCP_NODELAY on).
     """
 
+    # status line + Server header never change for this server instance:
+    # encode once per status instead of re-building the f-string (and
+    # re-encoding) on every response
+    _static_head: dict = {}
+
+    def _head_prefix(status: int) -> bytes:
+        got = _static_head.get(status)
+        if got is None:
+            got = (
+                f"HTTP/1.1 {status} {_REASONS.get(status, '')}\r\n"
+                f"Server: {server_name}\r\n"
+            ).encode("latin-1")
+            _static_head[status] = got
+        return got
+
     class JsonHandler(socketserver.StreamRequestHandler):
         rbufsize = 64 * 1024
         wbufsize = 64 * 1024
@@ -275,6 +316,10 @@ def _make_handler_class(
 
         def handle(self):
             self.close_connection = False
+            #: per-connection serialize buffer, reused across keep-alive
+            #: requests: head + payload assemble here and hit the socket
+            #: as one write with no per-response bytes concatenation
+            self._obuf = bytearray()
             try:
                 while not self.close_connection:
                     if not self._handle_one():
@@ -283,24 +328,27 @@ def _make_handler_class(
                 pass
 
         # -- response writing ------------------------------------------
-        def _head_bytes(self, status, ctype, length, extra=()) -> bytes:
-            head = (
-                f"HTTP/1.1 {status} {_REASONS.get(status, '')}\r\n"
-                f"Server: {server_name}\r\n"
-                f"Date: {_http_date()}\r\n"
-                f"Content-Type: {ctype}\r\n"
-                f"Content-Length: {length}\r\n"
-            )
+        def _head_into(self, buf: bytearray, status, ctype, length,
+                       extra=()) -> None:
+            buf += _head_prefix(status)
+            buf += _http_date_line()
+            buf += _ctype_line(ctype)
+            buf += b"Content-Length: %d\r\n" % length
             for k, v in extra:
-                head += f"{k}: {v}\r\n"
+                buf += f"{k}: {v}\r\n".encode("latin-1")
             if self.close_connection:
-                head += "Connection: close\r\n"
+                buf += b"Connection: close\r\n"
             elif self.http10:
                 # an HTTP/1.0 client assumes close unless keep-alive is
                 # echoed back — without this it would never reuse the
                 # connection while we block in readline waiting for it
-                head += "Connection: keep-alive\r\n"
-            return (head + "\r\n").encode("latin-1")
+                buf += b"Connection: keep-alive\r\n"
+            buf += b"\r\n"
+
+        def _head_bytes(self, status, ctype, length, extra=()) -> bytes:
+            out = bytearray()
+            self._head_into(out, status, ctype, length, extra)
+            return bytes(out)
 
         def _respond(self, status: int, body: Any):
             # HEAD must carry Content-Length but NO body bytes — writing
@@ -322,13 +370,15 @@ def _make_handler_class(
                             self.wfile.write(chunk)
                 self.wfile.flush()
                 return
+            out = self._obuf
+            del out[:]
             if isinstance(body, RawResponse):
                 payload = (
                     body.body if isinstance(body.body, bytes)
                     else body.body.encode()
                 )
-                out = self._head_bytes(
-                    status, body.content_type, len(payload),
+                self._head_into(
+                    out, status, body.content_type, len(payload),
                     body.headers.items(),
                 )
                 if not head:
@@ -344,8 +394,8 @@ def _make_handler_class(
                 log.exception("response not JSON-serializable")
                 status = 500
                 payload = b'{"message": "response not JSON-serializable"}'
-            out = self._head_bytes(
-                status, "application/json; charset=UTF-8", len(payload)
+            self._head_into(
+                out, status, "application/json; charset=UTF-8", len(payload)
             )
             if payload and not head:
                 out += payload

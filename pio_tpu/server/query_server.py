@@ -46,6 +46,12 @@ from pio_tpu.qos import (
     retry_after_header,
 )
 from pio_tpu.utils import envutil
+from pio_tpu.server.batchlane import (
+    BatchLaneSegment, LaneClient, LaneDrainer, LaneFallback,
+)
+from pio_tpu.server.bucketcache import (
+    BucketExecutionCache, dispatch_bucketed,
+)
 from pio_tpu.server.http import (
     HTTPError, JsonHTTPServer, Request, Router, float_param, int_param,
     json_response, keys_equal, metrics_response,
@@ -152,12 +158,44 @@ class _MicroBatcher:
         #: set when the probe decides "off" — query() then skips the
         #: batcher entirely (inline per-request path, no residual cost)
         self.bypassed = False
+        #: an "off" verdict is re-examined this often: the early probe
+        #: can catch compile transients / cold caches that a warmed
+        #: server has long outgrown — "off" is a lease, not a latch
+        #: (0 disables re-probing and restores the one-shot behavior)
+        self._reprobe_s = envutil.env_float("PIO_TPU_MB_REPROBE_S", 30.0)
+        self._decided_at = 0.0
+        self.reprobes = 0
         self._probe_lock = make_lock("query.microbatch.probe")
         self._probe: dict = {"batch": [], "solo": []}
         self._thread = threading.Thread(
             target=self._run, name="pio-tpu-microbatch", daemon=True
         )
         self._thread.start()
+
+    def active(self) -> bool:
+        """Should queries flow through the batcher? Cheap hot-path check
+        that doubles as the re-probe trigger: once an "off" verdict has
+        aged past the re-probe interval, the probe windows reset and the
+        next requests measure again — a verdict poisoned by deploy-time
+        transients (bucket compiles, cold caches) heals instead of
+        sticking for the server's lifetime."""
+        if not self.bypassed:
+            return True
+        if self._reprobe_s <= 0 \
+                or monotonic_s() - self._decided_at < self._reprobe_s:
+            return False
+        with self._probe_lock:
+            if not self.bypassed:  # another thread re-armed first
+                return True
+            self._probe = {"batch": [], "solo": []}
+            self._mode = "probe_batch"
+            self.bypassed = False
+            self.reprobes += 1
+        log.info(
+            "micro-batch re-probe: re-measuring after %.0fs in bypass",
+            self._reprobe_s,
+        )
+        return True
 
     def submit(self, query, span_sink=None, deadline=None):
         """Serve one query through the current regime; blocks until done.
@@ -198,7 +236,11 @@ class _MicroBatcher:
             self._queue.append(pend)
             self._cv.notify()
         pend[3].wait()
-        if mode == "probe_batch":
+        if mode == "probe_batch" and not pend[5].get("fresh_bucket"):
+            # a dispatch that compiled a fresh shape bucket is a one-off
+            # deploy transient, not the steady state the probe compares —
+            # discard the whole batch's samples (satellite of ISSUE 7:
+            # the old probe latched "off" on exactly these)
             self._note_probe("batch", monotonic_s() - t0)
         if span_sink is not None and "queue_s" in pend[5]:
             span_sink.add_span("queue", pend[5]["queue_s"])
@@ -241,8 +283,10 @@ class _MicroBatcher:
                 if self._mode == "off":
                     # true bypass: the query path re-checks this flag and
                     # goes back to inline per-request serving, byte-for-
-                    # byte the no-batcher code path (zero residual cost)
+                    # byte the no-batcher code path (zero residual cost
+                    # beyond the aged-verdict check in active())
                     self.bypassed = True
+                    self._decided_at = monotonic_s()
 
     @property
     def mode(self) -> str:
@@ -270,6 +314,9 @@ class _MicroBatcher:
             "batchedQueries": self.batched_queries,
             "maxBatch": self.max_batch,
             "windowUs": round(self._window_s * 1e6),
+            "reprobeSeconds": self._reprobe_s,
+            "reprobes": self.reprobes,
+            "bypassed": self.bypassed,
         }
 
     def _run(self):
@@ -337,19 +384,31 @@ class _MicroBatcher:
                 # request trace — "which requests shared this dispatch"
                 # becomes answerable from /traces.json. Device time lands
                 # on it as execute.device via the active-trace contextvar.
+                queries = [p[0] for p in batch]
+                # freshness from the warmed-set snapshot, not the
+                # dispatch return: _predict_batch is the seam tests and
+                # profilers wrap, so the batcher must go through it
+                cache = self._service._buckets
+                pre_warmed = cache.warmed
+                fresh = any(
+                    cache.bucket_for(n) not in pre_warmed
+                    for n in cache.chunks(len(queries))
+                )
                 with self._service.tracer.trace(
                     "microbatch",
                     links=[p[7] for p in batch if p[7]],
                     batch=len(batch),
                 ) as btr:
-                    results = self._service._predict_batch(
-                        [p[0] for p in batch]
-                    )
+                    results = self._service._predict_batch(queries)
                 exec_s = monotonic_s() - t_drain
                 for p, r in zip(batch, results):
                     p[1] = r
                     p[5]["execute_s"] = exec_s
                     p[5]["batch_id"] = btr.trace_id
+                    if fresh:
+                        # this dispatch paid a bucket compile — flag every
+                        # member so the probe discards the transient
+                        p[5]["fresh_bucket"] = True
             except Exception:
                 log.exception(
                     "micro-batch dispatch failed; per-query fallback "
@@ -468,6 +527,96 @@ class QueryServerService:
         self._scorer_breaker = (
             self.qos.breaker("scorer") if self.qos is not None else None
         )
+        # -- shape-bucket execution cache (ISSUE 7): every batched
+        # dispatch is padded to a fixed bucket ladder so steady-state
+        # serving never retraces; the warmup sweep in _load compiles the
+        # ladder at deploy. Metrics MUST be created (and their label
+        # cells pre-created) here, before any enable_pool bind, so the
+        # retrace/dispatch counters land in the shared segment.
+        self._buckets = BucketExecutionCache()
+        self._bucket_dispatch_total = self.obs.counter(
+            "pio_tpu_bucket_dispatch_total",
+            "Batched dispatches by shape bucket (padded batch size)",
+            ("engine_id", "bucket"),
+        )
+        self._bucket_retrace_total = self.obs.counter(
+            "pio_tpu_bucket_retrace_total",
+            "Batched dispatches that hit a cold shape bucket (paid an "
+            "XLA trace+compile the warmup sweep should have absorbed); "
+            "flat in steady state",
+            ("engine_id",),
+        )
+        self._bucket_evictions_total = self.obs.counter(
+            "pio_tpu_bucket_evictions_total",
+            "Model hot-swaps that evicted the previous generation's "
+            "warmed bucket entries",
+            ("engine_id",),
+        )
+        self._bucket_occupancy = self.obs.histogram(
+            "pio_tpu_bucket_occupancy_ratio",
+            "Real batch size over bucket size per dispatch (1.0 = no "
+            "padding waste)",
+            ("engine_id",),
+            buckets=(0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self._bucket_entries = self.obs.gauge(
+            "pio_tpu_bucket_entries",
+            "Warmed shape-bucket entries for the deployed generation",
+            ("engine_id",),
+        )
+        for b in self._buckets.buckets:
+            self._bucket_dispatch_total.labels(eng, str(b))
+        self._bucket_retrace_total.labels(eng)
+        self._bucket_evictions_total.labels(eng)
+        self._bucket_occ_cell = self._bucket_occupancy.labels(eng)
+        self._bucket_entries.labels(eng)
+        # -- cross-worker batch lane (ISSUE 7): wired by
+        # enable_batch_lane() in pool mode; counters declared up front
+        # for the same pool-bind reason as above
+        self._lane_client = None
+        self._lane_drainer = None
+        self._lane_seg = None
+        self._lane_enqueued_total = self.obs.counter(
+            "pio_tpu_batchlane_enqueued_total",
+            "Queries this worker served through the shared-memory batch "
+            "lane (answered by the device worker's bucketed dispatch)",
+            ("engine_id",),
+        )
+        self._lane_drained_total = self.obs.counter(
+            "pio_tpu_batchlane_drained_total",
+            "Lane requests the device worker drained across all stripes",
+            ("engine_id",),
+        )
+        self._lane_batches_total = self.obs.counter(
+            "pio_tpu_batchlane_batches_total",
+            "Cross-worker lane drain cycles served as one bucketed "
+            "dispatch",
+            ("engine_id",),
+        )
+        self._lane_full_total = self.obs.counter(
+            "pio_tpu_batchlane_full_total",
+            "Lane submissions that fell back to local predict because "
+            "this worker's stripe had no free slot",
+            ("engine_id",),
+        )
+        self._lane_fallback_total = self.obs.counter(
+            "pio_tpu_batchlane_fallback_total",
+            "Lane submissions served by the local fallback path, by "
+            "reason (full, timeout, oversize, remote_error, ...)",
+            ("engine_id", "reason"),
+        )
+        self._lane_depth = self.obs.gauge(
+            "pio_tpu_batchlane_depth",
+            "Unanswered lane requests across all stripes at last drain",
+            ("engine_id",),
+        )
+        self._lane_enqueued_total.labels(eng)
+        self._lane_drained_total.labels(eng)
+        self._lane_batches_total.labels(eng)
+        self._lane_full_total.labels(eng)
+        for reason in ("full", "timeout", "oversize", "remote_error",
+                       "unserializable", "undecodable_response"):
+            self._lane_fallback_total.labels(eng, reason)
         self.profile_hook = DeviceProfileHook.from_env()
         self._swap_lock = make_lock("query.model_swap")
         self._deployed = True
@@ -526,12 +675,78 @@ class QueryServerService:
         # resolve once at load — a conflicting query-class config should fail
         # deploy/reload, not the first query
         query_class = resolve_query_class(pairs)
+        # bucket warmup runs on the INCOMING pairs before the swap is
+        # visible: on a /reload the old model keeps serving while the
+        # new generation's shape buckets compile, then the swap installs
+        # model + warmed set atomically (hot-swap = eviction of the old
+        # generation's entries)
+        warmed = self._warm_buckets(pairs, serving)
+        eng = self.variant.engine_id
         with self._swap_lock:
             self.engine, self.engine_params = engine, engine_params
             self.instance_id = instance_id
             self.pairs, self.serving = pairs, serving
             self.query_class = query_class
+            if self._buckets.warmed:
+                self._bucket_evictions_total.inc(engine_id=eng)
+            self._buckets.install(warmed)
+            self._bucket_entries.set(len(warmed), engine_id=eng)
         log.info("serving engine instance %s", instance_id)
+
+    def _bucket_warm_enabled(self) -> bool:
+        """Warm the bucket ladder only where batched dispatches can
+        actually happen — a micro-batching server or a batch-lane device
+        worker. A plain per-request deploy (most tests, `pio deploy`
+        without the env) must not pay len(buckets) compiles at boot.
+        ``PIO_TPU_BUCKET_WARMUP=0`` force-disables, ``=1``
+        force-enables."""
+        flag = os.environ.get("PIO_TPU_BUCKET_WARMUP", "")
+        if flag == "0":
+            return False
+        if flag == "1":
+            return True
+        if envutil.env_float("PIO_TPU_SERVE_MICROBATCH_US", 0.0) > 0:
+            return True
+        return self._lane_drainer is not None
+
+    def _warm_buckets(self, pairs, serving) -> list:
+        """Compile the bucket ladder for ``pairs`` by dispatching each
+        bucket once with a representative query (``algo.warmup_query``).
+        Returns the warmed bucket list — empty when warmup is disabled
+        or no algorithm can mint a warmup query (the ladder then warms
+        lazily on first live dispatch, counted as retraces)."""
+        if not self._bucket_warm_enabled() or not pairs:
+            return []
+        wq = None
+        for algo, m in pairs:
+            try:
+                wq = algo.warmup_query(m)
+            except Exception:
+                log.exception(
+                    "warmup_query failed for %s", type(algo).__name__
+                )
+            if wq is not None:
+                break
+        if wq is None:
+            log.info(
+                "no algorithm provided a warmup query; shape buckets "
+                "warm lazily on first dispatch"
+            )
+            return []
+        t0 = monotonic_s()
+        warmed = []
+        for b in self._buckets.buckets:
+            try:
+                self._run_batch(pairs, serving, [wq] * b)
+                warmed.append(b)
+            except Exception:
+                log.exception("bucket %d warmup dispatch failed", b)
+                break
+        log.info(
+            "bucket warmup: compiled buckets %s in %.0f ms",
+            warmed, (monotonic_s() - t0) * 1e3,
+        )
+        return warmed
 
     # -- handlers -----------------------------------------------------------
     def status(self, req: Request):
@@ -762,6 +977,75 @@ class QueryServerService:
             return True, f"stripe {self._pool_idx} bound"
         return False, "shared metrics segment not bound"
 
+    def enable_batch_lane(self, path: str, doorbell, resp_events,
+                          device: bool) -> None:
+        """Wire this pool worker into the cross-worker batch lane.
+
+        The DEVICE worker opens the segment and runs the drainer thread
+        (aggregating every stripe into one bucketed dispatch); every
+        other worker gets a :class:`LaneClient` and ships its query
+        bodies over instead of dispatching locally — batch occupancy
+        scales with pool size instead of fragmenting per process."""
+        eng = self.variant.engine_id
+        try:
+            seg = BatchLaneSegment.open(path)
+        except Exception:
+            log.exception(
+                "batch lane segment open failed; worker %s serves "
+                "locally", self._pool_idx,
+            )
+            return
+        self._lane_seg = seg
+        if device:
+            def on_drain(n: int, batches: int) -> None:
+                self._lane_drained_total.inc(n, engine_id=eng)
+                self._lane_batches_total.inc(batches, engine_id=eng)
+                self._lane_depth.set(seg.pending_depth(), engine_id=eng)
+
+            self._lane_drainer = LaneDrainer(
+                seg, self._lane_dispatch, doorbell, resp_events,
+                on_drain=on_drain,
+            ).start()
+            self.health.add_liveness(
+                "batch_lane", lambda: (
+                    (True, "drainer alive")
+                    if self._lane_drainer.thread is not None
+                    and self._lane_drainer.thread.is_alive()
+                    else (False, "drainer thread dead")
+                ),
+            )
+            # the device worker now has batched dispatches to absorb:
+            # warm the ladder if deploy happened before the lane came up
+            if not self._buckets.warmed and self._deployed:
+                with self._swap_lock:
+                    pairs, serving = self.pairs, self.serving
+                warmed = self._warm_buckets(pairs, serving)
+                if warmed:
+                    self._buckets.install(warmed)
+                    self._bucket_entries.set(len(warmed), engine_id=eng)
+            log.info("batch lane drainer up (device worker)")
+        else:
+            self._lane_client = LaneClient(
+                seg, self._pool_idx, doorbell,
+                resp_events[self._pool_idx],
+            )
+            log.info("batch lane client up (worker %s)", self._pool_idx)
+
+    def _lane_dispatch(self, bodies: list) -> list:
+        """Drainer-side service: parse each shipped body with THIS
+        worker's snapshot and serve the whole cycle as one bucketed
+        batch. Runs on the drainer thread — sync the pool generation
+        first so a /reload elsewhere is honored here too."""
+        self._pool_sync()
+        with self._swap_lock:
+            qc = self.query_class
+            serving = self.serving
+        queries = [
+            serving.supplement(self._parse_query(b, qc)) for b in bodies
+        ]
+        results, _fresh = self._predict_batch_bucketed(queries)
+        return [_to_jsonable(r) for r in results]
+
     def _pool_sync(self) -> None:
         gen = self._pool_gen
         if gen is not None and gen.value != self._seen_gen:
@@ -866,8 +1150,43 @@ class QueryServerService:
                         # budget burned before execution (queue wait /
                         # parse) — shed before the model runs
                         raise DeadlineExceeded("deadline elapsed")
-                    if self._batcher is not None \
-                            and not self._batcher.bypassed:
+                    if self._lane_client is not None:
+                        # cross-worker batch lane: ship the raw query
+                        # body to the device worker (it re-parses with
+                        # its own snapshot), block on the response cell.
+                        # Any lane trouble falls back to local solo
+                        # dispatch — the lane is an optimization, never
+                        # a correctness dependency.
+                        rel_exec = tr.elapsed_s
+                        tr.add_span(
+                            "queue", rel_exec - rel_parse_end,
+                            rel_start_s=rel_parse_end,
+                        )
+                        timeout_s = None
+                        if deadline is not None:
+                            timeout_s = max(
+                                0.005,
+                                min(self._lane_client.timeout_s,
+                                    deadline.remaining_s() - 0.01),
+                            )
+                        try:
+                            result = self._lane_client.submit(
+                                req.body, timeout_s=timeout_s
+                            )
+                            self._lane_enqueued_total.inc(engine_id=eng)
+                        except LaneFallback as lf:
+                            self._lane_fallback_total.inc(
+                                engine_id=eng, reason=lf.reason
+                            )
+                            if lf.reason == "full":
+                                self._lane_full_total.inc(engine_id=eng)
+                            result = self._predict_one(query)
+                        tr.add_span(
+                            "execute", tr.elapsed_s - rel_exec,
+                            rel_start_s=rel_exec,
+                        )
+                    elif self._batcher is not None \
+                            and self._batcher.active():
                         result = self._batcher.submit(
                             query, span_sink=tr, deadline=deadline
                         )
@@ -1021,12 +1340,9 @@ class QueryServerService:
         add_active_span("execute.device", monotonic_s() - t_dev)
         return serving.serve(query, predictions)
 
-    def _predict_batch(self, queries: list):
+    def _run_batch(self, pairs, serving, queries: list):
         """One ``batch_predict`` dispatch per algorithm over the whole
-        micro-batch, then per-query serving combine (micro-batcher path)."""
-        failpoint("scorer.dispatch.batch")
-        with self._swap_lock:
-            pairs, serving = self.pairs, self.serving
+        (already bucket-shaped) batch, then per-query serving combine."""
         per_algo = []
         t_dev = monotonic_s()
         with self.profile_hook.capture():
@@ -1043,6 +1359,35 @@ class QueryServerService:
             for i, q in enumerate(queries)
         ]
 
+    def _predict_batch(self, queries: list):
+        """Micro-batch dispatch (bucketed); results only."""
+        return self._predict_batch_bucketed(queries)[0]
+
+    def _predict_batch_bucketed(self, queries: list):
+        """Serve a micro-batch through the shape-bucket cache: chunk to
+        the max bucket, pad each chunk up to its bucket (replicating the
+        last query — padding rows ride the same compiled program and are
+        sliced off), dispatch. Returns ``(results, fresh)`` where
+        ``fresh`` is True when any chunk hit a cold bucket — a retrace
+        the warmup sweep should have absorbed; the micro-batcher's probe
+        discards such samples as compile transients."""
+        failpoint("scorer.dispatch.batch")
+        eng = self.variant.engine_id
+        with self._swap_lock:
+            pairs, serving = self.pairs, self.serving
+
+        def on_dispatch(n: int, bucket: int, fresh: bool) -> None:
+            self._bucket_dispatch_total.inc(engine_id=eng, bucket=str(bucket))
+            self._bucket_occ_cell.observe(n / bucket)
+            if fresh:
+                self._bucket_retrace_total.inc(engine_id=eng)
+
+        return dispatch_bucketed(
+            self._buckets, queries,
+            lambda qs: self._run_batch(pairs, serving, qs),
+            on_dispatch=on_dispatch,
+        )
+
     def get_stats(self, req: Request):
         window_s = float_param(req.params, "window", 0.0, lo=0.0)
         if window_s > 0:
@@ -1054,6 +1399,20 @@ class QueryServerService:
                 out["stages"] = stages
         if self._batcher is not None:
             out["microbatch"] = self._batcher.to_dict()
+        out["buckets"] = self._buckets.to_dict()
+        if self._lane_drainer is not None:
+            out["batchLane"] = {
+                "role": "drainer",
+                "cycles": self._lane_drainer.cycles,
+                "drained": self._lane_drainer.drained,
+                "pendingDepth": self._lane_seg.pending_depth(),
+            }
+        elif self._lane_client is not None:
+            out["batchLane"] = {
+                "role": "client",
+                "worker": self._pool_idx,
+                "timeoutS": self._lane_client.timeout_s,
+            }
         if self._pool_idx is not None:
             # pool mode: these are ONE worker's numbers (the kernel
             # balanced this connection here); pool-wide totals live on
@@ -1242,6 +1601,11 @@ class QueryServerService:
         self._deployed = False
         if self._batcher is not None:
             self._batcher.stop()
+        if self._lane_drainer is not None:
+            # answer in-flight lane slots before the workers die so no
+            # sibling blocks out its full timeout during teardown
+            self._lane_drainer.stop()
+            self._lane_drainer = None
         server, shutdown_evt = self._server, self._pool_shutdown
 
         def _after():

@@ -73,7 +73,8 @@ _HEALTH_FAILS_TO_KILL = 3
 
 
 def _worker_main(spec: dict, idx: int, gen, shutdown_evt,
-                 health_ports=None) -> None:
+                 health_ports=None, lane_doorbell=None,
+                 lane_resp_events=None) -> None:
     """Entry point of one pool worker (spawned process)."""
     if not (spec["device_worker"] and idx == 0):
         # host-mirror scoring only; pin JAX to CPU before ANY import can
@@ -111,6 +112,14 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt,
         metrics_path=spec.get("metrics_path"),
         sidecar_ports=health_ports,
     )
+    if spec.get("lane_path") and lane_doorbell is not None:
+        # cross-worker batch lane: worker 0 (the device owner) drains
+        # every stripe into one bucketed dispatch; siblings ship their
+        # query bodies over shared memory instead of scoring locally
+        service.enable_batch_lane(
+            spec["lane_path"], lane_doorbell, lane_resp_events,
+            device=(idx == 0),
+        )
     service.attach_server(server)
     server.start()
     # health sidecar: the pool shares ONE SO_REUSEPORT port, so the
@@ -268,6 +277,39 @@ class ServingPool:
                 "pool metrics segment creation failed; workers expose "
                 "per-worker metrics only"
             )
+        # cross-worker batch lane (ISSUE 7): only meaningful when ONE
+        # worker owns the accelerator (device_worker) and there are
+        # siblings to aggregate — a homogeneous CPU pool serves faster
+        # per-process than funneled through one drainer. PIO_TPU_BATCH_LANE=0
+        # force-disables.
+        self._lane_seg = None
+        self._lane_doorbell = None
+        self._lane_resp_events = None
+        if (
+            device_worker and n_workers > 1
+            and os.environ.get("PIO_TPU_BATCH_LANE", "1") != "0"
+        ):
+            try:
+                from pio_tpu.server.batchlane import BatchLaneSegment
+
+                fd, lane_path = tempfile.mkstemp(
+                    prefix="pio-tpu-batch-lane-", suffix=".shm"
+                )
+                os.close(fd)
+                self._lane_seg = BatchLaneSegment.create(
+                    lane_path, n_workers
+                )
+                self._spec["lane_path"] = lane_path
+                self._lane_doorbell = self._ctx.Event()
+                self._lane_resp_events = [
+                    self._ctx.Event() for _ in range(n_workers)
+                ]
+            except Exception:
+                log.exception(
+                    "batch lane segment creation failed; workers serve "
+                    "locally"
+                )
+                self._lane_seg = None
 
     def _spawn(self, idx: int):
         self._health_ports[idx] = 0  # stale port from a previous life
@@ -277,7 +319,8 @@ class ServingPool:
             target=_worker_main,
             args=(
                 self._spec, idx, self._gen, self._shutdown,
-                self._health_ports,
+                self._health_ports, self._lane_doorbell,
+                self._lane_resp_events,
             ),
             name=f"pio-tpu-serve-{idx}",
             daemon=True,
@@ -457,3 +500,10 @@ class ServingPool:
             except OSError:
                 pass
             self._metrics_seg = None
+        if self._lane_seg is not None:
+            try:
+                self._lane_seg.close()
+                self._lane_seg.unlink()
+            except OSError:
+                pass
+            self._lane_seg = None

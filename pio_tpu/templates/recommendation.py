@@ -22,7 +22,7 @@ Query ``{"user": "u1", "num": 4}`` →
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -291,6 +291,13 @@ class ALSAlgorithm(Algorithm):
         return predict_user_topn(
             model, query, model.user_index, model.item_index
         )
+
+    def warmup_query(self, model: ALSModel) -> Optional[Query]:
+        """Any known user exercises the batched top-N program — enough
+        to compile each serving shape bucket at deploy."""
+        if len(model.user_index) == 0:
+            return None
+        return Query(user=model.user_index.inverse[0])
 
     def batch_predict(self, model: ALSModel, queries):
         """Vectorized offline scoring (reference ``batchPredictBase``):

@@ -275,6 +275,14 @@ class SeqRecAlgorithm(Algorithm):
             return codes or None
         return model.user_histories.get(query.user)
 
+    def warmup_query(self, model: SeqRecEngineModel) -> Optional[Query]:
+        """Any user with a training-time history drives the [B, T]
+        transformer forward — enough to compile each serving bucket."""
+        for u, hist in model.user_histories.items():
+            if hist:
+                return Query(user=u)
+        return None
+
     def predict(
         self, model: SeqRecEngineModel, query: Query
     ) -> PredictedResult:

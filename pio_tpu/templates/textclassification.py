@@ -276,6 +276,11 @@ class MLPAlgorithm(Algorithm):
             model.mlp.predict_proba(ids, w)[0], model.label_index
         )
 
+    def warmup_query(self, model: TextMLPModel) -> Query:
+        """Bag width is fixed per model, so any text (even empty)
+        produces the serving input shape — enough to warm each bucket."""
+        return Query(text="warmup")
+
     def batch_predict(self, model: TextMLPModel, queries):
         """Tokenize per query on host, then one device forward per
         bounded chunk of stacked [B, L] bags."""
@@ -331,6 +336,11 @@ class NBAlgorithm(Algorithm):
         )
         log_p = model.nb.scores_bags(ids, w)[0]
         return _proba_result(_softmax(log_p), model.label_index)
+
+    def warmup_query(self, model: TextNBModel) -> Query:
+        """Bag width is fixed per model, so any text (even empty)
+        produces the serving input shape — enough to warm each bucket."""
+        return Query(text="warmup")
 
     def batch_predict(self, model: TextNBModel, queries):
         """Tokenize per query on host, then one scores_bags call per

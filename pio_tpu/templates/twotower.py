@@ -133,6 +133,13 @@ class TwoTowerAlgorithm(Algorithm):
             model, query, model.user_index, model.item_index
         )
 
+    def warmup_query(self, model: TwoTowerEngineModel):
+        """Any known user exercises the batched top-N program — enough
+        to compile each serving shape bucket at deploy."""
+        if len(model.user_index) == 0:
+            return None
+        return Query(user=model.user_index.inverse[0])
+
     def batch_predict(self, model: TwoTowerEngineModel, queries):
         """Vectorized offline scoring: one device dispatch per chunk of
         known-user top-N queries (shared routing with the ALS template)."""

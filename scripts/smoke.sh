@@ -238,8 +238,11 @@ import json, sys
 body = json.load(sys.stdin)
 assert body["shed"]["rate_limit"] >= 1, body["shed"]
 ' || fail "/qos.json did not count the rate_limit shed"
-curl -fsS --max-time 10 "$BASE/metrics" \
-    | grep -q 'pio_tpu_qos_shed_total{.*reason="rate_limit"' \
+# capture, THEN grep: grep -q exits at first match and a direct pipe
+# would hand curl a SIGPIPE (exit 23) under pipefail once the /metrics
+# body outgrows the pipe buffer
+SHED_METRICS="$(curl -fsS --max-time 10 "$BASE/metrics")"
+grep -q 'pio_tpu_qos_shed_total{.*reason="rate_limit"' <<<"$SHED_METRICS" \
     || fail "/metrics missing pio_tpu_qos_shed_total rate_limit sample"
 echo "ok   shed accounted in /qos.json + /metrics"
 
@@ -334,9 +337,190 @@ body = json.load(sys.stdin)
 assert body["enabled"] is True, body
 assert sum(t["count"] for t in body["triggered"]) >= 1, body
 ' || fail "/faults.json missing armed spec / trigger counts"
-curl -fsS --max-time 10 "$CBASE/metrics" \
-    | grep -q 'pio_tpu_fault_triggered_total{' \
+CHAOS_METRICS="$(curl -fsS --max-time 10 "$CBASE/metrics")"
+grep -q 'pio_tpu_fault_triggered_total{' <<<"$CHAOS_METRICS" \
     || fail "/metrics missing pio_tpu_fault_triggered_total sample"
 echo "ok   injections visible on /faults.json + /metrics"
+
+# -------------------------------------------------- pooled batch lane
+# ISSUE 7: a pooled server with the shape-bucket cache warmed and the
+# cross-worker batch lane armed must keep the micro-batcher engaged
+# under concurrent load (mode != "off") and never retrace a bucket in
+# steady state (the retrace counter stays flat across the timed
+# window). The driver is a real temp FILE, not a heredoc on stdin:
+# the pool's spawn context re-imports __main__ in every worker
+# (__mp_main__), which needs an importable path — the module guards
+# its body with __name__ == "__main__" so workers import it inertly.
+POOL_STAGE="$WORKDIR/pool_stage.py"
+cat > "$POOL_STAGE" <<'PY'
+"""Smoke stage: pooled serving with shape buckets + the batch lane.
+
+Boots a 2-worker SO_REUSEPORT pool (worker 0 designated device owner so
+the lane arms), drives concurrent load, then asserts on the OUTSIDE
+view (/metrics pool-wide sums, /stats.json):
+
+- the bucket retrace counter is FLAT across the steady-state window
+  (every batch shape was served by a warmed executable),
+- the batch lane actually moved traffic (drained counter > 0),
+- the micro-batcher did not latch off (``mode != "off"``).
+"""
+import datetime as dt
+import json
+import os
+import threading
+import time
+import urllib.request
+
+
+def _post(base, body, timeout=30):
+    req = urllib.request.Request(
+        base + "/queries.json",
+        data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _get(base, path, timeout=10):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.read().decode("utf-8")
+
+
+def _counter_total(metrics_text, name):
+    """Sum every sample of one counter family in Prometheus text (the
+    scrape already sums worker stripes; this folds label cells)."""
+    total = 0.0
+    for line in metrics_text.splitlines():
+        if line.startswith(name + "{") or line.startswith(name + " "):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _drive(base, n_threads, n_each, retry=False):
+    errs = []
+
+    def run(t):
+        for q in range(n_each):
+            body = {"user": "u%d" % ((t * 31 + q) % 8), "num": 3}
+            for attempt in range(40 if retry else 1):
+                try:
+                    got = _post(base, body)
+                    assert "itemScores" in got, got
+                    break
+                except Exception as exc:  # 503 while a worker warms up
+                    if not retry or attempt == 39:
+                        errs.append(exc)
+                        return
+                    time.sleep(0.5)
+
+    threads = [
+        threading.Thread(target=run, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        raise SystemExit(f"pool load failed: {errs[:3]}")
+
+
+def main():
+    os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "SQ"
+    os.environ["PIO_STORAGE_SOURCES_SQ_TYPE"] = "sqlite"
+    os.environ["PIO_STORAGE_SOURCES_SQ_PATH"] = os.path.join(
+        os.environ["PIO_TPU_HOME"], "pool.db")
+    os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "SQ"
+    os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "SQ"
+    # batching on (the micro-batcher + the warmup sweep key off this);
+    # a short ladder keeps the per-worker CPU warmup sweep quick
+    os.environ["PIO_TPU_SERVE_MICROBATCH_US"] = "1500"
+    os.environ["PIO_TPU_BUCKET_WARMUP"] = "1"
+    os.environ["PIO_TPU_BATCH_BUCKETS"] = "1,2,4,8"
+
+    import pio_tpu.templates  # noqa: F401  (registers the factory)
+    from pio_tpu.controller import ComputeContext
+    from pio_tpu.data import Event
+    from pio_tpu.server.worker_pool import ServingPool
+    from pio_tpu.storage import App, Storage
+    from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+    app_id = Storage.get_meta_data_apps().insert(App(0, "smoke-pool"))
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    for u in range(8):
+        for i in range(6):
+            in_block = (u < 4) == (i < 3)
+            le.insert(
+                Event("rate", "user", f"u{u}", "item", f"i{i}",
+                      properties={"rating": 5.0 if in_block else 1.0},
+                      event_time=t0),
+                app_id,
+            )
+    variant = variant_from_dict({
+        "id": "smoke-pool-rec",
+        "engineFactory": "templates.recommendation",
+        "datasource": {"params": {"app_name": "smoke-pool"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "num_iterations": 4, "lambda_": 0.1}}],
+    })
+    engine, ep = build_engine(variant)
+    run_train(engine, ep, variant, ctx=ComputeContext.local())
+
+    pool = ServingPool(
+        variant, host="127.0.0.1", port=0, n_workers=2,
+        device_worker=True,
+    )
+    pool.start()
+    try:
+        pool.wait_ready(timeout=240.0)
+        base = f"http://127.0.0.1:{pool.port}"
+        # settle round: /readyz only vouches for the worker the kernel
+        # happened to pick, so retry 503s until BOTH workers are
+        # deployed + warmed; any cold compile (first num=3 top-k) lands
+        # here, outside the timed window
+        _drive(base, 8, 5, retry=True)
+        retrace_before = _counter_total(
+            _get(base, "/metrics"), "pio_tpu_bucket_retrace_total")
+        # steady state: 16 concurrent clients across both workers
+        _drive(base, 16, 10)
+        metrics = _get(base, "/metrics")
+        retrace_after = _counter_total(
+            metrics, "pio_tpu_bucket_retrace_total")
+        assert retrace_after == retrace_before, (
+            f"bucket retraces moved {retrace_before} -> {retrace_after} "
+            f"under steady-state load: a batch shape escaped the "
+            f"warmed ladder")
+        drained = _counter_total(
+            metrics, "pio_tpu_batchlane_drained_total")
+        assert drained >= 1, (
+            f"batch lane never drained a request (drained={drained}); "
+            f"pool queries are not aggregating")
+        # the micro-batcher must not have latched off; sample stats over
+        # several connections (the kernel picks the answering worker)
+        modes = {}
+        for _ in range(12):
+            st = json.loads(_get(base, "/stats.json"))
+            mb = st.get("microbatch")
+            if mb is not None:
+                modes[st.get("worker")] = mb["mode"]
+        assert modes, "no worker reported micro-batch stats"
+        assert "off" not in modes.values(), (
+            f"micro-batcher latched off under pooled load: {modes}")
+        print(f"pool stage: modes={modes} drained={int(drained)} "
+              f"retraces={int(retrace_after)}")
+    finally:
+        pool.stop()
+
+
+if __name__ == "__main__":
+    main()
+PY
+# PYTHONPATH: the driver lives in $WORKDIR, so sys.path[0] is /tmp —
+# point it (and the spawned pool workers, which inherit the env) at
+# this checkout
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" python "$POOL_STAGE" \
+    || fail "pooled batch-lane stage (mode/retrace/lane assertions)"
+echo "ok   pooled serving: micro-batcher engaged, retraces flat, lane drained"
 
 echo "smoke OK"

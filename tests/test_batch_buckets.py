@@ -1,0 +1,382 @@
+"""Shape-bucket execution cache + cross-worker batch lane (ISSUE 7).
+
+Covers the bucket policy at boundary sizes (B=1, B=bucket, B=bucket+1),
+warmed-generation eviction on model hot-swap, the retrace counter
+staying flat across steady-state dispatches, and the lane's SPSC slot
+protocol driven in-process with ``threading.Event`` doorbells.
+"""
+
+import threading
+import time
+
+import pytest
+
+import pio_tpu.templates  # noqa: F401
+from pio_tpu.obs.metrics import monotonic_s
+from pio_tpu.server.batchlane import (
+    BatchLaneSegment,
+    LaneClient,
+    LaneDrainer,
+    LaneFallback,
+    STATUS_ERROR,
+)
+from pio_tpu.server.bucketcache import (
+    BucketExecutionCache,
+    buckets_from_env,
+    dispatch_bucketed,
+)
+
+# --------------------------------------------------------------- policy
+
+
+class TestBucketPolicy:
+    def test_bucket_for_boundaries(self):
+        c = BucketExecutionCache(buckets=(1, 2, 4, 8))
+        assert c.bucket_for(1) == 1          # B == smallest bucket
+        assert c.bucket_for(2) == 2          # B == bucket
+        assert c.bucket_for(3) == 4          # B == bucket + 1 → next up
+        assert c.bucket_for(8) == 8          # B == max bucket
+        assert c.max_bucket == 8
+
+    def test_pad_exact_bucket_no_copy(self):
+        c = BucketExecutionCache(buckets=(1, 2, 4))
+        qs = ["a", "b"]
+        padded, bucket = c.pad(qs)
+        assert bucket == 2 and padded is qs  # no padding allocation
+
+    def test_pad_replicates_last(self):
+        c = BucketExecutionCache(buckets=(1, 2, 4))
+        padded, bucket = c.pad(["a", "b", "c"])  # bucket+1 → pad to 4
+        assert bucket == 4
+        assert padded == ["a", "b", "c", "c"]
+
+    def test_pad_single(self):
+        c = BucketExecutionCache(buckets=(1, 2, 4))
+        padded, bucket = c.pad(["a"])
+        assert bucket == 1 and padded == ["a"]
+
+    def test_chunks_oversize(self):
+        c = BucketExecutionCache(buckets=(1, 2, 4))
+        assert c.chunks(4) == [4]
+        assert c.chunks(5) == [4, 1]
+        assert c.chunks(11) == [4, 4, 3]
+
+    def test_env_ladder(self, monkeypatch):
+        monkeypatch.setenv("PIO_TPU_BATCH_BUCKETS", "8,1,4")
+        assert buckets_from_env() == (1, 4, 8)
+
+    def test_env_ladder_malformed_falls_back(self, monkeypatch):
+        monkeypatch.setenv("PIO_TPU_BATCH_BUCKETS", "2,zap")
+        assert buckets_from_env() == (1, 2, 4, 8, 16, 32)
+        monkeypatch.setenv("PIO_TPU_BATCH_BUCKETS", "0,2")
+        assert buckets_from_env() == (1, 2, 4, 8, 16, 32)
+
+
+class TestWarmedGeneration:
+    def test_cold_dispatch_counts_retrace_once(self):
+        c = BucketExecutionCache(buckets=(1, 2, 4))
+        assert c.note_dispatch(2) is True     # cold → retrace
+        assert c.retraces == 1
+        assert c.note_dispatch(2) is False    # now warmed
+        assert c.retraces == 1
+
+    def test_install_marks_warm(self):
+        c = BucketExecutionCache(buckets=(1, 2, 4))
+        c.install([1, 2, 4])
+        assert c.warmed == {1, 2, 4} and c.generation == 1
+        assert c.note_dispatch(4) is False and c.retraces == 0
+
+    def test_hot_swap_evicts(self):
+        c = BucketExecutionCache(buckets=(1, 2, 4))
+        c.install([1, 2, 4])
+        c.install([1, 2])                     # new generation
+        assert c.evictions == 3               # old entries evicted
+        assert c.generation == 2
+        assert c.note_dispatch(4) is True     # 4 is cold again
+
+    def test_retrace_flat_over_steady_state(self):
+        c = BucketExecutionCache(buckets=(1, 2, 4))
+        c.install([1, 2, 4])
+        calls = []
+
+        def run(padded):
+            calls.append(len(padded))
+            return [q.upper() for q in padded]
+
+        for i in range(100):
+            n = (i % 4) + 1                   # B = 1..4 forever
+            results, fresh = dispatch_bucketed(c, ["q"] * n, run)
+            assert len(results) == n and not fresh
+        assert c.retraces == 0                # flat across all 100
+        assert set(calls) <= {1, 2, 4}        # only bucket shapes ran
+
+    def test_dispatch_slices_padding(self):
+        c = BucketExecutionCache(buckets=(2, 4))
+        results, fresh = dispatch_bucketed(
+            c, ["a", "b", "c"], lambda qs: [q + "!" for q in qs]
+        )
+        assert results == ["a!", "b!", "c!"]
+        assert fresh is True                  # nothing installed → cold
+
+    def test_dispatch_chunks_oversize(self):
+        c = BucketExecutionCache(buckets=(1, 2))
+        c.install([1, 2])
+        seen = []
+        results, fresh = dispatch_bucketed(
+            c, list("abcde"), lambda qs: (seen.append(len(qs)), qs)[1]
+        )
+        assert results == list("abcde") and not fresh
+        assert seen == [2, 2, 1]              # max-bucket chunking
+
+    def test_on_dispatch_hook(self):
+        c = BucketExecutionCache(buckets=(2, 4))
+        c.install([2])
+        events = []
+        dispatch_bucketed(
+            c, ["a", "b", "c"], lambda qs: qs,
+            on_dispatch=lambda n, b, fresh: events.append((n, b, fresh)),
+        )
+        assert events == [(3, 4, True)]
+
+
+# ------------------------------------------------------------ batch lane
+
+
+def _lane(tmp_path, n_workers=2, **kw):
+    path = str(tmp_path / "lane.shm")
+    seg = BatchLaneSegment.create(path, n_workers, **kw)
+    doorbell = threading.Event()
+    resp = [threading.Event() for _ in range(n_workers)]
+    return seg, doorbell, resp
+
+
+class TestBatchLane:
+    def test_open_rejects_garbage(self, tmp_path):
+        p = tmp_path / "junk.shm"
+        p.write_bytes(b"NOTALANE" + b"\0" * 64)
+        with pytest.raises(ValueError):
+            BatchLaneSegment.open(str(p))
+
+    def test_roundtrip_aggregates_across_workers(self, tmp_path):
+        seg, doorbell, resp = _lane(tmp_path, n_workers=3)
+        batches = []
+
+        def dispatch(bodies):
+            batches.append(len(bodies))
+            return [{"echo": b["user"]} for b in bodies]
+
+        drainer = LaneDrainer(seg, dispatch, doorbell, resp)
+        clients = [
+            LaneClient(seg, w, doorbell, resp[w], timeout_s=5.0)
+            for w in (1, 2)
+        ]
+        out = {}
+
+        def submit(w):
+            out[w] = clients[w - 1].submit({"user": f"u{w}"})
+
+        threads = [
+            threading.Thread(target=submit, args=(w,)) for w in (1, 2)
+        ]
+        for t in threads:
+            t.start()
+        # both requests posted before one manual drain → ONE cross-worker
+        # batch
+        deadline = monotonic_s() + 5.0
+        while seg.pending_depth() < 2 and monotonic_s() < deadline:
+            time.sleep(0.002)
+        assert drainer.drain_once() == 2
+        for t in threads:
+            t.join(timeout=5.0)
+        assert out == {1: {"echo": "u1"}, 2: {"echo": "u2"}}
+        assert batches == [2]
+        assert seg.pending_depth() == 0
+
+    def test_drainer_thread_serves(self, tmp_path):
+        seg, doorbell, resp = _lane(tmp_path)
+        drainer = LaneDrainer(
+            seg, lambda bodies: [{"n": len(bodies)} for _ in bodies],
+            doorbell, resp, poll_s=0.01,
+        ).start()
+        try:
+            client = LaneClient(seg, 1, doorbell, resp[1], timeout_s=5.0)
+            assert client.submit({"q": 1}) == {"n": 1}
+            assert client.submit({"q": 2}) == {"n": 1}
+        finally:
+            drainer.stop()
+        assert drainer.drained == 2
+
+    def test_oversize_body_falls_back(self, tmp_path):
+        seg, doorbell, resp = _lane(tmp_path, payload_bytes=64)
+        client = LaneClient(seg, 0, doorbell, resp[0], timeout_s=0.2)
+        with pytest.raises(LaneFallback) as ei:
+            client.submit({"blob": "x" * 200})
+        assert ei.value.reason == "oversize"
+
+    def test_full_stripe_falls_back(self, tmp_path):
+        seg, doorbell, resp = _lane(tmp_path, slots_per_worker=2)
+        client = LaneClient(seg, 0, doorbell, resp[0], timeout_s=0.05)
+        # no drainer: both slots end up in-flight (timeout), third is full
+        for _ in range(2):
+            with pytest.raises(LaneFallback) as ei:
+                client.submit({"q": 1})
+            assert ei.value.reason == "timeout"
+        with pytest.raises(LaneFallback) as ei:
+            client.submit({"q": 1})
+        assert ei.value.reason == "full"
+
+    def test_timed_out_slot_reclaimed_after_answer(self, tmp_path):
+        seg, doorbell, resp = _lane(tmp_path, slots_per_worker=1)
+        client = LaneClient(seg, 0, doorbell, resp[0], timeout_s=0.05)
+        with pytest.raises(LaneFallback):
+            client.submit({"q": "zombie"})
+        # late drainer answers the abandoned slot...
+        drainer = LaneDrainer(
+            seg, lambda bodies: [{"late": True}] * len(bodies),
+            doorbell, resp,
+        )
+        assert drainer.drain_once() == 1
+        # ...after which the stripe is usable again
+        drainer.start()
+        try:
+            assert client.submit(
+                {"q": "fresh"}, timeout_s=5.0
+            ) == {"late": True}
+        finally:
+            drainer.stop()
+
+    def test_dispatch_error_reports_remote_error(self, tmp_path):
+        seg, doorbell, resp = _lane(tmp_path)
+
+        def boom(bodies):
+            raise RuntimeError("model died")
+
+        drainer = LaneDrainer(seg, boom, doorbell, resp, poll_s=0.01)
+        drainer.start()
+        try:
+            client = LaneClient(seg, 1, doorbell, resp[1], timeout_s=5.0)
+            with pytest.raises(LaneFallback) as ei:
+                client.submit({"q": 1})
+            assert ei.value.reason == "remote_error"
+        finally:
+            drainer.stop()
+
+    def test_undecodable_request_errors_only_that_slot(self, tmp_path):
+        seg, doorbell, resp = _lane(tmp_path)
+        seg.post_request(0, 0, b"\xff\xfenot json")
+        drainer = LaneDrainer(
+            seg, lambda bodies: [{"ok": True}] * len(bodies), doorbell, resp
+        )
+        assert drainer.drain_once() == 0      # nothing dispatchable
+        status, _ = seg.read_response(0, 0, 1)
+        assert status == STATUS_ERROR
+
+
+# ----------------------------------------------------- service integration
+# Mirrors tests/test_servers.py's fixture shape: memory storage + a tiny
+# trained ALS instance, then drives the service's bucketed dispatch path
+# directly (no HTTP needed for the cache semantics).
+
+import datetime as dt  # noqa: E402
+
+from pio_tpu.controller import ComputeContext  # noqa: E402
+from pio_tpu.data import Event  # noqa: E402
+from pio_tpu.server.query_server import QueryServerService  # noqa: E402
+from pio_tpu.storage import App, Storage  # noqa: E402
+from pio_tpu.workflow import (  # noqa: E402
+    build_engine,
+    run_train,
+    variant_from_dict,
+)
+
+VARIANT = {
+    "id": "rec-buckets",
+    "engineFactory": "templates.recommendation",
+    "datasource": {"params": {"app_name": "bucket-test"}},
+    "algorithms": [
+        {"name": "als",
+         "params": {"rank": 4, "num_iterations": 4, "lambda_": 0.1}}
+    ],
+}
+
+
+@pytest.fixture()
+def mem_storage(tmp_home, monkeypatch):
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "MEM")
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def _train_instance():
+    app_id = Storage.get_meta_data_apps().insert(App(0, "bucket-test"))
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 5, 1, tzinfo=dt.timezone.utc)
+    for u in range(6):
+        for i in range(5):
+            le.insert(
+                Event("rate", "user", f"u{u}", "item", f"i{i}",
+                      properties={"rating": 4.0}, event_time=t0),
+                app_id,
+            )
+    variant = variant_from_dict(VARIANT)
+    engine, ep = build_engine(variant)
+    ctx = ComputeContext.local()
+    run_train(engine, ep, variant, ctx=ctx)
+    return variant, ctx
+
+
+@pytest.fixture()
+def bucket_service(mem_storage, monkeypatch):
+    monkeypatch.setenv("PIO_TPU_BATCH_BUCKETS", "1,2,4")
+    monkeypatch.setenv("PIO_TPU_BUCKET_WARMUP", "1")
+    variant, ctx = _train_instance()
+    yield QueryServerService(variant, ctx=ctx)
+
+
+class TestServiceBuckets:
+    def test_deploy_warms_every_bucket(self, bucket_service):
+        svc = bucket_service
+        assert svc._buckets.warmed == {1, 2, 4}
+        assert svc._buckets.generation == 1
+        assert svc._buckets.retraces == 0
+
+    def test_steady_state_never_retraces(self, bucket_service):
+        svc = bucket_service
+        from pio_tpu.templates.recommendation import Query
+
+        for i in range(100):
+            n = (i % 5) + 1                   # includes bucket+1 and >max
+            qs = [Query(user=f"u{j % 6}", num=2) for j in range(n)]
+            results, fresh = svc._predict_batch_bucketed(qs)
+            assert len(results) == n and not fresh
+        assert svc._buckets.retraces == 0
+
+    def test_batch_matches_solo_results(self, bucket_service):
+        svc = bucket_service
+        from pio_tpu.templates.recommendation import Query
+
+        qs = [Query(user=f"u{j}", num=3) for j in range(3)]  # pads to 4
+        batched = svc._predict_batch(qs)
+        for q, got in zip(qs, batched):
+            solo = svc._predict_one(q)
+            assert [s.item for s in got.item_scores] == \
+                [s.item for s in solo.item_scores]
+
+    def test_hot_swap_evicts_and_rewarms(self, bucket_service):
+        svc = bucket_service
+        gen0 = svc._buckets.generation
+        svc._load(None)                       # the /reload path
+        assert svc._buckets.generation == gen0 + 1
+        assert svc._buckets.evictions >= 3    # old generation evicted
+        assert svc._buckets.warmed == {1, 2, 4}  # new one re-warmed
+
+    def test_warmup_skipped_without_batching(self, mem_storage, monkeypatch):
+        monkeypatch.delenv("PIO_TPU_BUCKET_WARMUP", raising=False)
+        monkeypatch.delenv("PIO_TPU_SERVE_MICROBATCH_US", raising=False)
+        variant, ctx = _train_instance()
+        svc = QueryServerService(variant, ctx=ctx)
+        assert svc._buckets.warmed == frozenset()
