@@ -109,6 +109,10 @@ FAILPOINT_NAMESPACES = (
     "storage.",
     "groupcommit.",
     "scorer.",
+    # device-resident serving sub-namespaces (subsumed by "scorer." but
+    # listed so --dump-failpoints readers see them as first-class)
+    "scorer.h2d.",
+    "scorer.donate.",
     "worker.",
     "batchlane.",
 )

@@ -122,6 +122,18 @@ class Algorithm(Component, Generic[PD, M, Q, P]):
         counted as retraces."""
         return None
 
+    def resident_scorer(self, model: M):
+        """Build a device-resident scorer for ``model`` (a
+        ``pio_tpu.server.residency.ResidentLinearScorer`` or compatible:
+        ``bind``/``prealloc``/``retire``/``to_dict``), or None (the
+        default) when this template has no resident serving path. The
+        query server calls this at deploy/hot-swap — behind the swap
+        lock, generation-bumped with the shape-bucket cache — and
+        attaches the result to the model, so ``predict``/
+        ``batch_predict`` implementations that honor it serve from
+        device-placed params instead of the host mirror."""
+        return None
+
 
 # Reference-parity aliases (see module docstring): the P/L/P2L distinction is
 # a Spark artifact; on a mesh all algorithms are "distributed".

@@ -115,11 +115,19 @@ class LogRegConfig:
 
 @dataclasses.dataclass
 class LogRegModel:
-    """weights [D, C] float32, bias [C] float32, plus class count."""
+    """weights [D, C] float32, bias [C] float32, plus class count.
+
+    ``feature_scales`` [D] float32 are the per-column symmetric
+    quantization scales observed on the TRAINING features (None on
+    models persisted before they were recorded): the serving-side int8
+    wire folds them into device-resident weights so query features can
+    ship as one byte per column (see ``pio_tpu/server/residency.py``).
+    """
 
     weights: np.ndarray
     bias: np.ndarray
     n_classes: int
+    feature_scales: Optional[np.ndarray] = None
 
     def logits(self, X: np.ndarray) -> np.ndarray:
         return X.astype(np.float32) @ self.weights + self.bias
@@ -188,12 +196,14 @@ def train_logreg(
         "b": jnp.zeros((n_classes,), jnp.float32),
     }
 
-    # per-column symmetric quantization scales for the int8 wire; folded
-    # into the weights on device so the learned W applies to RAW floats
-    scales = None
-    if config.input_dtype == "int8":
-        s = np.abs(X).max(axis=0)
-        scales = np.where(s == 0.0, 1.0, s / 127.0).astype(np.float32)
+    # per-column symmetric quantization scales: the int8 TRAINING wire
+    # folds them into the weights on device so the learned W applies to
+    # RAW floats; they also persist on the model (every mode — the pass
+    # is one reduction) so the SERVING int8 wire can quantize query
+    # features with the same training-side scales
+    s = np.abs(X).max(axis=0)
+    feature_scales = np.where(s == 0.0, 1.0, s / 127.0).astype(np.float32)
+    scales = feature_scales if config.input_dtype == "int8" else None
 
     def _prep(chunk: np.ndarray) -> np.ndarray:
         """Host-side wire encoding of a row span (the per-chunk work the
@@ -272,4 +282,5 @@ def train_logreg(
 
     return LogRegModel(
         weights=weights, bias=bias, n_classes=n_classes,
+        feature_scales=feature_scales,
     )

@@ -207,10 +207,15 @@ class MultinomialNBModel:
     Attributes:
         log_prior: [C] float32.
         log_theta: [C, D] float32 — smoothed log feature weights.
+        feature_scales: [D] float32 per-column int8 quantization scales
+            observed on the training features (None for bag-trained or
+            pre-existing models) — the serving int8 wire folds them into
+            device-resident weights (``pio_tpu/server/residency.py``).
     """
 
     log_prior: np.ndarray
     log_theta: np.ndarray
+    feature_scales: Optional[np.ndarray] = None
 
     @property
     def n_classes(self) -> int:
@@ -271,9 +276,13 @@ def train_multinomial_nb(
         return log_prior, log_theta
 
     log_prior, log_theta = fit(jnp.asarray(X), jnp.asarray(y))
+    s = np.abs(X).max(axis=0)
     return MultinomialNBModel(
         log_prior=np.asarray(log_prior, np.float32),
         log_theta=np.asarray(log_theta, np.float32),
+        feature_scales=np.where(
+            s == 0.0, 1.0, s / 127.0
+        ).astype(np.float32),
     )
 
 

@@ -40,6 +40,16 @@ hook on the device worker — works unchanged. Oversized bodies and a
 full stripe degrade to the submitter's local predict path (counted via
 ``pio_tpu_batchlane_full_total``), never to an error.
 
+Request payloads have ONE binary alternative (ISSUE 8): an int8-wire
+query packed as ``PACKED_MAGIC + u32 dim + dim int8 codes``. A JSON
+body always starts with ``{``/``[``/a quote, so the NUL-led magic can
+never collide; the drainer hands a decoded :class:`PackedQuery` to its
+dispatch function instead of a JSON body, and the device worker
+dequantizes it with the resident scorer's training scales (exact
+round trip — see ``server/residency.py``). Responses stay JSON in both
+cases: the win is the REQUEST direction, where a feature vector crosses
+the ring as one byte per column instead of its decimal text.
+
 Layout (little-endian)::
 
     0   8s  magic  b"PIOLANE1"
@@ -84,6 +94,47 @@ STATUS_OK = 0
 STATUS_ERROR = 1
 
 _SLOT_HDR = struct.Struct("<QQIII4x")
+
+#: packed int8 request frame: magic + u32 code count + the codes. The
+#: leading NUL is the JSON/binary discriminator (see module docstring).
+PACKED_MAGIC = b"\x00Q8\x01"
+_PACKED_HDR = struct.Struct("<4sI")
+
+
+class PackedQuery:
+    """An int8-wire query off the lane ring: ``codes`` is a ``[dim]``
+    int8 numpy array of quantized features. The drainer's dispatch
+    function (the device worker) rebuilds the template Query via the
+    resident scorer's ``dequantize`` + ``query_factory``."""
+
+    __slots__ = ("codes",)
+
+    def __init__(self, codes):
+        self.codes = codes
+
+    def __len__(self):
+        return len(self.codes)
+
+
+def pack_query_i8(codes) -> bytes:
+    """Encode a ``[dim]`` int8 code vector as a lane request frame."""
+    import numpy as np
+
+    codes = np.ascontiguousarray(codes, np.int8).reshape(-1)
+    return _PACKED_HDR.pack(PACKED_MAGIC, len(codes)) + codes.tobytes()
+
+
+def unpack_query_i8(payload: bytes) -> PackedQuery:
+    """Decode a packed frame (the caller already matched the magic)."""
+    import numpy as np
+
+    magic, dim = _PACKED_HDR.unpack_from(payload)
+    if magic != PACKED_MAGIC or len(payload) != _PACKED_HDR.size + dim:
+        raise ValueError("malformed packed lane frame")
+    return PackedQuery(
+        np.frombuffer(payload, np.int8, count=dim,
+                      offset=_PACKED_HDR.size).copy()
+    )
 
 
 class LaneFallback(Exception):
@@ -300,17 +351,25 @@ class LaneClient:
                 return s
         return None
 
-    def submit(self, body: dict, timeout_s: Optional[float] = None):
+    def submit(self, body: dict, timeout_s: Optional[float] = None,
+               packed: Optional[bytes] = None):
         """Serve one query body through the device worker; blocks until
         the response lands or the timeout elapses. Raises
         :class:`LaneFallback` whenever the lane cannot answer — the
         caller's local predict path is the degradation, so the lane can
-        never make a request fail that would have succeeded without it."""
+        never make a request fail that would have succeeded without it.
+
+        ``packed`` ships a pre-encoded binary frame (``pack_query_i8``)
+        instead of JSON-encoding ``body`` — the int8 wire's request
+        direction."""
         failpoint("batchlane.submit")
-        try:
-            payload = json.dumps(body).encode("utf-8")
-        except (TypeError, ValueError):
-            raise LaneFallback("unserializable")
+        if packed is not None:
+            payload = packed
+        else:
+            try:
+                payload = json.dumps(body).encode("utf-8")
+            except (TypeError, ValueError):
+                raise LaneFallback("unserializable")
         if len(payload) > self._seg.payload_bytes:
             raise LaneFallback("oversize")
         slot = self._acquire_slot()
@@ -402,7 +461,10 @@ class LaneDrainer:
                     continue
                 seq, payload = got
                 try:
-                    body = json.loads(payload.decode("utf-8"))
+                    if payload[:4] == PACKED_MAGIC:
+                        body = unpack_query_i8(payload)
+                    else:
+                        body = json.loads(payload.decode("utf-8"))
                 except (UnicodeDecodeError, ValueError):
                     self._seg.post_response(
                         w, s, seq, b'"undecodable"', STATUS_ERROR

@@ -133,15 +133,19 @@ class BucketExecutionCache:
             self.retraces += 1
         return True
 
-    def install(self, warmed: Sequence[int]) -> None:
+    def install(self, warmed: Sequence[int]) -> int:
         """Atomically swap in a new generation's warmed set (hot-swap
         eviction: whatever the old generation had compiled is dead —
-        the new model's shapes/weights own the jit caches now)."""
+        the new model's shapes/weights own the jit caches now).
+        Returns the new generation number so callers that co-version
+        other per-generation state (device-resident scorers) can stamp
+        it."""
         with self._lock:
             if self._warmed:
                 self.evictions += len(self._warmed)
             self._warmed = frozenset(warmed)
             self.generation += 1
+            return self.generation
 
     @property
     def warmed(self) -> frozenset:

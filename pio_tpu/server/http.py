@@ -274,6 +274,22 @@ def _ctype_line(ctype: str) -> bytes:
     return got
 
 
+#: per-THREAD response serialize buffer: head + payload assemble here
+#: and hit the socket as one write, reused across requests with no
+#: per-response allocation. Thread-local, NOT per-connection: handlers
+#: are not strictly confined to their accept thread (the batch-lane
+#: drainer answers laned requests from its own thread), and a shared
+#: bytearray would interleave two responses' bytes.
+_obuf_local = threading.local()
+
+
+def _thread_obuf() -> bytearray:
+    buf = getattr(_obuf_local, "buf", None)
+    if buf is None:
+        buf = _obuf_local.buf = bytearray()
+    return buf
+
+
 def _make_handler_class(
     router: Router,
     server_name: str,
@@ -316,10 +332,6 @@ def _make_handler_class(
 
         def handle(self):
             self.close_connection = False
-            #: per-connection serialize buffer, reused across keep-alive
-            #: requests: head + payload assemble here and hit the socket
-            #: as one write with no per-response bytes concatenation
-            self._obuf = bytearray()
             try:
                 while not self.close_connection:
                     if not self._handle_one():
@@ -370,7 +382,7 @@ def _make_handler_class(
                             self.wfile.write(chunk)
                 self.wfile.flush()
                 return
-            out = self._obuf
+            out = _thread_obuf()
             del out[:]
             if isinstance(body, RawResponse):
                 payload = (

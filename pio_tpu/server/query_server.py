@@ -18,6 +18,7 @@ logged back to the event store as a ``predict`` event on entity type
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import datetime as _dt
 import logging
@@ -47,7 +48,8 @@ from pio_tpu.qos import (
 )
 from pio_tpu.utils import envutil
 from pio_tpu.server.batchlane import (
-    BatchLaneSegment, LaneClient, LaneDrainer, LaneFallback,
+    BatchLaneSegment, LaneClient, LaneDrainer, LaneFallback, PackedQuery,
+    pack_query_i8,
 )
 from pio_tpu.server.bucketcache import (
     BucketExecutionCache, dispatch_bucketed,
@@ -167,6 +169,13 @@ class _MicroBatcher:
         self.reprobes = 0
         self._probe_lock = make_lock("query.microbatch.probe")
         self._probe: dict = {"batch": [], "solo": []}
+        #: per-bucket batched per-member latency samples (bounded ring,
+        #: fresh-bucket dispatches excluded) — the post-warmup honesty
+        #: map behind ``modeByBucket``: the single ``mode`` string is
+        #: one global verdict, but whether coalescing wins is a
+        #: PER-BUCKET question (a 64-wide dispatch amortizes RTT that a
+        #: 1-wide dispatch only adds handoffs to)
+        self._bucket_samples: dict = {}
         self._thread = threading.Thread(
             target=self._run, name="pio-tpu-microbatch", daemon=True
         )
@@ -307,8 +316,28 @@ class _MicroBatcher:
                 "batchedP50Ms": med(self._probe["batch"]),
                 "perQueryP50Ms": med(self._probe["solo"]),
             }
+            solo = sorted(self._probe["solo"])
+            solo_med = solo[len(solo) // 2] if solo else None
+        # post-warmup per-bucket verdict: each bucket's batched
+        # per-member p50 against the probe's per-query p50 — the honest
+        # answer to "which batch sizes is coalescing actually winning
+        # at", where the single `mode` string collapses them all
+        mode_by_bucket = {}
+        for b in sorted(self._bucket_samples):
+            xs = sorted(self._bucket_samples[b])
+            if not xs:
+                continue
+            p50 = xs[len(xs) // 2]
+            mode_by_bucket[str(b)] = {
+                "mode": (
+                    "on" if solo_med is None or p50 <= solo_med else "off"
+                ),
+                "p50Ms": round(p50 * 1e3, 3),
+                "samples": len(xs),
+            }
         return {
             "mode": self._mode,
+            "modeByBucket": mode_by_bucket,
             "probe": probe,
             "batches": self.batches,
             "batchedQueries": self.batched_queries,
@@ -401,6 +430,12 @@ class _MicroBatcher:
                 ) as btr:
                     results = self._service._predict_batch(queries)
                 exec_s = monotonic_s() - t_drain
+                bucket = cache.bucket_for(len(batch))
+                samples = self._bucket_samples.get(bucket)
+                if samples is None:
+                    samples = self._bucket_samples[bucket] = (
+                        collections.deque(maxlen=64)
+                    )
                 for p, r in zip(batch, results):
                     p[1] = r
                     p[5]["execute_s"] = exec_s
@@ -409,6 +444,10 @@ class _MicroBatcher:
                         # this dispatch paid a bucket compile — flag every
                         # member so the probe discards the transient
                         p[5]["fresh_bucket"] = True
+                    else:
+                        # per-member request latency (queue + execute)
+                        # under this bucket, steady-state samples only
+                        samples.append(p[5]["queue_s"] + exec_s)
             except Exception:
                 log.exception(
                     "micro-batch dispatch failed; per-query fallback "
@@ -617,6 +656,42 @@ class QueryServerService:
         for reason in ("full", "timeout", "oversize", "remote_error",
                        "unserializable", "undecodable_response"):
             self._lane_fallback_total.labels(eng, reason)
+        # -- device-resident serving (ISSUE 8): params placed on device
+        # once per generation, donated per-bucket dispatch buffers, int8
+        # query wire. Counters pre-created before any pool bind, same as
+        # the bucket/lane families above.
+        self._resident: List = []
+        self._h2d_bytes_total = self.obs.counter(
+            "pio_tpu_serving_h2d_bytes_total",
+            "Host→device feature bytes shipped by resident-scorer "
+            "dispatches (the int8 wire pays one byte per feature per "
+            "query; float32 pays four)",
+            ("engine_id",),
+        )
+        self._donation_total = self.obs.counter(
+            "pio_tpu_donation_total",
+            "Donated-buffer dispatch outcomes: hit = recycled the "
+            "standing per-bucket device buffer, miss = cold shape had "
+            "to allocate (once per bucket per generation)",
+            ("engine_id", "outcome"),
+        )
+        self._resident_params_bytes = self.obs.gauge(
+            "pio_tpu_resident_params_bytes",
+            "Device-resident serving parameter bytes for the deployed "
+            "generation (0 = host-mirror serving)",
+            ("engine_id",),
+        )
+        self._resident_models = self.obs.gauge(
+            "pio_tpu_resident_models",
+            "Models in the deployed generation serving from "
+            "device-resident params",
+            ("engine_id",),
+        )
+        self._h2d_bytes_total.labels(eng)
+        for outcome in ("hit", "miss"):
+            self._donation_total.labels(eng, outcome)
+        self._resident_params_bytes.labels(eng)
+        self._resident_models.labels(eng)
         self.profile_hook = DeviceProfileHook.from_env()
         self._swap_lock = make_lock("query.model_swap")
         self._deployed = True
@@ -675,11 +750,14 @@ class QueryServerService:
         # resolve once at load — a conflicting query-class config should fail
         # deploy/reload, not the first query
         query_class = resolve_query_class(pairs)
-        # bucket warmup runs on the INCOMING pairs before the swap is
-        # visible: on a /reload the old model keeps serving while the
-        # new generation's shape buckets compile, then the swap installs
-        # model + warmed set atomically (hot-swap = eviction of the old
-        # generation's entries)
+        # resident placement + bucket warmup run on the INCOMING pairs
+        # before the swap is visible: on a /reload the old model keeps
+        # serving while the new generation's params cross the link and
+        # its shape buckets compile, then the swap installs model +
+        # warmed set + resident scorers atomically (hot-swap = eviction
+        # of the old generation's entries AND retirement of its device
+        # params)
+        incoming = self._place_resident(pairs)
         warmed = self._warm_buckets(pairs, serving)
         eng = self.variant.engine_id
         with self._swap_lock:
@@ -689,9 +767,64 @@ class QueryServerService:
             self.query_class = query_class
             if self._buckets.warmed:
                 self._bucket_evictions_total.inc(engine_id=eng)
-            self._buckets.install(warmed)
+            gen = self._buckets.install(warmed)
             self._bucket_entries.set(len(warmed), engine_id=eng)
-        log.info("serving engine instance %s", instance_id)
+            outgoing, self._resident = self._resident, incoming
+        # retire OUTSIDE the lock: an in-flight dispatch that already
+        # read the old scorer finishes against still-live params, then
+        # every later read sees `retired` and falls back to the freshly
+        # swapped host mirror — stale weights can never answer
+        for sc in outgoing:
+            sc.retire()
+        self._resident_params_bytes.set(
+            sum(sc.placed_bytes for sc in incoming), engine_id=eng
+        )
+        self._resident_models.set(len(incoming), engine_id=eng)
+        log.info(
+            "serving engine instance %s (generation %d, %d resident)",
+            instance_id, gen, len(incoming),
+        )
+
+    def _place_resident(self, pairs) -> list:
+        """Build + place device-resident scorers for the incoming pairs
+        (``PIO_TPU_DEVICE_RESIDENT`` gate — see server/residency.py).
+        Each scorer is attached to its model as ``_resident`` so the
+        algorithm's predict/batch_predict dispatch through the device
+        params; a template without a scorer (or a build failure) keeps
+        its host-mirror path."""
+        from pio_tpu.server import residency
+
+        if not residency.enabled():
+            return []
+        eng = self.variant.engine_id
+
+        def on_h2d(nbytes: int) -> None:
+            self._h2d_bytes_total.inc(nbytes, engine_id=eng)
+
+        def on_donation(outcome: str) -> None:
+            self._donation_total.inc(engine_id=eng, outcome=outcome)
+
+        placed = []
+        for algo, m in pairs:
+            try:
+                sc = algo.resident_scorer(m)
+            except Exception:
+                log.exception(
+                    "resident_scorer failed for %s; model serves from "
+                    "the host mirror", type(algo).__name__,
+                )
+                continue
+            if sc is None:
+                continue
+            sc.bind(on_h2d=on_h2d, on_donation=on_donation)
+            sc.prealloc(self._buckets.buckets)
+            m._resident = sc
+            placed.append(sc)
+            log.info(
+                "resident scorer %r placed: %d param bytes, wire=%s",
+                sc.name, sc.placed_bytes, sc.wire,
+            )
+        return placed
 
     def _bucket_warm_enabled(self) -> bool:
         """Warm the bucket ladder only where batched dispatches can
@@ -1035,16 +1168,54 @@ class QueryServerService:
         """Drainer-side service: parse each shipped body with THIS
         worker's snapshot and serve the whole cycle as one bucketed
         batch. Runs on the drainer thread — sync the pool generation
-        first so a /reload elsewhere is honored here too."""
+        first so a /reload elsewhere is honored here too.
+
+        A body is either a JSON query body or a :class:`PackedQuery`
+        (int8 lane wire): packed features dequantize with this worker's
+        resident scales — identical to the submitter's, both came off
+        the same trained model — so the rebuilt query re-quantizes to
+        the exact codes that crossed the ring."""
         self._pool_sync()
         with self._swap_lock:
             qc = self.query_class
             serving = self.serving
-        queries = [
-            serving.supplement(self._parse_query(b, qc)) for b in bodies
-        ]
+            resident = list(self._resident)
+        sc = resident[0] if len(resident) == 1 else None
+
+        def to_query(b):
+            if isinstance(b, PackedQuery):
+                if sc is None or sc.scales is None \
+                        or sc.query_factory is None:
+                    raise ValueError(
+                        "packed lane query but no resident int8 scorer "
+                        "on the device worker"
+                    )
+                return sc.query_factory(sc.dequantize(b.codes))
+            return self._parse_query(b, qc)
+
+        queries = [serving.supplement(to_query(b)) for b in bodies]
         results, _fresh = self._predict_batch_bucketed(queries)
         return [_to_jsonable(r) for r in results]
+
+    def _lane_pack(self, query) -> Optional[bytes]:
+        """Wire-encode ``query`` as a packed int8 lane frame, or None to
+        ship the JSON body. Packing is sound only when exactly ONE
+        resident scorer serves on the int8 wire (the drainer dequantizes
+        with the same training scales, making the round trip exact) and
+        the query carries a dense feature vector."""
+        resident = self._resident
+        if len(resident) != 1:
+            return None
+        sc = resident[0]
+        if sc.wire != "int8" or sc.retired or sc.query_factory is None:
+            return None
+        vec = getattr(query, "vector", None)
+        if vec is None:
+            return None
+        try:
+            return pack_query_i8(sc.quantize(vec(sc.in_dim))[0])
+        except Exception:
+            return None
 
     def _pool_sync(self) -> None:
         gen = self._pool_gen
@@ -1171,7 +1342,8 @@ class QueryServerService:
                             )
                         try:
                             result = self._lane_client.submit(
-                                req.body, timeout_s=timeout_s
+                                req.body, timeout_s=timeout_s,
+                                packed=self._lane_pack(query),
                             )
                             self._lane_enqueued_total.inc(engine_id=eng)
                         except LaneFallback as lf:
@@ -1400,6 +1572,12 @@ class QueryServerService:
         if self._batcher is not None:
             out["microbatch"] = self._batcher.to_dict()
         out["buckets"] = self._buckets.to_dict()
+        resident = self._resident
+        out["residency"] = {
+            "enabled": bool(resident),
+            "paramBytes": sum(sc.placed_bytes for sc in resident),
+            "scorers": [sc.to_dict() for sc in resident],
+        }
         if self._lane_drainer is not None:
             out["batchLane"] = {
                 "role": "drainer",

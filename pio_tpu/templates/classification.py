@@ -217,14 +217,34 @@ class NaiveBayesAlgorithm(Algorithm):
 
     def predict(self, model: NBClassifierModel, query: Query) -> PredictedResult:
         x = query.vector(model.dim)
-        code = int(model.nb.predict(x)[0])
+        scorer = _resident_of(model)
+        if scorer is not None:
+            code = int(scorer.score_codes(x)[0])
+        else:
+            code = int(model.nb.predict(x)[0])
         return PredictedResult(label=model.label_index.inverse[code])
 
     def batch_predict(self, model: NBClassifierModel, queries):
         """One batched scoring call for the whole query file (the model
         predict already takes [B, d])."""
+        scorer = _resident_of(model)
         return _batch_label_results(
-            model, queries, lambda X: model.nb.predict(X)
+            model,
+            queries,
+            scorer.score_codes if scorer is not None
+            else lambda X: model.nb.predict(X),
+        )
+
+    def warmup_query(self, model: NBClassifierModel) -> Query:
+        return Query(attrs=(0.0,) * model.dim)
+
+    def resident_scorer(self, model: NBClassifierModel):
+        return _linear_resident(
+            "naivebayes",
+            model,
+            weights=model.nb.log_theta.T,
+            bias=model.nb.log_prior,
+            scales=getattr(model.nb, "feature_scales", None),
         )
 
 
@@ -277,14 +297,64 @@ class LogisticRegressionAlgorithm(Algorithm):
         self, model: LogRegClassifierModel, query: Query
     ) -> PredictedResult:
         x = query.vector(model.dim)
-        code = int(model.lr.predict(x)[0])
+        scorer = _resident_of(model)
+        if scorer is not None:
+            code = int(scorer.score_codes(x)[0])
+        else:
+            code = int(model.lr.predict(x)[0])
         return PredictedResult(label=model.label_index.inverse[code])
 
     def batch_predict(self, model: LogRegClassifierModel, queries):
         """One batched scoring call for the whole query file."""
+        scorer = _resident_of(model)
         return _batch_label_results(
-            model, queries, lambda X: model.lr.predict(X)
+            model,
+            queries,
+            scorer.score_codes if scorer is not None
+            else lambda X: model.lr.predict(X),
         )
+
+    def warmup_query(self, model: LogRegClassifierModel) -> Query:
+        return Query(attrs=(0.0,) * model.dim)
+
+    def resident_scorer(self, model: LogRegClassifierModel):
+        return _linear_resident(
+            "logreg",
+            model,
+            weights=model.lr.weights,
+            bias=model.lr.bias,
+            scales=getattr(model.lr, "feature_scales", None),
+        )
+
+
+def _resident_of(model):
+    """The model's live device-resident scorer, or None.
+
+    The query server attaches ``model._resident`` at deploy/hot-swap
+    (behind the swap lock); a retired scorer means a swap landed between
+    the attribute read and the dispatch — fall back to the host mirror,
+    which the swap already replaced."""
+    scorer = getattr(model, "_resident", None)
+    if scorer is not None and not scorer.retired:
+        return scorer
+    return None
+
+
+def _linear_resident(algo_name, model, weights, bias, scales):
+    """Shared resident-scorer builder for the two linear classifiers:
+    both serve ``argmax(X @ W + b)``, so they differ only in where W/b
+    live on the host model."""
+    from pio_tpu.server.residency import ResidentLinearScorer
+
+    return ResidentLinearScorer(
+        weights=weights,
+        bias=bias,
+        scales=scales,
+        name=algo_name,
+        query_factory=lambda x: Query(
+            attrs=tuple(float(v) for v in np.asarray(x).reshape(-1))
+        ),
+    )
 
 
 def _batch_label_results(model, queries, predict_codes):

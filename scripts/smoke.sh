@@ -523,4 +523,130 @@ PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" python "$POOL_STAGE" \
     || fail "pooled batch-lane stage (mode/retrace/lane assertions)"
 echo "ok   pooled serving: micro-batcher engaged, retraces flat, lane drained"
 
+# ------------------------------------------ device-resident serving
+# ISSUE 8: the resident-scorer failpoints must be dump-visible (a chaos
+# spec targeting them must arm something), then a classification server
+# with residency forced on and the int8 query wire must serve a steady
+# window where the h2d counter grows by AT MOST the int8 payload per
+# request (1 byte/feature — the params never re-ship), the bucket
+# retrace counter stays flat, and the donation hit rate holds >= 0.95.
+python -m pio_tpu.tools.cli lint --dump-failpoints pio_tpu | python -c '
+import json, sys
+inv = {f["point"] for f in json.load(sys.stdin)["failpoints"]}
+need = {"scorer.h2d.ship", "scorer.donate.dispatch"}
+missing = need - inv
+assert not missing, f"resident failpoints missing from inventory: {missing}"
+' || fail "scorer.h2d/scorer.donate failpoints missing from --dump-failpoints"
+echo "ok   scorer.h2d/scorer.donate failpoints in lint inventory"
+
+python - <<'PY' || fail "device-resident stage (h2d/retrace/donation assertions)"
+"""Smoke stage: device-resident serving on the int8 query wire.
+
+Boots a classification server with ``PIO_TPU_DEVICE_RESIDENT=1`` and
+``PIO_TPU_SERVE_WIRE=int8``, warms it, then drives a steady window and
+asserts from the OUTSIDE view (/metrics, /stats.json) that the wire is
+actually thin: h2d bytes grow by <= 1 byte/feature/request, zero
+retraces, donation hit rate >= 0.95, and every prediction is right.
+"""
+import datetime as dt
+import json
+import os
+import urllib.request
+
+os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "MEM"
+os.environ["PIO_STORAGE_SOURCES_MEM_TYPE"] = "memory"
+os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "MEM"
+os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "MEM"
+os.environ["PIO_TPU_DEVICE_RESIDENT"] = "1"
+os.environ["PIO_TPU_SERVE_WIRE"] = "int8"
+os.environ["PIO_TPU_BUCKET_WARMUP"] = "1"
+os.environ["PIO_TPU_BATCH_BUCKETS"] = "1,2,4"
+
+import pio_tpu.templates  # noqa: F401  (registers the factory)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.server import create_query_server
+from pio_tpu.storage import App, Storage
+from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+app_id = Storage.get_meta_data_apps().insert(App(0, "smoke-res"))
+le = Storage.get_levents()
+t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+PLANS = ("basic", "premium", "pro")
+n = 0
+for hot, plan in enumerate(PLANS):
+    for _ in range(8):
+        props = {f"attr{j}": (7 if j == hot else 1) for j in range(3)}
+        props["plan"] = plan
+        le.insert(
+            Event("$set", "user", f"u{n}", properties=props,
+                  event_time=t0 + dt.timedelta(minutes=n)),
+            app_id,
+        )
+        n += 1
+variant = variant_from_dict({
+    "id": "smoke-resident",
+    "engineFactory": "templates.classification",
+    "datasource": {"params": {"app_name": "smoke-res"}},
+    "algorithms": [{"name": "logreg", "params": {}}],
+})
+engine, ep = build_engine(variant)
+ctx = ComputeContext.local()
+run_train(engine, ep, variant, ctx=ctx)
+server, _service = create_query_server(
+    variant, host="127.0.0.1", port=0, ctx=ctx
+)
+server.start()
+try:
+    base = f"http://127.0.0.1:{server.port}"
+
+    def post(body):
+        req = urllib.request.Request(
+            base + "/queries.json",
+            data=json.dumps(body).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return r.read().decode("utf-8")
+
+    def counter(text, name):
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith(name + "{") or line.startswith(name + " "):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    got = post({"attrs": [9.0, 1.0, 1.0]})  # warm route + wire
+    assert got.get("label") == "basic", got
+    m0 = get("/metrics")
+    h2d0 = counter(m0, "pio_tpu_serving_h2d_bytes_total")
+    retr0 = counter(m0, "pio_tpu_bucket_retrace_total")
+    N, D = 40, 3
+    for q in range(N):
+        hot = q % 3
+        got = post({"attrs": [9.0 if j == hot else 1.0 for j in range(3)]})
+        assert got.get("label") == PLANS[hot], (q, got)
+    m1 = get("/metrics")
+    h2d = counter(m1, "pio_tpu_serving_h2d_bytes_total") - h2d0
+    retr = counter(m1, "pio_tpu_bucket_retrace_total") - retr0
+    assert 0 < h2d <= N * D, (
+        f"h2d grew {h2d} bytes over {N} requests on the int8 wire "
+        f"(want (0, {N * D}]: 1 byte/feature, params never re-ship)")
+    assert retr == 0, f"bucket retraces moved by {retr} in steady state"
+    res = json.loads(get("/stats.json"))["residency"]
+    assert res["enabled"] and res["paramBytes"] > 0, res
+    sc = res["scorers"][0]
+    assert sc["wire"] == "int8", sc
+    assert sc["donation"]["hitRate"] >= 0.95, sc["donation"]
+    print(f"resident stage: h2d={int(h2d)}B/{N} reqs retraces={int(retr)} "
+          f"donationHitRate={sc['donation']['hitRate']}")
+finally:
+    server.stop()
+PY
+echo "ok   device-resident serving: int8 wire thin, retraces flat, donations hit"
+
 echo "smoke OK"

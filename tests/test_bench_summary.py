@@ -54,11 +54,31 @@ def _full_result() -> dict:
             "concurrent_microbatch": {
                 "qps": 1380.0, "p50_ms": 10.7, "p95_ms": 22.8,
                 "mode": "off",
+                "mode_by_bucket": {
+                    "1": {"mode": "on", "p50Ms": 0.31, "samples": 64},
+                    "2": {"mode": "on", "p50Ms": 0.52, "samples": 64},
+                    "8": {"mode": "off", "p50Ms": 10.7, "samples": 41},
+                },
                 "probe": {"batchedP50Ms": 10.665, "perQueryP50Ms": 0.396},
                 "avg_batch": 7.21, "max_batch": 8,
             },
             "pool": {"qps": 1306.2, "p50_ms": 10.3, "p95_ms": 23.4,
-                     "workers": 2, "host_cores": 1},
+                     "workers": 2, "host_cores": 1,
+                     "laned_qps": 1188.4, "laned_p50_ms": 11.2,
+                     "laned_p95_ms": 24.8},
+            "resident": {
+                "queries": 200,
+                "int8": {"wire": "int8", "h2d_bytes_per_request": 3.0,
+                         "donation_hit_rate": 0.985, "retraces": 0,
+                         "param_bytes": 160},
+                "float32": {"wire": "float32",
+                            "h2d_bytes_per_request": 12.0,
+                            "donation_hit_rate": 0.985, "retraces": 0,
+                            "param_bytes": 160},
+                "h2d_ratio_f32_over_i8": 4.0,
+                "donation_hit_rate": 0.985,
+                "parity_delta": 0.0,
+            },
         },
         "secondary": {
             "classification_examples_per_sec": {
@@ -142,6 +162,12 @@ def test_summary_survives_tail_truncation(bench):
     assert parsed["p50_predict_ms"] == 1.612
     assert parsed["serving_qps"] == 1431.0
     assert parsed["pool_qps"] == 1306.2
+    assert parsed["pool_laned_qps"] == 1188.4
+    # per-bucket mode map compacts to {bucket: mode} in the summary
+    assert parsed["serving_mb_mode"] == {"1": "on", "2": "on", "8": "off"}
+    assert parsed["serving_h2d_x"] == 4.0
+    assert parsed["serving_donation_hit"] == 0.985
+    assert parsed["serving_wire_parity_delta"] == 0.0
     cfg = parsed["configs"]
     assert cfg["classification"]["x"] == 4.02
     assert cfg["similarproduct"]["x"] == 5.28

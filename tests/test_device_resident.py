@@ -1,0 +1,302 @@
+"""Device-resident serving (ISSUE 8): donated buffers, int8 wire,
+hot-swap retirement, and the packed lane frame.
+
+Covers the donation reuse guard (a donated buffer re-read must raise,
+never return garbage), wire parity (int8-quantized dispatches agree
+with the host float path), h2d byte accounting (int8 pays exactly one
+byte per feature), hot-swap retirement (a /reload retires the old
+generation's resident params so stale weights can never serve), and
+the lane's packed int8 payload round-tripping exactly against the JSON
+path. Everything runs with ``PIO_TPU_DEVICE_RESIDENT=1`` — the auto
+default keeps residency off on CPU, which is also asserted.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+import pio_tpu.templates  # noqa: F401  (registers engine factories)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.server.batchlane import (
+    PACKED_MAGIC,
+    PackedQuery,
+    pack_query_i8,
+    unpack_query_i8,
+)
+from pio_tpu.server.query_server import QueryServerService
+from pio_tpu.server.residency import (
+    DonatedBuffer,
+    ResidentLinearScorer,
+    enabled,
+    wire_mode,
+)
+from pio_tpu.storage import App, Storage
+from pio_tpu.templates.classification import Query
+from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+
+# ------------------------------------------------------------- env gating
+class TestGating:
+    def test_auto_is_off_on_cpu(self, monkeypatch):
+        monkeypatch.delenv("PIO_TPU_DEVICE_RESIDENT", raising=False)
+        assert enabled() is False  # suite runs under JAX_PLATFORMS=cpu
+
+    def test_force_on_off(self, monkeypatch):
+        monkeypatch.setenv("PIO_TPU_DEVICE_RESIDENT", "1")
+        assert enabled() is True
+        monkeypatch.setenv("PIO_TPU_DEVICE_RESIDENT", "0")
+        assert enabled() is False
+
+    def test_wire_mode_resolution(self, monkeypatch):
+        monkeypatch.delenv("PIO_TPU_SERVE_WIRE", raising=False)
+        assert wire_mode(True) == "int8"  # auto: int8 when scales exist
+        assert wire_mode(False) == "float32"
+        monkeypatch.setenv("PIO_TPU_SERVE_WIRE", "float32")
+        assert wire_mode(True) == "float32"
+        monkeypatch.setenv("PIO_TPU_SERVE_WIRE", "int8")
+        assert wire_mode(True) == "int8"
+        # int8 without scales cannot quantize — falls back, not crashes
+        assert wire_mode(False) == "float32"
+
+
+# --------------------------------------------------------- donation guard
+class TestDonatedBuffer:
+    def test_take_is_one_shot(self):
+        import jax.numpy as jnp
+
+        g = DonatedBuffer(jnp.zeros((2, 3)))
+        g.take()
+        with pytest.raises(RuntimeError, match="re-used"):
+            g.take()
+
+    def test_read_after_donation_raises(self):
+        import jax.numpy as jnp
+
+        g = DonatedBuffer(jnp.zeros((2, 3)))
+        assert g.array().shape == (2, 3)  # readable before donation
+        g.take()
+        with pytest.raises(RuntimeError, match="re-read"):
+            g.array()
+
+
+# ----------------------------------------------------------- scorer level
+def _scorer(monkeypatch, d=4, c=3, scales=True, seed=0, **kw):
+    monkeypatch.setenv("PIO_TPU_DEVICE_RESIDENT", "1")
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(d, c)).astype(np.float32)
+    b = rng.normal(size=c).astype(np.float32)
+    s = (np.abs(rng.normal(size=d)) / 127.0 + 1e-3).astype(np.float32)
+    return ResidentLinearScorer(
+        W, b, scales=s if scales else None, name="t", **kw
+    ), W, b
+
+
+class TestResidentScorer:
+    def test_int8_wire_parity_with_host_path(self, monkeypatch):
+        monkeypatch.delenv("PIO_TPU_SERVE_WIRE", raising=False)
+        sc, W, b = _scorer(monkeypatch, d=8, c=4, seed=3)
+        assert sc.wire == "int8"
+        rng = np.random.default_rng(9)
+        # features on the scales' dynamic range (|x| <= 127*s)
+        X = (rng.normal(size=(64, 8)) * sc.scales * 40).astype(np.float32)
+        host = np.argmax(X @ W + b, axis=1)
+        got = sc.score_codes(X)
+        assert (got == host).mean() >= 0.999  # training-wire tolerance
+
+    def test_h2d_bytes_exact_per_wire(self, monkeypatch):
+        X = np.ones((4, 6), np.float32)
+        sc, _, _ = _scorer(monkeypatch, d=6, scales=True)
+        sc.score_codes(X)
+        assert sc.h2d_bytes == 4 * 6  # one byte per int8 feature
+        monkeypatch.setenv("PIO_TPU_SERVE_WIRE", "float32")
+        sc32, _, _ = _scorer(monkeypatch, d=6, scales=True)
+        sc32.score_codes(X)
+        assert sc32.h2d_bytes == 4 * 6 * 4  # 4x the int8 wire
+
+    def test_donation_hit_miss_accounting(self, monkeypatch):
+        sc, _, _ = _scorer(monkeypatch)
+        sc.prealloc([1, 2])
+        X = np.ones((2, 4), np.float32)
+        for _ in range(5):
+            sc.score_codes(X)
+        assert sc.donation_hits == 5 and sc.donation_misses == 0
+        sc.score_codes(np.ones((3, 4), np.float32))  # cold shape
+        assert sc.donation_misses == 1
+        sc.score_codes(np.ones((3, 4), np.float32))  # now standing
+        assert sc.donation_hits == 6
+        d = sc.to_dict()
+        assert d["donation"]["hitRate"] == pytest.approx(6 / 7, abs=1e-4)
+
+    def test_retired_scorer_refuses(self, monkeypatch):
+        sc, _, _ = _scorer(monkeypatch)
+        sc.retire()
+        with pytest.raises(RuntimeError, match="retired"):
+            sc.score_codes(np.ones((1, 4), np.float32))
+
+    def test_quantize_dequantize_round_trip_exact(self, monkeypatch):
+        sc, _, _ = _scorer(monkeypatch, d=16, seed=7)
+        rng = np.random.default_rng(11)
+        X = (rng.normal(size=(32, 16)) * sc.scales * 50).astype(np.float32)
+        codes = sc.quantize(X)
+        assert np.array_equal(sc.quantize(sc.dequantize(codes)), codes)
+
+    def test_wire_shape_mismatch_raises(self, monkeypatch):
+        sc, _, _ = _scorer(monkeypatch, d=4)
+        with pytest.raises(ValueError, match="wire batch"):
+            sc.score_wire(np.zeros((2, 5), np.int8))
+
+
+# ------------------------------------------------------------ packed lane
+class TestPackedFrame:
+    def test_round_trip_exact(self):
+        codes = np.array([-127, -1, 0, 1, 127, 42], np.int8)
+        frame = pack_query_i8(codes)
+        assert frame[:4] == PACKED_MAGIC
+        got = unpack_query_i8(frame)
+        assert isinstance(got, PackedQuery)
+        assert np.array_equal(got.codes, codes)
+
+    def test_magic_disambiguates_from_json(self):
+        # a JSON body can never start with the NUL-led magic
+        assert not b'{"attrs": [1.0]}'.startswith(PACKED_MAGIC[:1])
+
+    def test_malformed_frame_raises(self):
+        frame = pack_query_i8(np.zeros(4, np.int8))
+        with pytest.raises(ValueError):
+            unpack_query_i8(frame[:-1])  # truncated
+
+
+# ------------------------------------------------------- service lifecycle
+@pytest.fixture(autouse=True)
+def isolated_storage(tmp_home):
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def _seed_users(app_id: int):
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    rng = np.random.default_rng(7)
+    n = 0
+    for plan, hot in (("basic", 0), ("premium", 1), ("pro", 2)):
+        for k in range(8):
+            attrs = rng.integers(0, 3, size=3)
+            attrs[hot] += 6
+            props = {f"attr{j}": int(attrs[j]) for j in range(3)}
+            props["plan"] = plan
+            le.insert(
+                Event("$set", "user", f"u{n}", properties=props,
+                      event_time=t0 + dt.timedelta(minutes=n)),
+                app_id,
+            )
+            n += 1
+
+
+def _service(monkeypatch, algo="logreg", resident="1"):
+    monkeypatch.setenv("PIO_TPU_DEVICE_RESIDENT", resident)
+    monkeypatch.setenv("PIO_TPU_BATCH_BUCKETS", "1,2,4")
+    monkeypatch.setenv("PIO_TPU_BUCKET_WARMUP", "1")
+    app_id = Storage.get_meta_data_apps().insert(App(0, "res-test"))
+    _seed_users(app_id)
+    variant = variant_from_dict({
+        "id": "res-e2e",
+        "engineFactory": "templates.classification",
+        "datasource": {"params": {"app_name": "res-test"}},
+        "algorithms": [{"name": algo, "params": {}}],
+    })
+    engine, ep = build_engine(variant)
+    ctx = ComputeContext.create(seed=0)
+    run_train(engine, ep, variant, ctx=ctx)
+    return QueryServerService(variant, ctx=ctx)
+
+
+CASES = [
+    (Query(attrs=(9.0, 1.0, 1.0)), "basic"),
+    (Query(attrs=(1.0, 9.0, 1.0)), "premium"),
+    (Query(attrs=(1.0, 1.0, 9.0)), "pro"),
+]
+
+
+class TestServiceResidency:
+    @pytest.mark.parametrize("algo", ["naivebayes", "logreg"])
+    def test_resident_scorer_placed_and_serves(self, monkeypatch, algo):
+        svc = _service(monkeypatch, algo=algo)
+        assert len(svc._resident) == 1
+        sc = svc._resident[0]
+        assert sc.wire == "int8" and sc.placed_bytes > 0
+        for query, want in CASES:
+            assert svc._predict_one(query).label == want
+        assert sc.dispatches > 0  # the predictions went through the device
+
+    def test_stats_report_residency(self, monkeypatch):
+        svc = _service(monkeypatch)
+        _, out = svc.get_stats(type("R", (), {"params": {}})())
+        res = out["residency"]
+        assert res["enabled"] is True
+        assert res["paramBytes"] == svc._resident[0].placed_bytes
+        assert res["scorers"][0]["wire"] == "int8"
+
+    def test_int8_parity_with_float32_wire(self, monkeypatch):
+        svc8 = _service(monkeypatch)
+        labels8 = [svc8._predict_one(q).label for q, _ in CASES]
+        monkeypatch.setenv("PIO_TPU_SERVE_WIRE", "float32")
+        svc8._load(None)
+        assert svc8._resident[0].wire == "float32"
+        labels32 = [svc8._predict_one(q).label for q, _ in CASES]
+        assert labels8 == labels32
+
+    def test_disabled_leaves_host_path(self, monkeypatch):
+        svc = _service(monkeypatch, resident="0")
+        assert svc._resident == []
+        for query, want in CASES:
+            assert svc._predict_one(query).label == want
+
+    def test_hot_swap_retires_old_generation(self, monkeypatch):
+        svc = _service(monkeypatch)
+        old = svc._resident[0]
+        gen0 = svc._buckets.generation
+        svc._load(None)  # the /reload path
+        assert svc._buckets.generation == gen0 + 1
+        assert old.retired is True
+        with pytest.raises(RuntimeError, match="retired"):
+            old.score_codes(np.ones((1, 3), np.float32))
+        new = svc._resident[0]
+        assert new is not old and not new.retired
+        for query, want in CASES:  # no stale-weights serving
+            assert svc._predict_one(query).label == want
+
+    def test_bucketed_batches_never_retrace_and_donate(self, monkeypatch):
+        svc = _service(monkeypatch)
+        sc = svc._resident[0]
+        for i in range(30):
+            qs = [q for q, _ in CASES][: (i % 3) + 1]
+            results, fresh = svc._predict_batch_bucketed(qs)
+            assert not fresh and len(results) == len(qs)
+        assert svc._buckets.retraces == 0
+        total = sc.donation_hits + sc.donation_misses
+        assert sc.donation_hits / total >= 0.95  # steady-state hit rate
+
+    def test_lane_packed_round_trips_exactly_vs_json(self, monkeypatch):
+        svc = _service(monkeypatch)
+        sc = svc._resident[0]
+        for query, want in CASES:
+            packed = svc._lane_pack(query)
+            assert packed is not None and packed[:4] == PACKED_MAGIC
+            pq = unpack_query_i8(packed)
+            # the wire codes the drainer re-derives from the rebuilt
+            # query are bit-identical to what crossed the ring
+            rebuilt = sc.query_factory(sc.dequantize(pq.codes))
+            assert np.array_equal(
+                sc.quantize(rebuilt.vector(sc.in_dim))[0], pq.codes
+            )
+            # and the served results agree between the two wire forms
+            json_body = {"attrs": list(query.attrs)}
+            got = svc._lane_dispatch([pq, json_body])
+            assert got[0] == got[1] == {"label": want}
+
+    def test_lane_pack_declines_without_int8_scorer(self, monkeypatch):
+        svc = _service(monkeypatch, resident="0")
+        assert svc._lane_pack(CASES[0][0]) is None
