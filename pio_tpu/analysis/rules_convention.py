@@ -115,6 +115,9 @@ FAILPOINT_NAMESPACES = (
     "scorer.donate.",
     "worker.",
     "batchlane.",
+    # partitioned event log + its replication protocol (ISSUE 9)
+    "partlog.",
+    "repl.",
 )
 
 

@@ -525,6 +525,23 @@ class MetricsRegistry:
         return self._segment is not None
 
     # -- exposition --------------------------------------------------------
+    def render_prefixed(self, prefixes, pool: bool = True) -> List[str]:
+        """Exposition lines for just the families whose name starts with
+        one of ``prefixes``. Serving daemons keep per-instance registries
+        but the storage layer's families (group commit, the partitioned
+        log and its replication links) live on the process-global
+        registry; this is the bridge a daemon adds as a collector to
+        surface a chosen slice of them on its own ``/metrics``."""
+        pfx = tuple(prefixes)
+        with self._lock:
+            metrics = [
+                m for m in self._metrics.values() if m.name.startswith(pfx)
+            ]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render(pool=pool))
+        return lines
+
     def render(self, pool: bool = True) -> List[str]:
         """Exposition lines for every family (pool-wide values for bound
         cells when ``pool``) plus collector extras."""

@@ -184,6 +184,18 @@ class EventServerService:
         from pio_tpu import faults as _faults
 
         self.obs.add_collector(_faults.exposition_lines)
+        from pio_tpu.obs import REGISTRY as _global_registry
+
+        # the partitioned log + its replication links meter on the
+        # process-global registry (the storage layer has no server
+        # instance of its own); bridge that slice into this scrape so
+        # the failover drill can watch partition appends and follower
+        # acks from the outside
+        self.obs.add_collector(
+            lambda: _global_registry.render_prefixed(
+                ("pio_tpu_partlog_", "pio_tpu_repl_")
+            )
+        )
         # -- health probes (ISSUE 2) --
         self.health = HealthMonitor()
         self.health.add_liveness("group_commit", self._check_group_commit)
@@ -234,6 +246,7 @@ class EventServerService:
         r.add("GET", "/slo\\.json", self.get_slo)
         r.add("GET", "/qos\\.json", self.get_qos)
         r.add("GET", "/faults\\.json", self.get_faults)
+        r.add("GET", "/storage\\.json", self.get_storage)
         r.add("GET", "/healthz", self.healthz)
         r.add("GET", "/readyz", self.readyz)
         r.add("POST", "/webhooks/([^/]+)\\.json", self.webhook_json)
@@ -360,6 +373,22 @@ class EventServerService:
         from pio_tpu import faults
 
         return 200, faults.snapshot()
+
+    def get_storage(self, req: Request):
+        """Event-store topology. Backends that can describe themselves
+        (the partitioned log's partition table, replication positions
+        and snapshot watermarks) do so via a duck-typed ``topology()``;
+        everything else reports just its type. This is how the chaos
+        drill (and an operator) proves which node is leader and how far
+        each follower has acked."""
+        try:
+            lev = Storage.get_levents()
+        except Exception as e:
+            raise HTTPError(503, f"event store unavailable: {e}")
+        topo = getattr(lev, "topology", None)
+        if topo is None:
+            return 200, {"backend": type(lev).__name__, "topology": None}
+        return 200, topo()
 
     def _qos_admit(self, req: Request):
         """Admission for the write paths: engine bucket, THEN the

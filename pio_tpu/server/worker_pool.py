@@ -51,10 +51,14 @@ from pio_tpu.workflow.engine_json import EngineVariant
 
 log = logging.getLogger("pio_tpu.workerpool")
 
-#: respawn budget per worker index — a worker that keeps dying signals a
-#: real fault (bad model, port clash), not a transient, so stop burning
-#: processes on it
+#: respawn budget per worker index AND per kill reason — a worker that
+#: keeps dying signals a real fault (bad model, port clash), not a
+#: transient, so stop burning processes on it. Budgets are split by
+#: reason: a wedge the health sweep shot (``unhealthy``) is usually
+#: load-induced and recoverable, so it must not consume the crash
+#: budget and retire a worker that never actually crash-looped
 _MAX_RESPAWNS = 3
+_MAX_RESPAWNS_BY_REASON = {"crash": _MAX_RESPAWNS, "unhealthy": 6}
 
 #: exponential respawn backoff: death N waits base * 2^(N-1), capped — a
 #: worker crash-looping on startup (bad model file, import error) must
@@ -226,7 +230,14 @@ class ServingPool:
         }
         self.n_workers = n_workers
         self._procs: list = []
-        self._respawns = [0] * n_workers
+        #: per-reason respawn counts ({"crash": n, "unhealthy": m}) —
+        #: each reason spends its own budget (_MAX_RESPAWNS_BY_REASON)
+        self._respawns = [
+            {r: 0 for r in _MAX_RESPAWNS_BY_REASON} for _ in range(n_workers)
+        ]
+        #: worker i died with an exhausted budget for its kill reason and
+        #: will never be respawned again
+        self._retired = [False] * n_workers
         #: monotonic deadline before which worker i must NOT be respawned
         #: (0.0 = no respawn scheduled); gives crash-looping workers an
         #: exponentially growing cool-down instead of a hot spawn loop
@@ -418,6 +429,46 @@ class ServingPool:
                 p.kill()
                 p.join(timeout=2.0)
 
+    def _account_death(self, i: int, exitcode, now: float) -> None:
+        """Account one observed worker death against the kill reason's
+        own respawn budget and schedule the backed-off respawn (or
+        retire the worker when that reason's budget is spent)."""
+        if self._retired[i]:
+            return
+        if (
+            self._spawned_at[i] > 0.0
+            and now - self._spawned_at[i] >= _RESPAWN_RESET_AFTER_S
+        ):
+            # long-lived worker: this death is not a crash loop
+            for r in self._respawns[i]:
+                self._respawns[i][r] = 0
+        reason = self._kill_reason[i] or "crash"
+        self._kill_reason[i] = None
+        budget = _MAX_RESPAWNS_BY_REASON.get(reason, _MAX_RESPAWNS)
+        if self._respawns[i].get(reason, 0) >= budget:
+            log.error(
+                "worker %d died %d times (reason %s); not respawning",
+                i, self._respawns[i][reason], reason,
+            )
+            self._retired[i] = True
+            return
+        self._respawns[i][reason] = self._respawns[i].get(reason, 0) + 1
+        self._respawn_counter.inc(reason=reason)
+        # backoff grows with THIS reason's streak: a worker the health
+        # sweep shot once does not inherit the cool-down its earlier
+        # crashes earned
+        delay = min(
+            _RESPAWN_BACKOFF_CAP_S,
+            _RESPAWN_BACKOFF_BASE_S
+            * 2 ** (self._respawns[i][reason] - 1),
+        )
+        self._respawn_due[i] = now + delay
+        log.warning(
+            "worker %d exited (code %s, reason %s); respawning in "
+            "%.1fs (%d/%d)",
+            i, exitcode, reason, delay, self._respawns[i][reason], budget,
+        )
+
     def wait(self, poll_s: float = 0.5,
              health_poll_s: float = 2.0) -> None:
         """Supervise until /undeploy (or stop()): respawn crashed workers
@@ -442,38 +493,12 @@ class ServingPool:
                     continue
                 # phase 1: first observation of this death — account for
                 # it and schedule the (possibly delayed) respawn
-                if (
-                    self._spawned_at[i] > 0.0
-                    and now - self._spawned_at[i] >= _RESPAWN_RESET_AFTER_S
-                ):
-                    # long-lived worker: this death is not a crash loop
-                    self._respawns[i] = 0
-                reason = self._kill_reason[i] or "crash"
-                self._kill_reason[i] = None
-                if self._respawns[i] >= _MAX_RESPAWNS:
-                    log.error(
-                        "worker %d died %d times; not respawning",
-                        i, self._respawns[i],
-                    )
-                    continue
-                self._respawns[i] += 1
-                self._respawn_counter.inc(reason=reason)
-                delay = min(
-                    _RESPAWN_BACKOFF_CAP_S,
-                    _RESPAWN_BACKOFF_BASE_S * 2 ** (self._respawns[i] - 1),
-                )
-                self._respawn_due[i] = now + delay
-                log.warning(
-                    "worker %d exited (code %s, reason %s); respawning "
-                    "in %.1fs (%d/%d)",
-                    i, p.exitcode, reason, delay,
-                    self._respawns[i], _MAX_RESPAWNS,
-                )
+                self._account_death(i, p.exitcode, now)
             if all(
                 not p.is_alive() for p in self._procs
-            ) and all(
-                r >= _MAX_RESPAWNS for r in self._respawns
-            ) and not any(d > 0.0 for d in self._respawn_due):
+            ) and all(self._retired) and not any(
+                d > 0.0 for d in self._respawn_due
+            ):
                 log.error("all workers dead and out of respawn budget")
                 break
             # plain sleep, not Event.wait(): nobody ever registers as a

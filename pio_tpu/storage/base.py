@@ -231,6 +231,22 @@ class PEventsAdapter(PEvents):
             return self._b.find_frame(app_id, **filters)
         return EventFrame.from_events(self.find(app_id, **filters))
 
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None) -> dict:
+        # a backend that pushed the fold down (e.g. the partitioned log's
+        # snapshot-aware read) must keep that advantage on the bulk path
+        if type(self._b).aggregate_properties is not LEvents.aggregate_properties:
+            return self._b.aggregate_properties(
+                app_id, entity_type, channel_id=channel_id,
+                start_time=start_time, until_time=until_time,
+                required=required,
+            )
+        return super().aggregate_properties(
+            app_id, entity_type, channel_id=channel_id,
+            start_time=start_time, until_time=until_time, required=required,
+        )
+
     def write(self, events, app_id, channel_id=None) -> None:
         self._b.write(events, app_id, channel_id)
 

@@ -1,0 +1,18 @@
+"""Partitioned, replicated event log with failover and compaction.
+
+Package layout:
+
+- ``framing``     — CRC frame codec + verified-prefix / torn-tail repair
+- ``segments``    — one partition's append-only segment chain
+- ``partitioned`` — the LEvents backend: router, group commit, views
+- ``replication`` — length-prefixed follower streaming + ack gating
+- ``compaction``  — snapshot folding with verify-and-fallback reads
+- ``failover``    — longest-verified-prefix election and promotion
+"""
+
+from pio_tpu.storage.partlog.partitioned import (  # noqa: F401
+    DEFAULT_PARTITIONS,
+    PARTITIONS_VAR,
+    PartitionedEventLog,
+    partition_of,
+)

@@ -20,7 +20,9 @@ Unset → quickstart defaults under ``$PIO_TPU_HOME`` (default
 ``~/.pio_tpu``): SQLite for metadata + events, localfs for models.
 Backend types: ``sqlite``, ``memory``, ``parquet`` (events only),
 ``eventlog`` (events only — native C++ append-only log, the at-scale
-event store), ``localfs`` (models only), ``searchable`` (aliases ``fts``,
+event store), ``partlog`` (events only — hash-partitioned, replicated
+segment log with leader failover and snapshot compaction), ``localfs``
+(models only), ``searchable`` (aliases ``fts``,
 ``elasticsearch`` — the ES-analog: sqlite + FTS5 full-text search over
 events, apps, and run metadata; serves METADATA and EVENTDATA), ``blob``
 (models only — content-addressed, URI-schemed store filling the HDFS/S3
@@ -243,6 +245,17 @@ class Storage:
             return cls._clients[key]
 
     @classmethod
+    def _partlog(cls, cfg: _SourceConfig):
+        from pio_tpu.storage.partlog import PartitionedEventLog
+
+        path = cfg.path or os.path.join(pio_home(), "partlog")
+        key = f"partlog:{path}"
+        with cls._lock:
+            if key not in cls._clients:
+                cls._clients[key] = PartitionedEventLog(path)
+            return cls._clients[key]
+
+    @classmethod
     def sqlite_clients(cls) -> Dict[str, SQLiteClient]:
         """repository label → SQLiteClient for every repository configured
         on the sqlite backend (opening a client applies pending schema
@@ -297,6 +310,8 @@ class Storage:
             return cls._memory("levents", MemLEvents)
         if cfg.type == "eventlog":
             return cls._eventlog(cfg)
+        if cfg.type == "partlog":
+            return cls._partlog(cfg)
         if cfg.type == "searchable":
             from pio_tpu.storage.searchable import SearchableEvents
 
@@ -317,6 +332,8 @@ class Storage:
             return MemPEvents(cls._memory("levents", MemLEvents))
         if cfg.type == "eventlog":
             return base.PEventsAdapter(cls._eventlog(cfg))
+        if cfg.type == "partlog":
+            return base.PEventsAdapter(cls._partlog(cfg))
         if cfg.type == "searchable":
             from pio_tpu.storage.searchable import SearchableEvents
 

@@ -342,6 +342,194 @@ grep -q 'pio_tpu_fault_triggered_total{' <<<"$CHAOS_METRICS" \
     || fail "/metrics missing pio_tpu_fault_triggered_total sample"
 echo "ok   injections visible on /faults.json + /metrics"
 
+# ----------------------------------- chaos v2: partlog leader failover
+# ISSUE 9: a 3-partition replicated event server at commit durability
+# must lose ZERO acknowledged writes when its leader is SIGKILLed
+# mid-ingest — a 201 is only sent after >= min_acks followers fsynced
+# the record, so the longest-verified-prefix promotion serves every
+# acked event. The drill also proves /storage.json reports the live
+# topology and the partlog/repl metric families are present.
+python -m pio_tpu.tools.cli lint --dump-failpoints pio_tpu | python -c '
+import json, sys
+inv = {f["point"] for f in json.load(sys.stdin)["failpoints"]}
+need = {"partlog.append.before_write", "repl.send", "repl.ack"}
+missing = need - inv
+assert not missing, f"partlog/repl failpoints missing from inventory: {missing}"
+' || fail "partlog/repl failpoints missing from --dump-failpoints"
+echo "ok   partlog/repl failpoints in lint inventory"
+
+FAILOVER_STAGE="$WORKDIR/failover_stage.py"
+cat > "$FAILOVER_STAGE" <<'PY'
+"""Smoke stage: partitioned-log leader failover under SIGKILL.
+
+Boots two in-process follower replicas and an EVENT server subprocess
+over a 3-partition ``partlog`` at ``commit`` durability (a 201 is sent
+only after a follower fsynced the record). A writer thread ingests
+continuously; once enough writes are acked the leader is SIGKILLed
+mid-ingest, the followers are promoted by longest verified prefix, and
+the promoted log must serve EVERY acked write — zero acked-write loss.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+WORKDIR = sys.argv[1]
+
+from pio_tpu.storage.partlog import failover
+from pio_tpu.storage.partlog.partitioned import PartitionedEventLog
+from pio_tpu.storage.partlog.replication import FollowerServer
+
+froot1 = os.path.join(WORKDIR, "failover-f1")
+froot2 = os.path.join(WORKDIR, "failover-f2")
+f1 = FollowerServer(froot1)
+f2 = FollowerServer(froot2)
+
+leader_root = os.path.join(WORKDIR, "failover-leader")
+port_file = os.path.join(WORKDIR, "failover-port")
+info_file = os.path.join(WORKDIR, "failover-info")
+
+LEADER_SRC = r'''
+import json, os, signal, sys
+from pio_tpu.server import create_event_server
+from pio_tpu.storage import AccessKey, App, Storage
+
+app_id = Storage.get_meta_data_apps().insert(App(0, "failover"))
+key = Storage.get_meta_data_access_keys().insert(AccessKey("", app_id))
+server = create_event_server(host="127.0.0.1", port=0).start()
+info_file, port_file = sys.argv[1], sys.argv[2]
+with open(info_file, "w") as f:
+    json.dump({"key": key, "app_id": app_id}, f)
+with open(port_file + ".tmp", "w") as f:
+    f.write(str(server.port))
+os.rename(port_file + ".tmp", port_file)  # atomic publish
+signal.sigwait({signal.SIGTERM, signal.SIGINT})
+server.stop()
+'''
+
+env = dict(os.environ)
+env.update({
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PL",
+    "PIO_STORAGE_SOURCES_PL_TYPE": "partlog",
+    "PIO_STORAGE_SOURCES_PL_PATH": leader_root,
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    "PIO_TPU_PARTLOG_PARTITIONS": "3",
+    "PIO_TPU_PARTLOG_REPLICAS": f"127.0.0.1:{f1.port},127.0.0.1:{f2.port}",
+    "PIO_TPU_DURABILITY": "commit",
+})
+proc = subprocess.Popen(
+    [sys.executable, "-c", LEADER_SRC, info_file, port_file], env=env)
+
+
+def _cleanup():
+    # a failed assertion must not leave the leader (sigwait) or the
+    # follower accept loops holding the stage open
+    stop_writer.set()
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait()
+    f1.stop()
+    f2.stop()
+
+
+deadline = time.time() + 60
+while not os.path.exists(port_file):
+    if proc.poll() is not None:
+        raise SystemExit("leader event server died during boot")
+    if time.time() > deadline:
+        proc.kill()
+        raise SystemExit("leader event server never published its port")
+    time.sleep(0.2)
+with open(port_file) as f:
+    base = "http://127.0.0.1:" + f.read().strip()
+with open(info_file) as f:
+    info = json.load(f)
+
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return r.read().decode("utf-8")
+
+
+acked = set()
+stop_writer = threading.Event()
+
+
+def writer():
+    i = 0
+    while not stop_writer.is_set():
+        i += 1
+        body = json.dumps({
+            "event": "chaos", "entityType": "user", "entityId": f"u{i}",
+            "properties": {"seq": i},
+            "eventTime": "2026-03-01T10:00:00Z",
+        }).encode("utf-8")
+        req = urllib.request.Request(
+            base + "/events.json?accessKey=" + info["key"],
+            data=body, headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=15) as r:
+                if r.status == 201:
+                    acked.add(f"u{i}")
+        except Exception:
+            return  # leader is gone: the in-flight write was never acked
+
+
+t = threading.Thread(target=writer, daemon=True)
+t.start()
+try:
+    deadline = time.time() + 60
+    while len(acked) < 15:
+        if time.time() > deadline:
+            raise SystemExit(f"only {len(acked)} writes acked in 60s")
+        time.sleep(0.05)
+
+    # the outside view while the leader is up: topology + repl metrics
+    topo = json.loads(get("/storage.json"))
+    assert topo["backend"] == "partlog", topo
+    assert topo["role"] == "leader" and topo["partitions"] == 3, topo
+    assert len(topo["partition_detail"]) == 3, topo
+    repl = topo["replication"]
+    assert repl is not None and repl["min_acks"] >= 1, repl
+    assert len(repl["followers"]) == 2, repl
+    metrics = get("/metrics")
+    for fam in ("pio_tpu_partlog_appends_total", "pio_tpu_repl_acks_total"):
+        assert fam + "{" in metrics, f"/metrics missing {fam}"
+
+    # mid-ingest SIGKILL: the writer thread is still posting
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+    stop_writer.set()
+    t.join(timeout=30)
+    n_acked = len(acked)
+finally:
+    _cleanup()
+
+promoted_root = os.path.join(WORKDIR, "failover-promoted")
+report = failover.promote([froot1, froot2], promoted_root)
+assert report["partitions"] == 3, report
+
+log = PartitionedEventLog(promoted_root)
+try:
+    got = {e.entity_id for e in log.find(info["app_id"])}
+finally:
+    log.close()
+lost = acked - got
+assert not lost, (
+    f"promoted follower lost {len(lost)} acked writes: {sorted(lost)[:5]}")
+print(f"failover stage: {n_acked} acked writes, 0 lost after promotion "
+      f"({len(got)} records served by the promoted root)")
+PY
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" python "$FAILOVER_STAGE" "$WORKDIR" \
+    || fail "partlog failover stage (acked-write loss / topology assertions)"
+echo "ok   partlog failover: leader SIGKILLed mid-ingest, zero acked writes lost"
+
 # -------------------------------------------------- pooled batch lane
 # ISSUE 7: a pooled server with the shape-bucket cache warmed and the
 # cross-worker batch lane armed must keep the micro-batcher engaged

@@ -427,7 +427,7 @@ class TestSupervisorHealthSweep:
         pool = ServingPool.__new__(ServingPool)  # skip __init__: no spawn
         pool.n_workers = 1
         pool._procs = [_FakeProc()]
-        pool._respawns = [0]
+        pool._respawns = [{"crash": 0, "unhealthy": 0}]
         pool._health_ports = [server.port]
         pool._health_fails = [0]
         pool._kill_reason = [None]

@@ -79,12 +79,17 @@ def _eventlog(tmp_path):
         pytest.skip(f"native eventlog unavailable: {e}")
 
 
-@pytest.fixture(params=["memory", "sqlite", "eventlog", "searchable"])
+@pytest.fixture(params=["memory", "sqlite", "eventlog", "searchable",
+                        "partlog"])
 def levents(request, tmp_path):
     if request.param == "memory":
         return MemLEvents()
     if request.param == "eventlog":
         return _eventlog(tmp_path)
+    if request.param == "partlog":
+        from pio_tpu.storage.partlog import PartitionedEventLog
+
+        return PartitionedEventLog(str(tmp_path / "partlog"), partitions=3)
     if request.param == "searchable":
         from pio_tpu.storage.searchable import (
             SearchableClient, SearchableEvents,
@@ -182,7 +187,7 @@ class TestLEventsConformance:
 
 # ------------------------------------------------------------------ PEvents
 @pytest.fixture(params=["memory", "sqlite", "parquet", "eventlog",
-                        "searchable"])
+                        "searchable", "partlog"])
 def pevents(request, tmp_path):
     if request.param == "memory":
         return MemPEvents(MemLEvents())
@@ -192,6 +197,13 @@ def pevents(request, tmp_path):
         from pio_tpu.storage.base import PEventsAdapter
 
         return PEventsAdapter(_eventlog(tmp_path))
+    if request.param == "partlog":
+        from pio_tpu.storage.base import PEventsAdapter
+        from pio_tpu.storage.partlog import PartitionedEventLog
+
+        return PEventsAdapter(
+            PartitionedEventLog(str(tmp_path / "partlog"), partitions=3)
+        )
     if request.param == "searchable":
         from pio_tpu.storage.searchable import (
             SearchableClient, SearchableEvents,

@@ -222,7 +222,7 @@ class TestServingPool:
                 break
             time.sleep(0.2)
         assert pool._procs[0] is not victim, "worker never respawned"
-        assert pool._respawns[0] == 1
+        assert pool._respawns[0]["crash"] == 1
         # the pool still answers (either worker may take the connection)
         status, got = _post(pool.port, "/queries.json",
                             {"user": "u1", "num": 2})
@@ -267,7 +267,10 @@ class TestServingPool:
                 break
             time.sleep(0.2)
         assert pool._procs[1] is not victim, "wedged worker never replaced"
-        assert pool._respawns[1] == 1
+        assert pool._respawns[1]["unhealthy"] == 1
+        # the health-sweep kill spent the unhealthy budget, not the
+        # crash budget (the split is the point of the per-reason split)
+        assert pool._respawns[1]["crash"] == 0
         # the replacement serves (either worker may take the connection)
         status, got = _post(pool.port, "/queries.json",
                             {"user": "u1", "num": 2})
