@@ -68,10 +68,13 @@ class ALSConfig:
     block_width: Optional[int] = None
     #: blocks per scan step — bounds the [chunk, width, K] HBM intermediate
     blocks_per_chunk: int = 4096
-    #: dtype for the factor gather + normal-equation matmuls ("bfloat16"
-    #: or "float32"). bf16 is the MXU's native rate and halves the gather
-    #: bandwidth; accumulation and the solves stay float32 either way.
-    matmul_dtype: str = "bfloat16"
+    #: dtype for the factor gather + normal-equation matmuls. "auto"
+    #: picks bfloat16 on accelerator backends — the MXU's native rate,
+    #: halving the gather bandwidth — and float32 on CPU, where bf16 is
+    #: emulated (no rate or bandwidth win) and its table rounding only
+    #: compounds across iterations. Explicit "bfloat16" / "float32"
+    #: override; accumulation and the solves stay float32 either way.
+    matmul_dtype: str = "auto"
     #: per-entity K×K solver: "auto" uses exact Cholesky for small entity
     #: counts and switches to Jacobi-preconditioned CG (matmul-only, rides
     #: the MXU) above ~32k entities, where XLA's batched factorizations
@@ -192,6 +195,16 @@ def _pack_blocks(
     )
 
 
+def _resolve_matmul_dtype(matmul_dtype: str) -> str:
+    """``"auto"`` → bfloat16 where the MXU pays for it, float32 on CPU
+    (emulated bf16: same FLOP rate, strictly more rounding)."""
+    if matmul_dtype != "auto":
+        return matmul_dtype
+    import jax
+
+    return "float32" if jax.default_backend() == "cpu" else "bfloat16"
+
+
 def _make_math(reg: float, implicit: bool, alpha: float,
                matmul_dtype: str, solver: str, rating_wire: str = "f32",
                item_wire: str = "planes"):
@@ -259,8 +272,10 @@ def _make_math(reg: float, implicit: bool, alpha: float,
         if varying_axis is not None:
             # Inside shard_map the carry becomes device-varying after the
             # first chunk; mark the zeros accordingly so scan types match.
-            A0 = jax.lax.pcast(A0, (varying_axis,), to="varying")
-            b0 = jax.lax.pcast(b0, (varying_axis,), to="varying")
+            from pio_tpu.parallel.compat import pcast
+
+            A0 = pcast(A0, (varying_axis,), to="varying")
+            b0 = pcast(b0, (varying_axis,), to="varying")
         (A, b), _ = jax.lax.scan(chunk_step, (A0, b0), chunks)
         return A, b
 
@@ -417,6 +432,8 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
     if mesh is not None and mesh.shape[axis] > 1:
         from jax.sharding import PartitionSpec as P
 
+        from pio_tpu.parallel.compat import shard_map
+
         blk_spec = (P(axis), P(axis), P(axis))
 
         def half_step(ent, other, r, factors, n_entities, chunk):
@@ -438,7 +455,7 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
             # check_vma=False: after the tiled all_gather every device holds
             # identical factors, but the varying-axis type system can't
             # infer that replication statically.
-            return jax.shard_map(
+            return shard_map(
                 body,
                 mesh=mesh,
                 in_specs=blk_spec + (P(),),
@@ -455,10 +472,15 @@ def _build_trainer(mesh, axis: str, iterations: int, reg: float,
 
     def run_body(by_user, by_item, seed):
         # factor init on device, inside the one compiled program:
-        # MLlib-style small random factors keep AᵀA well-conditioned
+        # MLlib-style |N(0,1)|/√rank — POSITIVE entries matched to the
+        # nonnegative ratings. A tiny symmetric init (±0.01) makes the
+        # first reg-dominated half-step collapse every factor onto one
+        # direction, and ALS (monotone) then converges inside that
+        # rank-deficient basin on some seeds
         ku, ki = jax.random.split(jax.random.PRNGKey(seed))
-        P_init = jax.random.normal(ku, (U_pad, rank), jnp.float32) * 0.01
-        Q_init = jax.random.normal(ki, (I_pad, rank), jnp.float32) * 0.01
+        scale = jnp.float32(rank) ** -0.5
+        P_init = jnp.abs(jax.random.normal(ku, (U_pad, rank), jnp.float32)) * scale
+        Q_init = jnp.abs(jax.random.normal(ki, (I_pad, rank), jnp.float32)) * scale
 
         def iteration(_, PQ):
             P_f, Q_f = PQ
@@ -581,7 +603,9 @@ def _build_stream_trainer(iterations: int, reg: float, implicit: bool,
         # trainer's draw
         ku, ki = jax.random.split(jax.random.PRNGKey(seed))
         del ku
-        Q0 = jax.random.normal(ki, (I_pad, rank), jnp.float32) * 0.01
+        Q0 = jnp.abs(
+            jax.random.normal(ki, (I_pad, rank), jnp.float32)
+        ) * (jnp.float32(rank) ** -0.5)
         A0 = jnp.zeros((U_pad, rank, rank), jnp.float32)
         b0 = jnp.zeros((U_pad, rank), jnp.float32)
         return Q0, A0, b0
@@ -759,7 +783,7 @@ def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
 
     init, accums, finalize = _build_stream_trainer(
         config.iterations, float(config.reg), bool(config.implicit),
-        float(config.alpha), str(config.matmul_dtype), str(config.solver),
+        float(config.alpha), _resolve_matmul_dtype(str(config.matmul_dtype)), str(config.solver),
         rank, U_pad, I_pad, w_user, w_item, S_item,
         chunk_stream, chunk_item, rating_wire, item_wire,
         tuple(tuple(s) for s in chunk_spec),
@@ -1252,7 +1276,7 @@ def train_als(
             mesh, axis, config.iterations, float(config.reg),
             bool(config.implicit), float(config.alpha),
             chunk_user, chunk_item,
-            str(config.matmul_dtype), str(config.solver),
+            _resolve_matmul_dtype(str(config.matmul_dtype)), str(config.solver),
             packed_shapes, K, U_pad, I_pad, rating_wire, item_wire,
             mesh_wire_lens,
         )

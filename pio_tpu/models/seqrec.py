@@ -184,11 +184,12 @@ def _block(blk, h, cfg, m_axis, s_axis):
     import jax
     import jax.numpy as jnp
 
+    from pio_tpu.parallel.compat import axis_size
     from pio_tpu.parallel.ring import ring_attention
     from pio_tpu.parallel.ulysses import ulysses_attention
 
     mb, t_loc, D = h.shape
-    n_model = 1 if m_axis is None else jax.lax.axis_size(m_axis)
+    n_model = 1 if m_axis is None else axis_size(m_axis)
     heads_loc = cfg.n_heads // n_model
     hd = cfg.d_model // cfg.n_heads
     if cfg.attention == "ring":
@@ -266,12 +267,13 @@ def _trunk(params, seqs, cfg, m_axis, s_axis, p_axis):
     if p_axis is None:
         h = apply_stack(h, blocks)
     else:
+        from pio_tpu.parallel.compat import axis_size
         from pio_tpu.parallel.pipeline import pipeline_apply
 
         # Microbatch so the pipe stays busy: with one microbatch every
         # stage computes discarded garbage for (n_pipe-1)/n_pipe of the
         # ticks. n_pipe microbatches ≈ 50% steady-state utilization.
-        n_pipe = jax.lax.axis_size(p_axis)
+        n_pipe = axis_size(p_axis)
         mb = h.shape[0]
         m = n_pipe if mb % n_pipe == 0 else 1
         hm = h.reshape(m, mb // m, *h.shape[1:])
@@ -340,7 +342,7 @@ def train_seqrec(
     import jax
     import jax.numpy as jnp
     import optax
-    from jax import shard_map
+    from pio_tpu.parallel.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     cfg = config

@@ -134,6 +134,8 @@ def _contrastive_loss(user_p, item_p, uids, iids, cfg, d_axis, m_axis):
     import jax
     import jax.numpy as jnp
 
+    from pio_tpu.parallel.compat import axis_size
+
     u = _tower_forward(user_p, uids, m_axis)  # [B_loc, D]
     v = _tower_forward(item_p, iids, m_axis)  # [B_loc, D]
     b_loc = u.shape[0]
@@ -153,7 +155,7 @@ def _contrastive_loss(user_p, item_p, uids, iids, cfg, d_axis, m_axis):
     loss = ce.sum()
     if d_axis is not None:
         loss = jax.lax.psum(loss, d_axis)
-        total = b_loc * jax.lax.axis_size(d_axis)
+        total = b_loc * axis_size(d_axis)
     else:
         total = b_loc
     return loss / total
@@ -178,7 +180,7 @@ def _build_tt_trainer(mesh, cfg: TwoTowerConfig, n_batches: int,
     import jax
     import jax.numpy as jnp
     import optax
-    from jax import shard_map
+    from pio_tpu.parallel.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     d_axis = "data" if mesh is not None else None

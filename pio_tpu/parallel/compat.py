@@ -1,0 +1,49 @@
+"""Version-bridging imports for jax APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the jax
+top level, renaming its replication-check kwarg ``check_rep`` →
+``check_vma`` on the way. Call sites import :func:`shard_map` from here
+and use the modern spelling; on an older jax the kwarg is translated.
+
+This module imports jax at module level — import it lazily (inside the
+compiled-path functions), like the call sites already import jax itself,
+so storage/server consumers of :mod:`pio_tpu.parallel` don't pay the
+jax import at startup.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    @functools.wraps(_legacy_shard_map)
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _legacy_shard_map(f, *args, **kwargs)
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+
+    def axis_size(axis_name):
+        """Classic spelling: ``psum(1, axis)`` constant-folds to the
+        static group size under pmap/shard_map."""
+        return jax.lax.psum(1, axis_name)
+
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+else:
+
+    def pcast(x, axis_name, to):
+        """Pre-varying-type jax (the ``check_rep`` era) tracks
+        replication dynamically — there is no type to cast."""
+        return x

@@ -18,6 +18,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from pio_tpu.parallel.compat import axis_size
+
 
 def pipeline_apply(params, x, stage_fn: Callable, *, axis: str = "pipe"):
     """Run ``x`` through ``n_stages`` chained applications of ``stage_fn``.
@@ -34,7 +36,7 @@ def pipeline_apply(params, x, stage_fn: Callable, *, axis: str = "pipe"):
     identical on every device of the axis (psum-reconciled), so callers can
     use ``out_specs=P(...)`` with the pipe dim unsharded.
     """
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     n_micro = x.shape[0]
     ticks = n_micro + n - 1

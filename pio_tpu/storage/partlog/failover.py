@@ -86,11 +86,22 @@ def promote(candidate_roots: List[str], dest_root: str,
     """Assemble a promoted leader root at ``dest_root`` from the
     election winners. Each partition's verified stream becomes
     ``pNNN/seg-00000001.log`` (positions are stream offsets, so one
-    segment holding the whole prefix is a valid chain). Returns the
-    election result plus the manifest written."""
+    segment holding the whole prefix is a valid chain). ``dest_root``
+    must be absent or empty: a prior incarnation's higher-numbered
+    segments or snapshot files would mix into the promoted chain and
+    replay rewritten/duplicated history. Returns the election result
+    plus the manifest written."""
     election = elect(candidate_roots, partitions)
     n = len(election)
     os.makedirs(dest_root, exist_ok=True)
+    stale = sorted(os.listdir(dest_root))
+    if stale:
+        raise base.StorageError(
+            f"failover promote: dest root {dest_root} is not empty "
+            f"(found {', '.join(stale[:5])}): stale segments or "
+            "snapshots would mix into the promoted chain — promote "
+            "into a fresh directory"
+        )
     for k, res in election.items():
         pdir = os.path.join(dest_root, f"p{k:03d}")
         os.makedirs(pdir, exist_ok=True)

@@ -102,7 +102,22 @@ class _View:
             elif t == "del":
                 self.buckets.setdefault(key, {}).pop(rec["id"], None)
             elif t == "rm":
-                self.buckets.pop(key, None)
+                # partition-scoped: remove() fans one rm record into
+                # EVERY partition, and each clears only the entries its
+                # own partition contributed (collectively the N records
+                # still clear the bucket). Replay walks partitions
+                # sequentially, so a bucket-wide pop here would delete
+                # events acked AFTER the purge that routed to a lower-
+                # numbered partition — replayed first, then wiped by a
+                # later partition's rm record.
+                bucket = self.buckets.get(key)
+                if bucket is not None:
+                    for eid in [
+                        eid for eid, row in bucket.items() if row[0] == k
+                    ]:
+                        del bucket[eid]
+                    if not bucket:
+                        self.buckets.pop(key, None)
             else:
                 raise base.StorageError(
                     f"unknown partlog record type {t!r}"
@@ -220,18 +235,41 @@ class PartitionedEventLog(base.LEvents):
     def _flush_partition(self, k: int, payloads) -> List[object]:
         """Append every payload's framed bytes in ONE write, gate on
         follower acks per the durability mode, then advance the view.
-        payloads: [(result, rec_dict, framed_bytes)]."""
-        blob = b"".join(framed for _, _, framed in payloads)
+        Each payload is a GROUP ``[(result, rec_dict, framed), ...]`` —
+        a single insert submits a one-member group, ``insert_batch``
+        submits its whole per-partition slice as one payload — so EVERY
+        write path serializes through the committer's commit lock and
+        segment order always matches view order."""
+        from pio_tpu.storage.groupcommit import PartialFlushOutcome
+
+        members = [m for group in payloads for m in group]
+        blob = b"".join(framed for _, _, framed in members)
         _, end = self._segs[k].append(blob)
+        ack_exc = None
         if self._replicator is not None:
             self._replicator.notify()
             if mode() == "commit":
                 # an ack here means min_acks follower DISKS have the
-                # bytes; a timeout raises and the 201 is never sent
-                self._replicator.wait_acked(k, end)
-        for _, rec, _ in payloads:
+                # bytes; a timeout must fail the WHOLE batch fast. The
+                # blob is already on the leader's segment log, so the
+                # committer's generic solo retry would re-append every
+                # payload — PartialFlushOutcome assigns the error
+                # verbatim instead (persisted-but-unacked is never
+                # blind-retried).
+                try:
+                    self._replicator.wait_acked(k, end)
+                except base.StorageError as exc:
+                    ack_exc = exc
+        # the view advances even when acks timed out: the bytes ARE on
+        # the leader's disk and a reopen would replay them — the live
+        # view and the segment chain must never disagree
+        for _, rec, _ in members:
             self._view.apply(rec, k)
-        return [result for result, _, _ in payloads]
+        if ack_exc is not None:
+            raise PartialFlushOutcome([ack_exc] * len(payloads))
+        return [
+            [result for result, _, _ in group] for group in payloads
+        ]
 
     # -- LEvents -------------------------------------------------------------
     def init_channel(self, app_id: int, channel_id=None) -> bool:
@@ -240,13 +278,15 @@ class PartitionedEventLog(base.LEvents):
     def insert(self, event: Event, app_id: int, channel_id=None) -> str:
         eid, rec, framed = self._encode_event(event, app_id, channel_id)
         k = partition_of(rec["e"]["entityId"], self.partitions)
-        return self._committers[k].submit((eid, rec, framed))
+        return self._committers[k].submit([(eid, rec, framed)])[0]
 
     def insert_batch(self, events, app_id: int, channel_id=None):
-        """Route the batch by partition, then ONE append per partition
-        touched (the records are self-framed, so a concatenation is a
-        valid append sequence — same contract as the eventlog backend).
-        """
+        """Route the batch by partition, then ONE committer submit per
+        partition touched — the whole per-partition slice is one group
+        payload, so it lands as one append (the records are self-framed,
+        so a concatenation is a valid append sequence — same contract as
+        the eventlog backend) and cannot interleave with a concurrent
+        committer-led flush on the same partition."""
         if not events:
             return []
         ids: List[str] = []
@@ -257,7 +297,7 @@ class PartitionedEventLog(base.LEvents):
             k = partition_of(rec["e"]["entityId"], self.partitions)
             groups.setdefault(k, []).append((eid, rec, framed))
         for k, members in groups.items():
-            self._flush_partition(k, members)
+            self._committers[k].submit(members)
         return ids
 
     def get(self, event_id: str, app_id: int, channel_id=None):
@@ -278,8 +318,8 @@ class PartitionedEventLog(base.LEvents):
                    "id": event_id}
             k = partition_of(ev.entity_id, self.partitions)
             return self._committers[k].submit(
-                (True, rec, self._frame_rec(rec))
-            )
+                [(True, rec, self._frame_rec(rec))]
+            )[0]
 
     def find(
         self,
@@ -316,7 +356,7 @@ class PartitionedEventLog(base.LEvents):
         rec = {"t": "rm", "a": app_id, "c": channel_id}
         for k in range(self.partitions):
             self._committers[k].submit(
-                (True, rec, self._frame_rec(rec))
+                [(True, rec, self._frame_rec(rec))]
             )
         return True
 
@@ -342,7 +382,7 @@ class PartitionedEventLog(base.LEvents):
                     (True, rec, self._frame_rec(rec))
                 )
         for k, members in groups.items():
-            self._flush_partition(k, members)
+            self._committers[k].submit(members)
 
     # -- compaction / snapshot-aware aggregation -----------------------------
     def compact(self) -> Dict[int, int]:

@@ -415,6 +415,15 @@ class Replicator:
         self.min_acks = env_int(
             MIN_ACKS_VAR, 1 if addrs else 0, positive=False
         )
+        if self.min_acks > len(addrs):
+            # loud misconfiguration, same policy as durability.mode():
+            # silently capping to the replica count would quietly weaken
+            # the commit-durability guarantee the operator asked for
+            raise base.StorageError(
+                f"{MIN_ACKS_VAR}={self.min_acks} exceeds the "
+                f"{len(addrs)} replica(s) configured in {REPLICAS_VAR}: "
+                "commit durability could never collect that many acks"
+            )
         self.ack_timeout_s = env_float(
             ACK_TIMEOUT_VAR, DEFAULT_ACK_TIMEOUT_S, positive=True
         )
@@ -445,7 +454,7 @@ class Replicator:
         circuit breaker, not burn the request's budget in retries."""
         if timeout_s is None:
             timeout_s = self.ack_timeout_s
-        need = min(self.min_acks, len(self._links))
+        need = self.min_acks  # construction guarantees <= len(links)
         if need <= 0:
             return
         deadline = monotonic_s() + timeout_s

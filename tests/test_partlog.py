@@ -184,6 +184,54 @@ class TestRouter:
         assert {e.event_id for e in again.find(1)} == set(ids[1:])
         again.close()
 
+    def test_post_remove_writes_survive_reopen(self, tmp_path):
+        """A channel purge fans one rm record into every partition, but
+        replay walks partitions SEQUENTIALLY: each rm must clear only
+        its own partition's pre-purge entries, or events acked after
+        the purge that routed to a lower-numbered partition get
+        replayed first and then wiped by a later partition's rm."""
+        root = str(tmp_path / "pl")
+        log = PartitionedEventLog(root, partitions=4)
+        for h in range(1, 6):
+            log.insert(ev("rate", T(h), eid=f"old{h}"), 1)
+        assert log.remove(1)
+        # new1..new8 spread over all 4 partitions (verified routing)
+        ids = [
+            log.insert(ev("rate", T(h), eid=f"new{h}"), 1)
+            for h in range(1, 9)
+        ]
+        assert {e.event_id for e in log.find(1)} == set(ids)
+        log.close()
+        again = PartitionedEventLog(root)
+        assert {e.event_id for e in again.find(1)} == set(ids)
+        again.close()
+
+    def test_batch_writes_ride_the_committer(self, tmp_path):
+        """insert_batch and delete_bulk must go through the partition's
+        GroupCommitter (one group payload per partition touched), never
+        flush directly — a direct flush could interleave with a
+        committer-led flush on the same partition, letting segment
+        order and view order diverge."""
+        log = PartitionedEventLog(str(tmp_path / "pl"), partitions=2)
+        submitted = []
+        for k, gc in enumerate(log._committers):
+            gc.submit = (
+                lambda payload, _k=k, _orig=gc.submit:
+                submitted.append((_k, len(payload))) or _orig(payload)
+            )
+        events = [ev("rate", T(h), eid=f"u{h}") for h in range(1, 9)]
+        ids = log.insert_batch(events, 1)
+        assert len(ids) == 8
+        assert sum(n for _, n in submitted) == 8
+        assert {k for k, _ in submitted} == {
+            partition_of(f"u{h}", 2) for h in range(1, 9)
+        }
+        submitted.clear()
+        log.delete_bulk(ids[:3], 1)
+        assert sum(n for _, n in submitted) == 3
+        assert len(log.find(1)) == 5
+        log.close()
+
 
 # -------------------------------------------------------------- replication
 class TestReplication:
@@ -231,6 +279,51 @@ class TestReplication:
         assert not is_transient(ei.value)
         assert monotonic_s() - t0 < 5.0
         log.close()
+
+    def test_ack_timeout_does_not_duplicate_appends(
+        self, tmp_path, monkeypatch
+    ):
+        """An ack timeout fires AFTER the blob hit the leader's segment
+        log: the flush must report it via PartialFlushOutcome so the
+        committer fails the whole batch in ONE timeout — a generic
+        raise would trigger the solo-retry path, re-appending every
+        already-persisted payload and waiting the timeout per payload."""
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        monkeypatch.setenv(
+            "PIO_TPU_PARTLOG_REPLICAS", f"127.0.0.1:{dead_port}"
+        )
+        monkeypatch.setenv("PIO_TPU_REPL_ACK_TIMEOUT_S", "0.2")
+        monkeypatch.setenv("PIO_TPU_REPL_CONNECT_DEADLINE_S", "0.2")
+        monkeypatch.setenv("PIO_TPU_DURABILITY", "commit")
+        log = PartitionedEventLog(str(tmp_path / "leader"), partitions=1)
+        events = [ev("rate", T(h), eid=f"u{h}") for h in range(1, 7)]
+        t0 = monotonic_s()
+        with pytest.raises(StorageError, match="replication ack timeout"):
+            log.insert_batch(events, 1)
+        # one timeout for the whole batch, not (B+1) solo re-waits
+        assert monotonic_s() - t0 < 2.0
+        # each record persisted exactly once — no solo re-appends
+        assert len(log._segs[0].payloads()) == 6
+        # persisted-but-unacked: live view matches what replay serves
+        assert len(log.find(1)) == 6
+        log.close()
+        again = PartitionedEventLog(str(tmp_path / "leader"))
+        assert len(again.find(1)) == 6
+        again.close()
+
+    def test_min_acks_above_replica_count_raises(
+        self, tmp_path, monkeypatch
+    ):
+        # silently capping min_acks to the replica count would weaken
+        # the durability guarantee the operator asked for — misconfig
+        # must fail construction loudly (durability.mode() policy)
+        monkeypatch.setenv("PIO_TPU_PARTLOG_REPLICAS", "127.0.0.1:9")
+        monkeypatch.setenv("PIO_TPU_REPL_MIN_ACKS", "3")
+        with pytest.raises(StorageError, match="PIO_TPU_REPL_MIN_ACKS"):
+            PartitionedEventLog(str(tmp_path / "leader"), partitions=2)
 
     def test_reconnect_catches_up(self, tmp_path, monkeypatch):
         """A follower that was down during the writes reconnects and
@@ -413,6 +506,25 @@ class TestElection:
     def test_no_manifest_anywhere_raises(self, tmp_path):
         with pytest.raises(StorageError, match="MANIFEST"):
             failover.elect([str(tmp_path / "empty")])
+
+    def test_promote_refuses_nonempty_dest(self, tmp_path):
+        # a prior incarnation's files (an older seg-00000002.log, a
+        # snapshot) would mix into the promoted chain — refuse loudly
+        a = str(tmp_path / "a")
+        self._mk_follower_root(a, [[b"x"]])
+        dest = str(tmp_path / "dest")
+        os.makedirs(os.path.join(dest, "p000"))
+        with open(
+            os.path.join(dest, "p000", "seg-00000002.log"), "wb"
+        ) as f:
+            f.write(framing.frame(b"stale"))
+        with pytest.raises(StorageError, match="not empty"):
+            failover.promote([a], dest)
+        # a pre-created but EMPTY dest is fine
+        dest2 = str(tmp_path / "dest2")
+        os.makedirs(dest2)
+        res = failover.promote([a], dest2)
+        assert res["partitions"] == 1
 
 
 # --------------------------------------------------------------- compaction
