@@ -1003,6 +1003,125 @@ def _bench_pool_serving(factors, n_users: int, n_items: int) -> dict:
     return got
 
 
+def _bench_sharded_serving(factors, n_users: int, n_items: int,
+                           baseline_qps=None) -> dict:
+    """Mesh-worker pool stage: worker 0 owns the whole device mesh and
+    serves with partition-rule-sharded factor tables (ISSUE 10). On a
+    host without an accelerator the mesh is 8 simulated CPU devices
+    (XLA_FLAGS, inherited by the spawned worker), so the number here
+    mostly proves the sharded dispatch path and its retrace behavior;
+    ``scaling_x`` is sharded QPS over the single-device laned pool."""
+    import sys as _sys
+    import urllib.request
+
+    from pio_tpu.server.worker_pool import ServingPool
+    from pio_tpu.workflow.core_workflow import run_train
+    from pio_tpu.workflow.engine_json import build_engine, variant_from_dict
+
+    home = os.environ["PIO_TPU_HOME"]
+    np.savez(
+        os.path.join(home, "bench_factors.npz"),
+        user_factors=factors.user_factors,
+        item_factors=factors.item_factors,
+    )
+    with open(os.path.join(home, "pio_bench_pool_engine.py"), "w") as f:
+        f.write(_POOL_ENGINE_SRC)
+    if home not in _sys.path:
+        _sys.path.insert(0, home)
+    os.environ["PYTHONPATH"] = (
+        home + os.pathsep + os.environ.get("PYTHONPATH", "")
+    )
+    variant = variant_from_dict({
+        "id": "bench-recommendation-sharded",
+        "version": "1",
+        "engineFactory": "pio_bench_pool_engine:engine",
+        "algorithms": [{"name": "als", "params": {}}],
+    })
+    engine, ep = build_engine(variant)
+    run_train(engine, ep, variant)
+
+    cores = len(os.sched_getaffinity(0))
+    n_workers = max(2, min(4, cores))
+    import jax
+
+    n_real = len(jax.devices())
+    prev_xla = os.environ.get("XLA_FLAGS")
+    if n_real <= 1:
+        # no multi-chip hardware: give the spawned mesh worker a
+        # simulated 8-device CPU mesh (host-platform device count only
+        # affects the CPU backend, so this is a no-op on real TPU hosts)
+        os.environ["XLA_FLAGS"] = (
+            (prev_xla + " " if prev_xla else "")
+            + "--xla_force_host_platform_device_count=8"
+        )
+    got: dict = {"workers": n_workers, "mesh_devices": max(n_real, 8)}
+    try:
+        pool = ServingPool(
+            variant, host="127.0.0.1", port=0, n_workers=n_workers,
+            mesh_worker=True,
+        )
+        t_boot = time.perf_counter()
+        pool.start()
+        try:
+            pool.wait_ready(timeout=180)
+            got["time_to_ready_s"] = round(time.perf_counter() - t_boot, 4)
+            warm = _KeepAliveClient(pool.port)
+            for _ in range(2 * n_workers):
+                warm({"user": "u1", "num": 10})
+                warm.close()
+                warm = _KeepAliveClient(pool.port)
+            warm.close()
+            sg = _concurrent_stage(pool.port, n_users)
+            got["qps"] = sg["qps"]
+            got["p50_ms"] = sg.get("p50_ms")
+            got["p95_ms"] = sg.get("p95_ms")
+            # the kernel picks which worker answers /stats.json; retry
+            # until the mesh owner (the only one with sharding enabled)
+            # answers, so the artifact records the actual placement
+            for _ in range(16):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{pool.port}/stats.json", timeout=5.0
+                ) as r:
+                    st = json.loads(r.read().decode("utf-8"))
+                sh = st.get("sharding") or {}
+                if sh.get("enabled"):
+                    got["sharding"] = sh
+                    break
+        finally:
+            pool.stop()
+    finally:
+        if prev_xla is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = prev_xla
+    if baseline_qps is None:
+        # no laned pool_qps to compare against (pool stage failed):
+        # measure the single-device funnel here
+        try:
+            base = ServingPool(
+                variant, host="127.0.0.1", port=0, n_workers=n_workers,
+                device_worker=True,
+            )
+            base.start()
+            try:
+                base.wait_ready(timeout=180)
+                warm = _KeepAliveClient(base.port)
+                for _ in range(2 * n_workers):
+                    warm({"user": "u1", "num": 10})
+                    warm.close()
+                    warm = _KeepAliveClient(base.port)
+                warm.close()
+                baseline_qps = _concurrent_stage(base.port, n_users)["qps"]
+            finally:
+                base.stop()
+        except Exception as exc:
+            print(f"# sharded baseline pool failed: {exc}", file=sys.stderr)
+    if baseline_qps:
+        got["baseline_qps"] = baseline_qps
+        got["scaling_x"] = round(got["qps"] / baseline_qps, 3)
+    return got
+
+
 # ------------------------------------------------------------- secondary
 def _bench_classification(ctx, scale: float) -> dict:
     """BASELINE config #2: LogReg (treeAggregate ≡ psum all-reduce).
@@ -1819,6 +1938,8 @@ def build_summary(full: dict, full_path: str = "BENCH_FULL.json") -> dict:
         "pool_laned_qps": get("serving", "pool", "laned_qps"),
         "pool_workers": get("serving", "pool", "workers"),
         "host_cores": get("serving", "pool", "host_cores"),
+        "sharded_qps": get("serving", "sharded", "qps"),
+        "sharded_scaling_x": get("serving", "sharded", "scaling_x"),
         "serving_attributed": get(
             "serving", "latency_budget", "attributedFraction"
         ),
@@ -2044,6 +2165,13 @@ def main() -> None:
         serving["pool"] = _bench_pool_serving(factors, n_users, n_items)
     except Exception as exc:
         print(f"# pool serving stage failed: {exc}", file=sys.stderr)
+    try:
+        serving["sharded"] = _bench_sharded_serving(
+            factors, n_users, n_items,
+            baseline_qps=serving.get("pool", {}).get("laned_qps"),
+        )
+    except Exception as exc:
+        print(f"# sharded serving stage failed: {exc}", file=sys.stderr)
     try:
         serving["resident"] = _bench_resident_serving(
             min(n_queries, 200)

@@ -118,6 +118,8 @@ FAILPOINT_NAMESPACES = (
     # partitioned event log + its replication protocol (ISSUE 9)
     "partlog.",
     "repl.",
+    # mesh-sharded placement + shard-manifest reassembly (ISSUE 10)
+    "shard.",
 )
 
 

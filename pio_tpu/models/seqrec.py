@@ -142,29 +142,24 @@ def init_params(vocab: int, cfg: SeqRecConfig):
 
 
 def param_specs(cfg: SeqRecConfig):
-    """PartitionSpecs: ep for emb, tp for heads/ffn, pp over the stack."""
-    from jax.sharding import PartitionSpec as P
+    """PartitionSpecs: ep for emb, tp for heads/ffn, pp over the stack —
+    derived from the partition-rule registry (``rules_for("seqrec")``)."""
+    from pio_tpu.parallel.partition import match_partition_rules, rules_for
 
-    return {
-        "emb": P("model", None),  # vocab-sharded (ep)
-        "pos": P(),
-        "blocks": {
-            "ln1_g": P("pipe", None),
-            "ln1_b": P("pipe", None),
-            "wq": P("pipe", None, "model"),  # heads column-sharded (tp)
-            "wk": P("pipe", None, "model"),
-            "wv": P("pipe", None, "model"),
-            "wo": P("pipe", "model", None),  # row-sharded + psum (tp)
-            "ln2_g": P("pipe", None),
-            "ln2_b": P("pipe", None),
-            "w1": P("pipe", None, "model"),  # ffn column-sharded (tp)
-            "b1": P("pipe", "model"),
-            "w2": P("pipe", "model", None),  # ffn row-sharded + psum (tp)
-            "b2": P("pipe", None),
-        },
-        "lnf_g": P(),
-        "lnf_b": P(),
+    block_keys = (
+        "ln1_g", "ln1_b", "wq", "wk", "wv", "wo",
+        "ln2_g", "ln2_b", "w1", "b1", "w2", "b2",
+    )
+    skeleton = {
+        "emb": np.empty(0),
+        "pos": np.empty(0),
+        "blocks": {k: np.empty(0) for k in block_keys},
+        "lnf_g": np.empty(0),
+        "lnf_b": np.empty(0),
     }
+    return match_partition_rules(
+        rules_for("seqrec"), skeleton, on_unmatched="error"
+    )
 
 
 def _ln(x, g, b):

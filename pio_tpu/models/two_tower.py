@@ -93,16 +93,14 @@ def _init_tower(key, vocab: int, cfg: TwoTowerConfig):
 
 
 def _tower_specs():
-    """PartitionSpecs for one tower's params (see module docstring)."""
-    from jax.sharding import PartitionSpec as P
+    """PartitionSpecs for one tower's params, from the partition-rule
+    registry (``rules_for("two_tower")``) — ep embedding, tp MLP splits."""
+    from pio_tpu.parallel.partition import match_partition_rules, rules_for
 
-    return {
-        "emb": P("model", None),  # vocab-sharded (ep)
-        "w1": P(None, "model"),  # column-sharded (tp)
-        "b1": P("model"),
-        "w2": P("model", None),  # row-sharded (tp)
-        "b2": P(),
-    }
+    skeleton = {k: np.empty(0) for k in ("emb", "w1", "b1", "w2", "b2")}
+    return match_partition_rules(
+        rules_for("two_tower"), skeleton, on_unmatched="error"
+    )
 
 
 def _tower_forward(params, ids, axis: Optional[str]):

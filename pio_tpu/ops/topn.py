@@ -56,10 +56,21 @@ def _bucket(n: int, cap: int) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _topn_fn(k: int, with_exclude: bool):
-    """Jitted [B,K]@[K,N] + top-k (cached per static k / exclusion arity)."""
+def _topn_fn(k: int, with_exclude: bool, n_valid: Optional[int] = None):
+    """Jitted [B,K]@[K,N] + top-k (cached per static k / exclusion arity).
+
+    ``n_valid``: static count of real columns when the col table is padded
+    to a mesh multiple — pad columns are masked to -inf before top-k so a
+    zero-vector pad row can never outrank a real negative score.
+    """
     import jax
     import jax.numpy as jnp
+
+    def _mask_pad(scores):
+        if n_valid is None:
+            return scores
+        keep = jnp.arange(scores.shape[1]) < n_valid
+        return jnp.where(keep[None, :], scores, -jnp.inf)
 
     if with_exclude:
 
@@ -68,6 +79,7 @@ def _topn_fn(k: int, with_exclude: bool):
             scores = jnp.einsum(
                 "bk,nk->bn", q, cols, preferred_element_type=jnp.float32
             )
+            scores = _mask_pad(scores)
             b = jnp.arange(codes.shape[0])[:, None]
             # sentinel index n_cols is out of bounds → dropped, not wrapped
             scores = scores.at[b, excl].set(-jnp.inf, mode="drop")
@@ -80,7 +92,7 @@ def _topn_fn(k: int, with_exclude: bool):
             scores = jnp.einsum(
                 "bk,nk->bn", q, cols, preferred_element_type=jnp.float32
             )
-            return jax.lax.top_k(scores, k)
+            return jax.lax.top_k(_mask_pad(scores), k)
 
     return jax.jit(fn)
 
@@ -150,6 +162,13 @@ class DeviceTopNScorer:
     None consults ``PIO_TPU_SERVE_DEVICE`` and defaults to adaptive
     batch-size routing (see module docstring). ``link_rtt_s`` overrides the
     probed link round-trip (tests inject synthetic link speeds).
+
+    ``mesh``: a multi-device mesh to shard the factor tables over. Both
+    tables row-shard on the mesh's entity axis (``data``), padded up to a
+    shard multiple — each chip holds 1/n of the model, and the jitted
+    score + top-k runs GSPMD-sharded with stable input shardings (no
+    steady-state retraces). The per-device footprint is enforced against
+    ``PIO_TPU_DEVICE_BUDGET_BYTES`` when set.
     """
 
     def __init__(
@@ -159,6 +178,7 @@ class DeviceTopNScorer:
         prefer_device: Optional[bool] = None,
         warmup: bool = False,
         link_rtt_s: Optional[float] = None,
+        mesh=None,
     ):
         rows = np.ascontiguousarray(row_factors, dtype=np.float32)
         cols = np.ascontiguousarray(col_factors, dtype=np.float32)
@@ -172,6 +192,10 @@ class DeviceTopNScorer:
         self._cols_np = cols
         self._rows_dev = self._cols_dev = None
         self._cols_t = None  # lazy transposed mirror (native host path)
+        if mesh is not None and int(np.prod(mesh.devices.shape)) <= 1:
+            mesh = None  # a 1-chip mesh is the plain device path
+        self._mesh = mesh
+        self._ncols_pad = self.n_cols
 
         if self.n_rows == 0 or self.n_cols == 0:
             # degenerate factor tables cannot be probed (the host-row
@@ -194,9 +218,23 @@ class DeviceTopNScorer:
         else:
             import jax
 
+            from pio_tpu.parallel.partition import assert_device_budget
+
             # the single upload of the deploy lifetime
-            self._rows_dev = jax.device_put(rows)
-            self._cols_dev = jax.device_put(cols)
+            if self._mesh is not None:
+                n_dev = int(np.prod(self._mesh.devices.shape))
+                assert_device_budget(
+                    rows.nbytes + cols.nbytes, n_dev, "topn mesh placement"
+                )
+                self._rows_dev, self._cols_dev, self._ncols_pad = (
+                    self._place_sharded(rows, cols)
+                )
+            else:
+                assert_device_budget(
+                    rows.nbytes + cols.nbytes, 1, "topn device placement"
+                )
+                self._rows_dev = jax.device_put(rows)
+                self._cols_dev = jax.device_put(cols)
             if mode == "device":
                 self.min_device_batch = 1
                 self.min_pair_batch = 1
@@ -225,6 +263,55 @@ class DeviceTopNScorer:
             # DEPLOY time, not inside the first live request
             self.top_n_batch(np.zeros(1, np.int32), 1)
 
+    def _place_sharded(self, rows, cols):
+        """Row-shard both tables over the mesh entity axis (padded to a
+        shard multiple; pad rows are zero and masked out of top-k)."""
+        import jax
+
+        from pio_tpu.parallel.compat import NamedSharding
+        from pio_tpu.parallel.compat import PartitionSpec as P
+
+        mesh = self._mesh
+        axis = "data" if "data" in mesh.axis_names else mesh.axis_names[0]
+        size = int(mesh.shape[axis])
+        sharding = NamedSharding(mesh, P(axis, None))
+
+        def pad_rows(a):
+            n = -(-a.shape[0] // size) * size
+            if n == a.shape[0]:
+                return a
+            out = np.zeros((n, a.shape[1]), a.dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        rows_dev = jax.device_put(pad_rows(rows), sharding)
+        cols_p = pad_rows(cols)
+        return rows_dev, jax.device_put(cols_p, sharding), cols_p.shape[0]
+
+    @property
+    def mesh_sharded(self) -> bool:
+        """True when the factor tables are sharded over a serving mesh."""
+        return self._mesh is not None and self.on_device
+
+    def sharding_info(self) -> Optional[dict]:
+        """Placement summary for /stats.json; None when unsharded."""
+        if not self.mesh_sharded:
+            return None
+        mesh = self._mesh
+        n_dev = int(np.prod(mesh.devices.shape))
+        total = self._rows_np.nbytes + self._cols_np.nbytes
+        return {
+            "meshShape": {
+                k: int(v) for k, v in mesh.shape.items() if int(v) > 1
+            } or {"data": 1},
+            "nDevices": n_dev,
+            "rows": [int(self.n_rows), int(self.rank)],
+            "cols": [int(self.n_cols), int(self.rank)],
+            "colsPadded": int(self._ncols_pad),
+            "bytesPerDevice": -(-total // n_dev),
+            "totalBytes": int(total),
+        }
+
     @property
     def on_device(self) -> bool:
         """True when at least some batch sizes route to the accelerator."""
@@ -247,6 +334,8 @@ class DeviceTopNScorer:
 
         B = codes.shape[0]
         k = _bucket(n, self.n_cols) if n < self.n_cols else self.n_cols
+        padded_cols = self._ncols_pad != self.n_cols
+        n_valid = self.n_cols if padded_cols else None
         idx_out = np.empty((B, k), np.int64)
         val_out = np.empty((B, k), np.float32)
         for lo in range(0, B, _MAX_BATCH_BUCKET):
@@ -261,19 +350,23 @@ class DeviceTopNScorer:
                 ep = np.pad(
                     exclude[lo:lo + _MAX_BATCH_BUCKET],
                     ((0, pad), (0, _bucket(max(E, 1), 1 << 30) - E)),
-                    constant_values=self.n_cols,  # OOB sentinel → dropped
+                    constant_values=self._ncols_pad,  # OOB → dropped
                 )
-                vals, idx = _topn_fn(k, True)(
+                vals, idx = _topn_fn(k, True, n_valid)(
                     self._rows_dev, self._cols_dev, cp, ep
                 )
             else:
-                vals, idx = _topn_fn(k, False)(
+                vals, idx = _topn_fn(k, False, n_valid)(
                     self._rows_dev, self._cols_dev, cp
                 )
             vals, idx = jax.device_get((vals, idx))
             m = chunk.shape[0]
             idx_out[lo:lo + m] = idx[:m]
             val_out[lo:lo + m] = vals[:m]
+        if padded_cols:
+            # a fully-masked row could surface a pad index at -inf; pin
+            # such slots to col 0 so callers never see an OOB item code
+            idx_out = np.where(np.isfinite(val_out), idx_out, 0)
         return idx_out[:, :n], val_out[:, :n]
 
     #: native host scorer is a SINGLE-CORE fused loop targeting the
@@ -421,7 +514,8 @@ class DeviceTopNScorer:
             s = jax.device_get(
                 _scores_fn()(self._rows_dev, self._cols_dev, cp)
             )
-            out[lo:lo + chunk.shape[0]] = s[: chunk.shape[0]]
+            # sharded placement pads the col table; trim pad columns
+            out[lo:lo + chunk.shape[0]] = s[: chunk.shape[0], : self.n_cols]
         return out
 
     def score_pairs(

@@ -15,6 +15,14 @@ multi-second jax import at startup.
 from pio_tpu.parallel.context import ComputeContext, default_mesh
 from pio_tpu.parallel.distributed import maybe_initialize
 from pio_tpu.parallel.mesh import AXIS_ORDER, MeshSpec, build_mesh, mesh_axis_size
+from pio_tpu.parallel.partition import (
+    DeviceBudgetExceeded,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    register_partition_rules,
+    rules_for,
+    shard_params,
+)
 
 _LAZY = {
     "pipeline_apply": "pio_tpu.parallel.pipeline",
@@ -28,11 +36,17 @@ _LAZY = {
 __all__ = [
     "AXIS_ORDER",
     "ComputeContext",
+    "DeviceBudgetExceeded",
     "MeshSpec",
     "build_mesh",
     "default_mesh",
+    "make_shard_and_gather_fns",
+    "match_partition_rules",
     "maybe_initialize",
     "mesh_axis_size",
+    "register_partition_rules",
+    "rules_for",
+    "shard_params",
     *sorted(_LAZY),
 ]
 

@@ -39,6 +39,21 @@ else:
         return jax.lax.psum(1, axis_name)
 
 
+try:
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+except ImportError:  # pre-jax.sharding releases
+    try:
+        from jax.experimental.sharding import (  # noqa: F401
+            NamedSharding,
+        )
+    except ImportError:
+        from jax.experimental.pjit import (  # noqa: F401
+            NamedSharding,
+        )
+    from jax.experimental import PartitionSpec  # noqa: F401
+    from jax.experimental.maps import Mesh  # noqa: F401
+
+
 if hasattr(jax.lax, "pcast"):
     pcast = jax.lax.pcast
 else:

@@ -136,6 +136,24 @@ class ComputeContext:
         out["mask"] = jax.device_put(mask, sharding)
         return out
 
+    def shard_params(self, params, rules=None, template=None,
+                     on_unmatched="replicate"):
+        """Place a parameter pytree on the mesh under partition rules.
+
+        ``rules`` is an ordered ``(path_regex, PartitionSpec)`` list;
+        pass ``template`` instead to use the registered rule set
+        (``"als"`` / ``"two_tower"`` / ``"seqrec"``). Returns
+        ``(sharded_params, specs)``; with no mesh the params come back
+        as single-device jnp arrays.
+        """
+        from pio_tpu.parallel import partition as _partition
+
+        if rules is None:
+            rules = _partition.rules_for(template) if template else []
+        return _partition.shard_params(
+            self.mesh, params, rules, on_unmatched=on_unmatched
+        )
+
     def replicate(self, array):
         """Fully replicate an array over the mesh (broadcast analog)."""
         import jax

@@ -692,6 +692,26 @@ class QueryServerService:
             self._donation_total.labels(eng, outcome)
         self._resident_params_bytes.labels(eng)
         self._resident_models.labels(eng)
+        # -- mesh-sharded serving (ISSUE 10): factor tables partitioned
+        # over the serving mesh via the partition-rule registry
+        # (PIO_TPU_MESH_SERVE gate). Counters pre-created before any
+        # pool bind, same as the families above.
+        self._sharding_info: Optional[dict] = None
+        self._shard_bytes_placed_total = self.obs.counter(
+            "pio_tpu_shard_bytes_placed_total",
+            "Model parameter bytes placed sharded across the serving "
+            "mesh (summed over devices, once per deploy generation)",
+            ("engine_id",),
+        )
+        self._shard_gather_fallback_total = self.obs.counter(
+            "pio_tpu_shard_gather_fallback_total",
+            "Mesh placements that fell back to single-device/replicated "
+            "serving (budget exceeded, indivisible shapes, or placement "
+            "error)",
+            ("engine_id",),
+        )
+        self._shard_bytes_placed_total.labels(eng)
+        self._shard_gather_fallback_total.labels(eng)
         self.profile_hook = DeviceProfileHook.from_env()
         self._swap_lock = make_lock("query.model_swap")
         self._deployed = True
@@ -745,6 +765,17 @@ class QueryServerService:
             instance_id, engine, engine_params, self.ctx,
             variant=self.variant,
         )
+        # mesh attach must precede prepare_for_serving (inside
+        # algorithms_with_models): templates warm their device scorer
+        # there, and a model that only fits sharded would fail the
+        # per-device budget on the single-chip path
+        serve_mesh = self._serving_mesh()
+        if serve_mesh is not None:
+            for m in models:
+                try:
+                    m.__dict__["_serve_mesh"] = serve_mesh
+                except AttributeError:  # __slots__ model: no mesh channel
+                    pass
         pairs = engine.algorithms_with_models(engine_params, models)
         serving = engine.make_serving(engine_params)
         # resolve once at load — a conflicting query-class config should fail
@@ -757,10 +788,12 @@ class QueryServerService:
         # warmed set + resident scorers atomically (hot-swap = eviction
         # of the old generation's entries AND retirement of its device
         # params)
+        sharding_info = self._place_mesh(pairs)
         incoming = self._place_resident(pairs)
         warmed = self._warm_buckets(pairs, serving)
         eng = self.variant.engine_id
         with self._swap_lock:
+            self._sharding_info = sharding_info
             self.engine, self.engine_params = engine, engine_params
             self.instance_id = instance_id
             self.pairs, self.serving = pairs, serving
@@ -784,6 +817,84 @@ class QueryServerService:
             "serving engine instance %s (generation %d, %d resident)",
             instance_id, gen, len(incoming),
         )
+
+    def _serving_mesh(self):
+        """The mesh to shard serving params over, or None.
+
+        Gate: ``PIO_TPU_MESH_SERVE=1`` enables sharded serving over the
+        context mesh; ``0``/unset keeps the single-device placement every
+        existing deploy runs (sharding changes device placement, so it is
+        opt-in per server, not inferred from mesh presence)."""
+        flag = os.environ.get("PIO_TPU_MESH_SERVE", "0").strip().lower()
+        if flag not in ("1", "on", "true"):
+            return None
+        mesh = self.ctx.mesh
+        if mesh is None or self.ctx.num_devices <= 1:
+            return None
+        return mesh
+
+    def _place_mesh(self, pairs) -> Optional[dict]:
+        """Shard each model's serving factor tables over the serving mesh
+        (partition-rule placement inside the scorer; see ops/topn.py).
+
+        Runs on the INCOMING pairs before the swap, like residency: the
+        scorers build eagerly here so placement cost and failures land at
+        deploy, not inside the first live query. A model whose placement
+        fails (budget, shapes) serves single-device instead — counted by
+        ``pio_tpu_shard_gather_fallback_total``."""
+        mesh = self._serving_mesh()
+        if mesh is None:
+            return None
+        eng = self.variant.engine_id
+        placed = []
+        for algo, m in pairs:
+            # resident scorers read the same attribute at build time;
+            # __dict__ write keeps frozen dataclass models settable
+            try:
+                m.__dict__["_serve_mesh"] = mesh
+            except AttributeError:  # __slots__ model: no mesh channel
+                continue
+            if not hasattr(m, "scorer"):
+                continue
+            try:
+                failpoint("shard.place")
+                # prepare_for_serving usually built the sharded scorer
+                # already (the mesh attaches before it in _load); rebuild
+                # only when the cache predates the mesh or went host-mode
+                sc = m.__dict__.get("_scorer")
+                if sc is None or not getattr(sc, "mesh_sharded", False):
+                    m.__dict__.pop("_scorer", None)
+                    sc = m.scorer(warmup=True)
+                info = sc.sharding_info() if sc is not None else None
+            except Exception:
+                log.exception(
+                    "mesh placement failed for %s; serving single-device",
+                    type(m).__name__,
+                )
+                m.__dict__.pop("_serve_mesh", None)
+                m.__dict__.pop("_scorer", None)
+                self._shard_gather_fallback_total.inc(engine_id=eng)
+                continue
+            if info is None:
+                # scorer chose the host/replicated path (budget, 1-chip
+                # mesh, host-forced mode): not a sharded placement
+                self._shard_gather_fallback_total.inc(engine_id=eng)
+                continue
+            info = dict(info)
+            info["model"] = type(m).__name__
+            placed.append(info)
+            self._shard_bytes_placed_total.inc(
+                int(info["totalBytes"]), engine_id=eng
+            )
+            log.info(
+                "sharded placement: %s over %d device(s), %d B/device",
+                type(m).__name__, info["nDevices"], info["bytesPerDevice"],
+            )
+        return {
+            "enabled": True,
+            "meshDevices": self.ctx.num_devices,
+            "models": placed,
+        }
 
     def _place_resident(self, pairs) -> list:
         """Build + place device-resident scorers for the incoming pairs
@@ -1578,6 +1689,11 @@ class QueryServerService:
             "paramBytes": sum(sc.placed_bytes for sc in resident),
             "scorers": [sc.to_dict() for sc in resident],
         }
+        with self._swap_lock:
+            sharding = self._sharding_info
+        out["sharding"] = (
+            dict(sharding) if sharding else {"enabled": False}
+        )
         if self._lane_drainer is not None:
             out["batchLane"] = {
                 "role": "drainer",

@@ -183,6 +183,7 @@ class ResidentLinearScorer:
         scales: Optional[np.ndarray] = None,
         name: str = "",
         query_factory: Optional[Callable[[np.ndarray], object]] = None,
+        mesh=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -212,9 +213,49 @@ class ResidentLinearScorer:
         else:
             w_eff = W
         # the one-time placement: these device arrays ARE the serving
-        # params for this generation; no per-dispatch host re-feed
-        self._w_dev = jax.device_put(jnp.asarray(w_eff))
-        self._b_dev = jax.device_put(jnp.asarray(b))
+        # params for this generation; no per-dispatch host re-feed.
+        # With a multi-chip mesh the weights row-shard on the contraction
+        # dim (each chip holds D/n rows; the jitted matmul closes with a
+        # psum and the logits come back replicated, so the donated
+        # buffers keep their single-buffer aval and aliasing). A D that
+        # doesn't divide the axis falls back to replicated placement
+        # (``mesh_fallback`` — the service counts it).
+        self._mesh = None
+        self._x_sharding = None
+        self.mesh_fallback = False
+        if mesh is not None and int(np.prod(mesh.devices.shape)) > 1:
+            from pio_tpu.parallel.compat import NamedSharding
+            from pio_tpu.parallel.compat import PartitionSpec as P
+            from pio_tpu.parallel.partition import assert_device_budget
+
+            axis = (
+                "data" if "data" in mesh.axis_names else mesh.axis_names[0]
+            )
+            if self.in_dim % int(mesh.shape[axis]) == 0:
+                n_dev = int(np.prod(mesh.devices.shape))
+                assert_device_budget(
+                    w_eff.nbytes + b.nbytes, n_dev,
+                    f"resident scorer {name!r} mesh placement",
+                )
+                self._mesh = mesh
+                self._w_dev = jax.device_put(
+                    jnp.asarray(w_eff), NamedSharding(mesh, P(axis, None))
+                )
+                self._x_sharding = NamedSharding(mesh, P())
+                self._b_dev = jax.device_put(
+                    jnp.asarray(b), self._x_sharding
+                )
+            else:
+                self.mesh_fallback = True
+        if self._mesh is None:
+            from pio_tpu.parallel.partition import assert_device_budget
+
+            assert_device_budget(
+                w_eff.nbytes + b.nbytes, 1,
+                f"resident scorer {name!r} placement",
+            )
+            self._w_dev = jax.device_put(jnp.asarray(w_eff))
+            self._b_dev = jax.device_put(jnp.asarray(b))
         self.placed_bytes = int(w_eff.nbytes + b.nbytes)
         #: per-bucket donated logits buffers, keyed by batch size; the
         #: value cycles: donated into the dispatch, replaced by the
@@ -251,9 +292,12 @@ class ResidentLinearScorer:
         with self._lock:
             for b in buckets:
                 if b not in self._out_bufs:
-                    self._out_bufs[b] = DonatedBuffer(jax.device_put(
-                        jnp.zeros((int(b), self.n_classes), jnp.float32)
-                    ))
+                    z = jnp.zeros((int(b), self.n_classes), jnp.float32)
+                    self._out_bufs[b] = DonatedBuffer(
+                        jax.device_put(z, self._x_sharding)
+                        if self._x_sharding is not None
+                        else jax.device_put(z)
+                    )
 
     def retire(self) -> None:
         """Hot-swap eviction: drop the device params and refuse further
@@ -311,7 +355,11 @@ class ResidentLinearScorer:
             )
         n = wire.shape[0]
         failpoint("scorer.h2d.ship")
-        x_dev = jax.device_put(wire)
+        x_dev = (
+            jax.device_put(wire, self._x_sharding)
+            if self._x_sharding is not None
+            else jax.device_put(wire)
+        )
         nbytes = int(wire.nbytes)
         self.h2d_bytes += nbytes
         if self._on_h2d is not None:
@@ -330,9 +378,12 @@ class ResidentLinearScorer:
         if guard is None:
             import jax.numpy as jnp
 
-            guard = DonatedBuffer(jax.device_put(
-                jnp.zeros((n, self.n_classes), jnp.float32)
-            ))
+            z = jnp.zeros((n, self.n_classes), jnp.float32)
+            guard = DonatedBuffer(
+                jax.device_put(z, self._x_sharding)
+                if self._x_sharding is not None
+                else jax.device_put(z)
+            )
         raw = guard.take()
         new_logits, codes = _scorer_fn()(raw, x_dev, self._w_dev, self._b_dev)
         # the old buffer object is dead either way; count the backends
@@ -363,6 +414,7 @@ class ResidentLinearScorer:
             "inDim": self.in_dim,
             "nClasses": self.n_classes,
             "paramBytes": self.placed_bytes,
+            "sharded": self._mesh is not None,
             "retired": self.retired,
             "dispatches": self.dispatches,
             "h2dBytes": self.h2d_bytes,

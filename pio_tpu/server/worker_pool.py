@@ -17,6 +17,14 @@ own the device scorer (``device_worker=True``) when the pool runs on the
 TPU VM itself. Non-owner workers pin JAX to CPU before anything imports
 it, so they can never grab the chip.
 
+``mesh_worker=True`` is the multi-chip variant of the same ownership
+model: worker 0 owns the WHOLE mesh and serves with mesh-sharded factor
+tables (``PIO_TPU_MESH_SERVE=1``; partition rules in
+``pio_tpu/parallel/partition.py``), so one serving host can hold a model
+that exceeds a single chip's memory budget. Siblings stay host-mirror
+scorers and route large batches to worker 0 through the batch lane,
+exactly as with ``device_worker``.
+
 Pool semantics (shared ``multiprocessing`` primitives, spawn context):
 
 - **/reload** on any worker bumps a shared generation counter after
@@ -80,7 +88,14 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt,
                  health_ports=None, lane_doorbell=None,
                  lane_resp_events=None) -> None:
     """Entry point of one pool worker (spawned process)."""
-    if not (spec["device_worker"] and idx == 0):
+    owns_device = (
+        (spec["device_worker"] or spec.get("mesh_worker")) and idx == 0
+    )
+    if owns_device and spec.get("mesh_worker"):
+        # the mesh owner serves sharded: partition-rule placement over
+        # every local device instead of a single-chip upload
+        os.environ["PIO_TPU_MESH_SERVE"] = "1"
+    if not owns_device:
         # host-mirror scoring only; pin JAX to CPU before ANY import can
         # initialize the TPU runtime (single-owner constraint)
         os.environ["PIO_TPU_SERVE_DEVICE"] = "host"
@@ -183,6 +198,7 @@ class ServingPool:
         feedback_app_id: Optional[int] = None,
         admin_key: Optional[str] = None,
         device_worker: bool = False,
+        mesh_worker: bool = False,
         slos: Optional[list] = None,
         qos: Optional[str] = None,
     ):
@@ -220,6 +236,7 @@ class ServingPool:
             "feedback_app_id": feedback_app_id,
             "admin_key": admin_key,
             "device_worker": device_worker,
+            "mesh_worker": mesh_worker,
             "slos": list(slos) if slos else None,
             # QoS spec string: every worker parses the same policy, and
             # because each runs identical service-init code, their QoS
@@ -297,7 +314,7 @@ class ServingPool:
         self._lane_doorbell = None
         self._lane_resp_events = None
         if (
-            device_worker and n_workers > 1
+            (device_worker or mesh_worker) and n_workers > 1
             and os.environ.get("PIO_TPU_BATCH_LANE", "1") != "0"
         ):
             try:

@@ -351,6 +351,7 @@ def _linear_resident(algo_name, model, weights, bias, scales):
         bias=bias,
         scales=scales,
         name=algo_name,
+        mesh=getattr(model, "_serve_mesh", None),
         query_factory=lambda x: Query(
             attrs=tuple(float(v) for v in np.asarray(x).reshape(-1))
         ),

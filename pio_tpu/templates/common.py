@@ -57,7 +57,10 @@ class DeviceScorerModel:
                     from pio_tpu.ops.topn import DeviceTopNScorer
 
                     rows, cols = self._scorer_factors()
-                    s = DeviceTopNScorer(rows, cols, warmup=warmup)
+                    s = DeviceTopNScorer(
+                        rows, cols, warmup=warmup,
+                        mesh=self.__dict__.get("_serve_mesh"),
+                    )
                     self.__dict__["_scorer"] = s
         return s
 
@@ -66,6 +69,7 @@ class DeviceScorerModel:
         d = dict(self.__dict__)
         d.pop("_scorer", None)
         d.pop("_scorer_lock", None)
+        d.pop("_serve_mesh", None)
         return d
 
 

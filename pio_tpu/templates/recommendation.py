@@ -52,6 +52,7 @@ from pio_tpu.templates.common import (
     seen_exclusion_holdout,
     resolve_app,
 )
+from pio_tpu.workflow.shard_store import ShardableModel
 
 
 # --------------------------------------------------------------- data source
@@ -245,13 +246,30 @@ class ALSAlgorithmParams(Params):
 
 
 @dataclasses.dataclass
-class ALSModel(DeviceScorerModel):
+class ALSModel(DeviceScorerModel, ShardableModel):
     factors: ALSFactors
     user_index: BiMap
     item_index: BiMap
 
+    shard_template = "als"
+
     def _scorer_factors(self):
         return self.factors.user_factors, self.factors.item_factors
+
+    def shard_arrays(self):
+        return {
+            "user_factors": self.factors.user_factors,
+            "item_factors": self.factors.item_factors,
+        }
+
+    def replace_shard_arrays(self, arrays):
+        return dataclasses.replace(
+            self,
+            factors=ALSFactors(
+                user_factors=arrays["user_factors"],
+                item_factors=arrays["item_factors"],
+            ),
+        )
 
 
 class ALSAlgorithm(Algorithm):

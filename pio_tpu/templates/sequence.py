@@ -51,6 +51,7 @@ from pio_tpu.templates.common import (
     fold_assignments,
     resolve_app,
 )
+from pio_tpu.workflow.shard_store import ShardableModel
 
 
 # --------------------------------------------------------------- data source
@@ -203,11 +204,37 @@ class SeqRecParams(Params):
 
 
 @dataclasses.dataclass
-class SeqRecEngineModel:
+class SeqRecEngineModel(ShardableModel):
     model: SeqRecModel
     item_index: BiMap
     #: training-time histories for user-id queries
     user_histories: Dict[str, List[int]]
+
+    shard_template = "seqrec"
+
+    def shard_arrays(self):
+        # flatten the layer-stacked params pytree with the same "/"
+        # paths the partition rules match against
+        out = {}
+        for k, v in self.model.params.items():
+            if isinstance(v, dict):
+                for k2, v2 in v.items():
+                    out[f"{k}/{k2}"] = v2
+            else:
+                out[k] = v
+        return out
+
+    def replace_shard_arrays(self, arrays):
+        params: Dict = {}
+        for name, arr in arrays.items():
+            if "/" in name:
+                outer, inner = name.split("/", 1)
+                params.setdefault(outer, {})[inner] = arr
+            else:
+                params[name] = arr
+        return dataclasses.replace(
+            self, model=dataclasses.replace(self.model, params=params)
+        )
 
 
 class SeqRecAlgorithm(Algorithm):

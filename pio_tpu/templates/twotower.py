@@ -43,6 +43,7 @@ from pio_tpu.models.two_tower import (
 from pio_tpu.parallel.context import ComputeContext
 from pio_tpu.parallel.mesh import MeshSpec, build_mesh
 from pio_tpu.templates.common import DeviceScorerModel, PredictedResult
+from pio_tpu.workflow.shard_store import ShardableModel
 from pio_tpu.templates.recommendation import (
     PreparedData,
     Query,
@@ -68,13 +69,31 @@ class TwoTowerParams(Params):
 
 
 @dataclasses.dataclass
-class TwoTowerEngineModel(DeviceScorerModel):
+class TwoTowerEngineModel(DeviceScorerModel, ShardableModel):
     model: TwoTowerModel
     user_index: BiMap
     item_index: BiMap
 
+    shard_template = "two_tower"
+
     def _scorer_factors(self):
         return self.model.user_vectors, self.model.item_vectors
+
+    def shard_arrays(self):
+        return {
+            "user_vectors": self.model.user_vectors,
+            "item_vectors": self.model.item_vectors,
+        }
+
+    def replace_shard_arrays(self, arrays):
+        return dataclasses.replace(
+            self,
+            model=dataclasses.replace(
+                self.model,
+                user_vectors=arrays["user_vectors"],
+                item_vectors=arrays["item_vectors"],
+            ),
+        )
 
 
 class TwoTowerAlgorithm(Algorithm):

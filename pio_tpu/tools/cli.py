@@ -387,6 +387,7 @@ def cmd_deploy(args) -> int:
             feedback_app_id=feedback_app_id,
             admin_key=args.admin_key,
             device_worker=args.device_worker,
+            mesh_worker=getattr(args, "mesh_worker", False),
             slos=slos,
             qos=qos,
         )
@@ -815,6 +816,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--device-worker", action="store_true",
         help="with --workers>1: let worker 0 own the accelerator scorer "
              "(libtpu single-owner); others stay on the host mirror",
+    )
+    a.add_argument(
+        "--mesh-worker", action="store_true",
+        help="with --workers>1: let worker 0 own the WHOLE device mesh "
+             "and serve mesh-sharded factor tables (PIO_TPU_MESH_SERVE; "
+             "for models exceeding one chip's memory budget)",
     )
     a.add_argument(
         "--profile-dir", default="",

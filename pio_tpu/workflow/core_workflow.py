@@ -44,6 +44,7 @@ from pio_tpu.storage import (
     Storage,
 )
 from pio_tpu.obs import slog
+from pio_tpu.workflow import shard_store
 from pio_tpu.workflow.engine_json import EngineVariant
 from pio_tpu.workflow.params import WorkflowParams
 
@@ -233,8 +234,25 @@ def run_train(
                     None if ext else m
                     for ext, m in zip(persisted_externally, models)
                 ]
-                blob = serialize_models(blob_models)
                 models_store = Storage.get_model_data_models()
+                if shard_store.sharded_persist_enabled():
+                    # ShardableModel arrays go out as per-shard records +
+                    # a shard manifest (written BEFORE the blob: a torn
+                    # persist leaves a blob-less shard set, never a blob
+                    # naming missing shards); the blob keeps placeholders
+                    mesh_shape = (
+                        [int(s) for s in ctx.mesh.devices.shape]
+                        if ctx.mesh is not None
+                        else [1]
+                    )
+                    blob_models = shard_store.save_sharded(
+                        models_store,
+                        instance_id,
+                        blob_models,
+                        n_shards=ctx.num_devices,
+                        mesh_shape=mesh_shape,
+                    )
+                blob = serialize_models(blob_models)
                 models_store.insert(Model(id=instance_id, models=blob))
                 manifest = _json.dumps(
                     {
@@ -272,13 +290,18 @@ def run_train(
         raise
 
 
-def _verified_blob_models(models_store, instance_id: str) -> List[Any]:
+def _verified_blob_models(
+    models_store, instance_id: str, ctx: Optional[ComputeContext] = None
+) -> List[Any]:
     """Fetch + checksum-verify + deserialize one instance's model blob.
 
     Raises RuntimeError on a missing record, a checksum mismatch against
     the instance's manifest, or a blob that fails to unpickle. A missing
     manifest (pre-manifest instance, or crash between blob and manifest
-    writes) loads unverified.
+    writes) loads unverified. Shard-stripped models (sharded persist)
+    reassemble from checksum-verified shard records; a missing/torn
+    shard set raises like a torn blob, so the same last-known-good
+    fallback applies.
     """
     record = models_store.get(instance_id)
     if record is None:
@@ -298,12 +321,18 @@ def _verified_blob_models(models_store, instance_id: str) -> List[Any]:
                 f"verification (manifest {want}, blob {got})"
             )
     try:
-        return deserialize_models(record.models)
+        models = deserialize_models(record.models)
     except Exception as e:
         raise RuntimeError(
             f"model blob for instance {instance_id!r} failed to "
             f"deserialize: {e}"
         ) from e
+    return shard_store.restore_sharded(
+        models_store,
+        instance_id,
+        models,
+        n_devices=ctx.num_devices if ctx is not None else None,
+    )
 
 
 def load_models_for_instance(
@@ -323,7 +352,7 @@ def load_models_for_instance(
     """
     models_store = Storage.get_model_data_models()
     try:
-        blob_models = _verified_blob_models(models_store, instance_id)
+        blob_models = _verified_blob_models(models_store, instance_id, ctx)
     except RuntimeError as primary_err:
         if variant is None:
             raise
@@ -341,7 +370,9 @@ def load_models_for_instance(
             if cand.id == instance_id:
                 continue
             try:
-                blob_models = _verified_blob_models(models_store, cand.id)
+                blob_models = _verified_blob_models(
+                    models_store, cand.id, ctx
+                )
             except RuntimeError as e:
                 log.warning("fallback candidate %s also bad: %s", cand.id, e)
                 continue

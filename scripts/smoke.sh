@@ -837,4 +837,168 @@ finally:
 PY
 echo "ok   device-resident serving: int8 wire thin, retraces flat, donations hit"
 
+# --------------------------------------------- mesh-sharded serving
+# ISSUE 10: the shard.* failpoints must be dump-visible, then a
+# recommendation server on a simulated 8-device mesh with
+# PIO_TPU_MESH_SERVE=1 (and sharded persistence on) must report a
+# populated /stats.json "sharding" block, answer a steady window with
+# the retrace counter flat, and agree with the host-scored reference.
+python -m pio_tpu.tools.cli lint --dump-failpoints pio_tpu | python -c '
+import json, sys
+inv = {f["point"] for f in json.load(sys.stdin)["failpoints"]}
+need = {"shard.place", "shard.reshard"}
+missing = need - inv
+assert not missing, f"shard failpoints missing from inventory: {missing}"
+' || fail "shard.place/shard.reshard failpoints missing from --dump-failpoints"
+echo "ok   shard.place/shard.reshard failpoints in lint inventory"
+
+python - <<'PY' || fail "mesh-sharded stage (sharding block/retrace/parity assertions)"
+"""Smoke stage: mesh-sharded serving via the partition-rule registry.
+
+Trains ALS with sharded persistence on, serves it over a simulated
+8-device CPU mesh with PIO_TPU_MESH_SERVE=1, and asserts from the
+outside: the /stats.json sharding block names the mesh and the placed
+model, rankings match the host-scored reference exactly, and the bucket
+retrace counter stays flat across the steady-state window.
+"""
+import datetime as dt
+import json
+import os
+import urllib.request
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "MEM"
+os.environ["PIO_STORAGE_SOURCES_MEM_TYPE"] = "memory"
+os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "MEM"
+os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "MEM"
+os.environ["PIO_TPU_SHARDED_PERSIST"] = "1"
+os.environ["PIO_TPU_MESH_SERVE"] = "1"
+os.environ["PIO_TPU_BUCKET_WARMUP"] = "1"
+os.environ["PIO_TPU_BATCH_BUCKETS"] = "1,2,4"
+
+import pio_tpu.templates  # noqa: F401  (registers the factory)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.server import create_query_server
+from pio_tpu.storage import App, Storage
+from pio_tpu.templates.recommendation import Query
+from pio_tpu.workflow import (
+    build_engine, load_models_for_instance, run_train, variant_from_dict,
+)
+
+app_id = Storage.get_meta_data_apps().insert(App(0, "smoke-shard"))
+le = Storage.get_levents()
+t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+for u in range(12):
+    for i in range(8):
+        in_block = (u < 6) == (i < 4)
+        le.insert(
+            Event("rate", "user", f"u{u}", "item", f"i{i}",
+                  properties={"rating": 5.0 if in_block else 1.0},
+                  event_time=t0 + dt.timedelta(minutes=u * 60 + i)),
+            app_id,
+        )
+variant = variant_from_dict({
+    "id": "smoke-sharded",
+    "engineFactory": "templates.recommendation",
+    "datasource": {"params": {"app_name": "smoke-shard"}},
+    "algorithms": [{"name": "als", "params": {
+        "rank": 6, "num_iterations": 8, "lambda_": 0.05, "seed": 1}}],
+})
+engine, ep = build_engine(variant)
+ctx = ComputeContext.create(seed=0)
+n_dev = ctx.num_devices
+assert n_dev == 8, f"expected the simulated 8-device mesh, got {n_dev}"
+iid = run_train(engine, ep, variant, ctx=ctx)
+
+# the sharded-persist artifacts must actually exist (blob is stripped)
+ms = Storage.get_model_data_models()
+assert ms.get(iid + ".shards") is not None, "shard manifest missing"
+
+# headline constraint: a per-device budget the WHOLE model does not fit
+# in (480 B of factors, 64 B/chip budget) — serving must only be
+# possible sharded over the mesh
+from pio_tpu.ops.topn import DeviceTopNScorer
+from pio_tpu.parallel.partition import DeviceBudgetExceeded
+
+os.environ["PIO_TPU_DEVICE_BUDGET_BYTES"] = "64"
+probe = load_models_for_instance(iid, engine, ep, ctx)[0]
+rows, cols = probe.factors.user_factors, probe.factors.item_factors
+assert rows.nbytes + cols.nbytes > 64, "model unexpectedly fits one chip"
+try:
+    DeviceTopNScorer(rows, cols, prefer_device=True)
+except DeviceBudgetExceeded:
+    pass
+else:
+    raise AssertionError("single-chip placement ignored the budget")
+
+# host-scored reference: the same instance through the direct predict
+# path on host numpy — pin host mode so warmup never attempts a
+# single-chip placement (the 64 B budget is still in force)
+models = load_models_for_instance(iid, engine, ep, ctx)
+serving = engine.make_serving(ep)
+os.environ["PIO_TPU_SERVE_DEVICE"] = "host"
+pairs = engine.algorithms_with_models(ep, models)
+os.environ.pop("PIO_TPU_SERVE_DEVICE", None)
+def host_ref(user, num):
+    q = Query(user=user, num=num)
+    preds = [algo.predict(m, q) for algo, m in pairs]
+    return [s.item for s in serving.serve(q, preds).item_scores]
+
+server, _service = create_query_server(
+    variant, host="127.0.0.1", port=0, ctx=ctx
+)
+server.start()
+try:
+    base = f"http://127.0.0.1:{server.port}"
+
+    def post(body):
+        req = urllib.request.Request(
+            base + "/queries.json",
+            data=json.dumps(body).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return r.read().decode("utf-8")
+
+    def counter(text, name):
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith(name + "{") or line.startswith(name + " "):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    sh = json.loads(get("/stats.json"))["sharding"]
+    assert sh["enabled"] and sh["meshDevices"] == 8, sh
+    assert sh["models"] and sh["models"][0]["nDevices"] == 8, sh
+    placed = counter(get("/metrics"), "pio_tpu_shard_bytes_placed_total")
+    assert placed == sh["models"][0]["totalBytes"], (placed, sh)
+
+    got = post({"user": "u0", "num": 4})  # warm route
+    assert [s["item"] for s in got["itemScores"]] == host_ref("u0", 4), got
+    m0 = get("/metrics")
+    retr0 = counter(m0, "pio_tpu_bucket_retrace_total")
+    N = 40
+    for q in range(N):
+        user = f"u{q % 12}"
+        got = post({"user": user, "num": 4})
+        assert [s["item"] for s in got["itemScores"]] == host_ref(user, 4), (
+            user, got)
+    retr = counter(get("/metrics"), "pio_tpu_bucket_retrace_total") - retr0
+    assert retr == 0, f"bucket retraces moved by {retr} in steady state"
+    print(f"sharded stage: mesh={sh['models'][0]['meshShape']} "
+          f"placed={int(placed)}B retraces={int(retr)} parity exact over "
+          f"{N} requests")
+finally:
+    server.stop()
+PY
+echo "ok   mesh-sharded serving: sharding block populated, retraces flat, host parity"
+
 echo "smoke OK"
