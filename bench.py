@@ -1842,7 +1842,9 @@ def _bench_partitioned_ingest(scale: float) -> dict:
                         _ACK_SECONDS,
                     )
 
-                    p95 = _ACK_SECONDS._default_cell().quantile(0.95)
+                    # per-partition/per-follower since ISSUE 11; the
+                    # family-wide quantile merges cells bucket-wise
+                    p95 = _ACK_SECONDS.quantile(0.95)
                     if p95 is not None:
                         got["repl_lag_p95_ms"] = round(p95 * 1e3, 3)
                 return got
@@ -2086,6 +2088,210 @@ def emit(full: dict, path: str | None = None,
             os.unlink(tmp)
     print(f"# full result written to {path}", file=sys.stderr)
     return json.dumps(build_summary(full, full_path=path))
+
+
+# ---------------------------------------------------------------------------
+# bench history ledger (ISSUE 11): ``bench.py --history`` appends each
+# run's trajectory fields to BENCH_HISTORY.jsonl and prints a
+# delta-vs-previous-run table (to stderr — stdout stays the one summary
+# line) with a configurable regression threshold. The BENCH_r0x
+# artifacts are point-in-time snapshots; this is the trend line.
+# ---------------------------------------------------------------------------
+
+HISTORY_BASENAME = "BENCH_HISTORY.jsonl"
+DEFAULT_REGRESSION_THRESHOLD = 0.05
+
+#: trajectory fields and their good direction; a move against the
+#: direction by more than the threshold is flagged REGRESSION
+HISTORY_FIELDS = (
+    ("value", "up"),                 # headline examples/sec/chip
+    ("serving_qps", "up"),
+    ("pool_qps", "up"),
+    ("p50_predict_ms", "down"),
+    ("p95_predict_ms", "down"),
+    ("serving_attributed", "up"),    # latency-attribution coverage
+    ("serving_h2d_x", "up"),         # f32/i8 h2d byte ratio (wire win)
+    ("shed_rate", "down"),           # overload stage shed fraction
+)
+
+
+def _git_sha() -> str | None:
+    import subprocess
+
+    try:
+        got = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5.0,
+        )
+        sha = got.stdout.strip()
+        return sha or None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def history_record(full: dict, summary: dict,
+                   git_sha: str | None = None,
+                   timestamp: str | None = None) -> dict:
+    """One BENCH_HISTORY.jsonl row: the trajectory fields only."""
+    if timestamp is None:
+        import datetime as _dt
+
+        timestamp = _dt.datetime.now(_dt.timezone.utc).isoformat(
+            timespec="seconds"
+        )
+    serving = full.get("serving") or {}
+    conc = serving.get("concurrent") or {}
+    overload = serving.get("overload") or {}
+    rec = {
+        "timestamp": timestamp,
+        "git_sha": git_sha if git_sha is not None else _git_sha(),
+        "smoke": _is_smoke_run(),
+        "metric": summary.get("metric"),
+        "value": summary.get("value"),
+        "vs_baseline": summary.get("vs_baseline"),
+        "serving_qps": summary.get("serving_qps"),
+        "pool_qps": summary.get("pool_qps"),
+        "p50_predict_ms": summary.get("p50_predict_ms"),
+        "p95_predict_ms": conc.get("p95_ms"),
+        "serving_attributed": summary.get("serving_attributed"),
+        "serving_h2d_x": summary.get("serving_h2d_x"),
+        "shed_rate": overload.get("shed_rate"),
+        "shed_counts": {
+            "offered": overload.get("offered"),
+            "admitted": overload.get("admitted"),
+            "server_shed": overload.get("server_shed"),
+        },
+    }
+    return rec
+
+
+def append_history(record: dict, path: str) -> None:
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def read_history(path: str) -> list:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    print(f"# skipping malformed history line in {path}",
+                          file=sys.stderr)
+    except OSError:
+        pass
+    return out
+
+
+def history_delta_table(prev: dict, cur: dict,
+                        threshold: float) -> tuple:
+    """``(table_lines, regressed_fields)`` comparing two history rows.
+    A field counts as a regression when it moves AGAINST its good
+    direction by more than ``threshold`` (fractional, e.g. 0.05)."""
+    lines = [
+        f"bench history delta vs {prev.get('git_sha') or '?'} "
+        f"({prev.get('timestamp') or '?'}), threshold "
+        f"{threshold * 100:.1f}%:",
+        f"  {'field':<20} {'prev':>12} {'now':>12} {'delta':>9}",
+    ]
+    regressed = []
+    for field, direction in HISTORY_FIELDS:
+        a, b = prev.get(field), cur.get(field)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+            continue
+        pct = (b - a) / a if a else None
+        if pct is None:
+            tag = ""
+            delta = "n/a"
+        else:
+            delta = f"{pct * 100:+.1f}%"
+            bad = pct < -threshold if direction == "up" else pct > threshold
+            good = pct > threshold if direction == "up" else pct < -threshold
+            tag = "  REGRESSION" if bad else ("  improved" if good else "")
+            if bad:
+                regressed.append(field)
+        lines.append(f"  {field:<20} {a:>12} {b:>12} {delta:>9}{tag}")
+    if len(lines) == 2:
+        lines.append("  (no comparable numeric fields)")
+    return lines, regressed
+
+
+def parse_history_argv(argv: list) -> dict:
+    """``--history [--history-file PATH] [--regression-threshold FRAC]``
+    (also enabled by ``PIO_TPU_BENCH_HISTORY=1`` for env-only drivers).
+    Unknown argv entries are ignored — bench is env-driven otherwise."""
+    opts = {
+        "history": os.environ.get("PIO_TPU_BENCH_HISTORY", "0") == "1",
+        "history_file": os.environ.get("PIO_TPU_BENCH_HISTORY_FILE"),
+        "threshold": DEFAULT_REGRESSION_THRESHOLD,
+    }
+    it = iter(argv)
+    for a in it:
+        if a == "--history":
+            opts["history"] = True
+        elif a == "--history-file":
+            opts["history_file"] = next(it, None)
+        elif a.startswith("--history-file="):
+            opts["history_file"] = a.split("=", 1)[1]
+        elif a == "--regression-threshold":
+            raw = next(it, None)
+            try:
+                opts["threshold"] = float(raw)
+            except (TypeError, ValueError):
+                print(f"# bad --regression-threshold {raw!r}; keeping "
+                      f"{opts['threshold']}", file=sys.stderr)
+        elif a.startswith("--regression-threshold="):
+            raw = a.split("=", 1)[1]
+            try:
+                opts["threshold"] = float(raw)
+            except ValueError:
+                print(f"# bad --regression-threshold {raw!r}; keeping "
+                      f"{opts['threshold']}", file=sys.stderr)
+    return opts
+
+
+def maybe_record_history(full: dict, summary: dict, argv: list) -> None:
+    """Append this run to the ledger and print the delta table (stderr).
+    Best-effort by design: a ledger problem must never cost the summary
+    line. The previous run compared against is the last ledger row with
+    the SAME smoke flag — comparing a smoke run against a full-scale one
+    would flag phantom regressions."""
+    opts = parse_history_argv(argv)
+    if not opts["history"]:
+        return
+    try:
+        path = opts["history_file"] or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), HISTORY_BASENAME
+        )
+        rec = history_record(full, summary)
+        prior = [
+            r for r in read_history(path)
+            if r.get("smoke") == rec.get("smoke")
+        ]
+        append_history(rec, path)
+        print(f"# history appended to {path} "
+              f"({'smoke' if rec['smoke'] else 'full'} run)",
+              file=sys.stderr)
+        if prior:
+            lines, regressed = history_delta_table(
+                prior[-1], rec, opts["threshold"]
+            )
+            for line in lines:
+                print(f"# {line}", file=sys.stderr)
+            if regressed:
+                print(f"# REGRESSION in: {', '.join(regressed)}",
+                      file=sys.stderr)
+        else:
+            print("# no prior comparable run in ledger; baseline row "
+                  "recorded", file=sys.stderr)
+    except Exception as exc:
+        print(f"# bench history failed: {exc}", file=sys.stderr)
 
 
 def main() -> None:
@@ -2366,7 +2572,9 @@ def main() -> None:
         "serving": serving,
         "secondary": secondary,
     }
-    print(emit(out))
+    line = emit(out)
+    maybe_record_history(out, json.loads(line), sys.argv[1:])
+    print(line)
 
 
 if __name__ == "__main__":

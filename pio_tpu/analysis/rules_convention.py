@@ -348,3 +348,74 @@ class SpanNameRule(Rule):
                             f"`stage.substage` convention "
                             f"([a-z0-9_] atoms joined by dots)",
                         )
+
+
+# ---------------------------------------------------------------------------
+# rule: metric catalog drift (fleet/replication families)
+
+#: high-churn metric namespaces whose docs/observability.md rows must
+#: have a live registration (or collector emission) in the source set —
+#: a row surviving a family rename/removal would document a phantom
+_CATALOG_DRIFT_PREFIXES = ("pio_tpu_fleet_", "pio_tpu_repl_")
+
+_CATALOG_ROW_RE = re.compile(r"^\|\s*`(pio_tpu_[a-z0-9_]+)`\s*\|")
+
+
+@register
+class MetricCatalogDriftRule(ProjectRule):
+    id = "metric-catalog-drift"
+    family = "convention"
+    description = (
+        "Every documented pio_tpu_fleet_*/pio_tpu_repl_* catalog row in "
+        "docs/observability.md must correspond to a live registration "
+        "or collector emission in the linted sources (the inverse of "
+        "metric-name: code->doc there, doc->code here)."
+    )
+
+    def check_project(self, modules: List[ModuleInfo],
+                      ctx: LintContext) -> Iterable[Finding]:
+        # only meaningful against the real tree: fixture subsets (the
+        # lint rule tests) and partial runs would see phantom drift
+        if not any(m.module_name == "pio_tpu.obs.fleet" for m in modules):
+            return
+        import os as _os
+
+        doc = _os.path.join(ctx.repo_root, "docs", "observability.md")
+        try:
+            with open(doc, "r", encoding="utf-8") as fh:
+                doc_lines = fh.readlines()
+        except OSError:
+            return
+        emitted = self._emitted_names(modules)
+        for lineno, line in enumerate(doc_lines, 1):
+            mm = _CATALOG_ROW_RE.match(line.strip())
+            if not mm:
+                continue
+            name = mm.group(1)
+            if not name.startswith(_CATALOG_DRIFT_PREFIXES):
+                continue
+            if name not in emitted:
+                yield Finding(
+                    self.id, _os.path.join("docs", "observability.md"),
+                    lineno, 0,
+                    f"catalog row `{name}` has no registration or "
+                    f"emission in the linted sources — remove the row "
+                    f"or restore the family",
+                )
+
+    @staticmethod
+    def _emitted_names(modules: List[ModuleInfo]) -> set:
+        """Metric names the code can actually expose: first args of
+        counter/gauge/histogram registrations plus any pio_tpu_* token
+        inside a string literal (collector-emitted families render
+        their exposition lines from literals)."""
+        out: set = set()
+        for m in modules:
+            for node in ast.walk(m.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                out.update(re.findall(
+                    r"(pio_tpu_[a-z0-9_]+)", node.value
+                ))
+        return out

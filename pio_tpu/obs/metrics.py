@@ -346,6 +346,25 @@ class Histogram(_Metric):
         (self.labels(**labels) if labels
          else self._default_cell()).observe(v, exemplar=exemplar)
 
+    def quantile(self, q: float, pool: bool = False) -> Optional[float]:
+        """Family-wide bucket-interpolated quantile: cells merge
+        bucket-wise first (all cells share this family's edges), so a
+        labeled histogram still answers "p95 across every label set" —
+        bench reads ``pio_tpu_repl_ack_seconds`` this way now that it
+        is per-partition/per-follower."""
+        with self._lock:
+            cells = list(self._cells.values())
+        if not cells:
+            return None
+        merged = _HistogramCell(self.buckets)
+        for cell in cells:
+            buckets, sum_, count = cell._snapshot(pool)
+            for k, c in enumerate(buckets):
+                merged._buckets[k] += c
+            merged._sum += sum_
+            merged._count += count
+        return merged.quantile(q, pool=False)
+
     def samples(self, pool: bool = True) -> List[str]:
         out = []
         for values, cell in list(self._cells.items()):

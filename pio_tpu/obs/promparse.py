@@ -1,19 +1,33 @@
-"""Small Prometheus text-format parser.
+"""Small Prometheus text-format parser (and re-renderer).
 
 Shared by the test suite (round-tripping every ``/metrics`` endpoint),
-``bench.py`` (server-side metric deltas embedded in the bench artifact)
-and the dashboard's serving view. Parses the subset the exposition
-spec defines for text format 0.0.4: ``# HELP``/``# TYPE`` comment lines
-and ``name{labels} value`` samples with escaped label values, plus the
+``bench.py`` (server-side metric deltas embedded in the bench artifact),
+the dashboard's serving view, and the fleet aggregator (ISSUE 11), which
+parses every member's scrape, relabels it with ``pio_tpu_member``, merges
+and re-exposes the union. Parses the subset the exposition spec defines
+for text format 0.0.4: ``# HELP``/``# TYPE`` comment lines and
+``name{labels} value`` samples with escaped label values, plus the
 OpenMetrics-style exemplar suffix our histograms append to bucket lines
 (``... 42 # {trace_id="query-7"} 0.0042``).
+
+Federation helpers:
+
+- ``merge(*scrapes)`` — counters (and histogram series) sum, gauges are
+  last-write-wins, conflicting ``# TYPE`` declarations raise;
+- ``with_labels(pm, member=...)`` — inject a label into every sample;
+- ``render(pm)`` — back to exposition text, round-trip-stable through
+  ``parse_prometheus_text`` (exemplars included).
 """
 
 from __future__ import annotations
 
-from typing import Dict, FrozenSet, Optional, Tuple
+from typing import Dict, FrozenSet, List, Optional, Tuple
 
 LabelSet = FrozenSet[Tuple[str, str]]
+
+#: suffixes that belong to a histogram/summary family rather than being
+#: metric names of their own
+_FAMILY_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
 class ParsedMetrics:
@@ -166,3 +180,153 @@ def parse_prometheus_text(text: str) -> ParsedMetrics:
         if exemplar is not None:
             out.exemplars[(name.strip(), labels)] = exemplar
     return out
+
+
+# ---------------------------------------------------------------------------
+# federation helpers (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def family_base(name: str, types: Dict[str, str]) -> str:
+    """The family a sample line belongs to: ``foo_bucket``/``foo_sum``/
+    ``foo_count`` collapse to ``foo`` when ``foo`` is a declared
+    histogram or summary; every other name is its own family."""
+    for suf in _FAMILY_SUFFIXES:
+        if name.endswith(suf):
+            base = name[: -len(suf)]
+            if types.get(base) in ("histogram", "summary"):
+                return base
+    return name
+
+
+def _merge_mode(name: str, types: Dict[str, str]) -> str:
+    """``sum`` or ``last`` for one sample name under the merged types."""
+    base = family_base(name, types)
+    typ = types.get(base)
+    if typ == "counter":
+        return "sum"
+    if typ in ("histogram", "summary"):
+        # bucket/sum/count series are cumulative -> add; summary
+        # quantile samples are point estimates -> last-write-wins
+        if name != base or typ == "histogram":
+            return "sum"
+        return "last"
+    if typ == "gauge":
+        return "last"
+    # untyped: counter naming discipline says *_total is cumulative
+    return "sum" if name.endswith("_total") else "last"
+
+
+def merge(*scrapes: ParsedMetrics) -> ParsedMetrics:
+    """Merge scrapes into one: counter(-like) series sum, gauges are
+    last-write-wins (later argument wins), histograms add bucket-wise
+    (their ``_bucket``/``_sum``/``_count`` series are all cumulative).
+    Exemplars are last-write-wins per sample. A family declared with
+    two different ``# TYPE``\\ s across scrapes raises ``ValueError`` —
+    silently summing a gauge into a counter would corrupt both."""
+    out = ParsedMetrics()
+    for pm in scrapes:
+        for fam, typ in pm.types.items():
+            prev = out.types.get(fam)
+            if prev is not None and prev != typ:
+                raise ValueError(
+                    f"conflicting TYPE for {fam!r}: {prev!r} vs {typ!r}"
+                )
+            out.types[fam] = typ
+        for fam, h in pm.helps.items():
+            out.helps.setdefault(fam, h)
+    for pm in scrapes:
+        for key, v in pm.samples.items():
+            if _merge_mode(key[0], out.types) == "sum":
+                out.samples[key] = out.samples.get(key, 0.0) + v
+            else:
+                out.samples[key] = v
+        out.exemplars.update(pm.exemplars)
+    return out
+
+
+def with_labels(pm: ParsedMetrics, **labels) -> ParsedMetrics:
+    """A copy of ``pm`` with ``labels`` injected into every sample (the
+    fleet aggregator stamps ``pio_tpu_member="host:port"`` this way).
+    An injected name overrides any same-named label already present."""
+    inj = tuple((k, str(v)) for k, v in labels.items())
+    names = frozenset(k for k, _ in inj)
+
+    def rekey(key):
+        name, ls = key
+        kept = tuple(p for p in ls if p[0] not in names)
+        return name, frozenset(kept + inj)
+
+    out = ParsedMetrics()
+    out.types.update(pm.types)
+    out.helps.update(pm.helps)
+    out.samples = {rekey(k): v for k, v in pm.samples.items()}
+    out.exemplars = {rekey(k): v for k, v in pm.exemplars.items()}
+    return out
+
+
+def _esc_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _sample_sort_key(name: str, ls: LabelSet):
+    """Stable order: name, then labels (with ``le`` compared numerically
+    last so histogram buckets render in ascending edge order)."""
+    d = dict(ls)
+    le = d.pop("le", None)
+    le_v = (
+        0.0 if le is None
+        else float("inf") if le == "+Inf" else float(le)
+    )
+    return name, tuple(sorted(d.items())), le_v
+
+
+def render(pm: ParsedMetrics) -> List[str]:
+    """Exposition lines for ``pm`` — HELP/TYPE once per family, samples
+    grouped under their family, exemplars re-attached. The output parses
+    back to an equal ``ParsedMetrics`` (the round-trip property the unit
+    tests pin down)."""
+    fams: Dict[str, List[Tuple[str, LabelSet]]] = {}
+    for (name, ls) in pm.samples:
+        fams.setdefault(family_base(name, pm.types), []).append((name, ls))
+    # families with only HELP/TYPE and no samples still render their head
+    for fam in list(pm.types) + list(pm.helps):
+        fams.setdefault(fam, [])
+    lines: List[str] = []
+    for fam in sorted(fams):
+        if fam in pm.helps:
+            h = pm.helps[fam].replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP {fam} {h}")
+        if fam in pm.types:
+            lines.append(f"# TYPE {fam} {pm.types[fam]}")
+        for name, ls in sorted(
+            fams[fam], key=lambda p: _sample_sort_key(p[0], p[1])
+        ):
+            if ls:
+                body = ",".join(
+                    f'{k}="{_esc_label(v)}"' for k, v in sorted(ls)
+                )
+                head = f"{name}{{{body}}}"
+            else:
+                head = name
+            line = f"{head} {_fmt_value(pm.samples[(name, ls)])}"
+            ex = pm.exemplars.get((name, ls))
+            if ex is not None:
+                ex_ls, ex_v = ex
+                ex_body = ",".join(
+                    f'{k}="{_esc_label(v)}"' for k, v in sorted(ex_ls)
+                )
+                line += f" # {{{ex_body}}}"
+                if ex_v is not None:
+                    line += f" {_fmt_value(ex_v)}"
+            lines.append(line)
+    return lines

@@ -13,11 +13,25 @@ Reading a pool-wide total sums the slot across stripes. Works with the
 
 Layout (little-endian)::
 
-    0   8s  magic  b"PIOMETR1"
+    0   8s  magic  b"PIOMETR2"
     8   I   n_workers
     12  I   slots_per_worker
     16  16x reserved
-    32  n_workers stripes of slots_per_worker float64 each
+    32  n_workers generation float64 (stripe ownership, see below)
+    32+8*n_workers   n_workers stripes of slots_per_worker float64 each
+
+Stripe generations (ISSUE 11): a respawned worker *adopts* its
+predecessor's stripe (counters keep their totals), which is correct for
+pool-wide sums but invisible to an external aggregator — a counter that
+jumps mid-scrape could be traffic or could be adoption. The supervisor
+owns the generation word: ``set_generation`` to ``1`` at first spawn,
+``bump_generation`` on every respawn, and ``retire`` (negates the
+value) when a worker's respawn budget is spent and its stripe is frozen
+at its last totals. Workers export their stripe's generation as the
+``pio_tpu_pool_stripe_generation`` gauge, so a scraper that sees the
+generation move knows any counter discontinuity is adoption, not load —
+and a negative generation marks a retired stripe whose (retained, still
+summed) totals will never move again.
 
 Torn reads are possible in theory (a reader may catch a stripe between
 two writes of one histogram observe) — acceptable for monitoring: every
@@ -32,7 +46,7 @@ import os
 import struct
 from typing import List
 
-MAGIC = b"PIOMETR1"
+MAGIC = b"PIOMETR2"
 HEADER_BYTES = 32
 #: default stripe width — the query server's pool-bound families
 #: (request/error counters + stage histogram cells + latency histogram
@@ -59,7 +73,7 @@ class PoolMetricsSegment:
                slots_per_worker: int = DEFAULT_SLOTS) -> "PoolMetricsSegment":
         if n_workers < 1 or slots_per_worker < 1:
             raise ValueError("n_workers and slots_per_worker must be >= 1")
-        size = HEADER_BYTES + n_workers * slots_per_worker * 8
+        size = cls._size(n_workers, slots_per_worker)
         with open(path, "wb") as f:
             f.write(MAGIC)
             f.write(struct.pack("<II", n_workers, slots_per_worker))
@@ -74,12 +88,15 @@ class PoolMetricsSegment:
             if len(head) < HEADER_BYTES or head[:8] != MAGIC:
                 raise ValueError(f"{path}: not a pool metrics segment")
             n_workers, slots = struct.unpack_from("<II", head, 8)
-            size = HEADER_BYTES + n_workers * slots * 8
-            m = mmap.mmap(f.fileno(), size)
+            m = mmap.mmap(f.fileno(), cls._size(n_workers, slots))
         except BaseException:
             f.close()
             raise
         return cls(path, n_workers, slots, _file=f, _map=m)
+
+    @staticmethod
+    def _size(n_workers: int, slots_per_worker: int) -> int:
+        return HEADER_BYTES + n_workers * 8 + n_workers * slots_per_worker * 8
 
     def close(self) -> None:
         if self._m is not None:
@@ -96,13 +113,50 @@ class PoolMetricsSegment:
         except OSError:
             pass
 
+    # -- stripe generations ------------------------------------------------
+    def _gen_off(self, worker_idx: int) -> int:
+        if not (0 <= worker_idx < self.n_workers):
+            raise IndexError(f"worker {worker_idx} of {self.n_workers}")
+        return HEADER_BYTES + worker_idx * 8
+
+    def generation(self, worker_idx: int) -> int:
+        """0 = never owned; N>0 = owned, adopted N-1 times; -N = stripe
+        retired at generation N (frozen totals, still summed)."""
+        return int(struct.unpack_from(
+            "<d", self._m, self._gen_off(worker_idx)
+        )[0])
+
+    def set_generation(self, worker_idx: int, gen: int) -> None:
+        struct.pack_into(
+            "<d", self._m, self._gen_off(worker_idx), float(gen)
+        )
+
+    def bump_generation(self, worker_idx: int) -> int:
+        """Supervisor-side: the stripe is about to be adopted by a
+        replacement process. Returns the new generation."""
+        gen = abs(self.generation(worker_idx)) + 1
+        self.set_generation(worker_idx, gen)
+        return gen
+
+    def retire_stripe(self, worker_idx: int) -> int:
+        """Supervisor-side: the worker is permanently retired; negate
+        the generation so scrapers know the stripe's totals are frozen
+        (retained in sums — retirement must not shrink pool counters)."""
+        gen = -abs(self.generation(worker_idx))
+        self.set_generation(worker_idx, gen)
+        return gen
+
+    def generations(self) -> List[int]:
+        return [self.generation(w) for w in range(self.n_workers)]
+
     # -- slots -------------------------------------------------------------
     def _off(self, worker_idx: int, slot: int) -> int:
         if not (0 <= worker_idx < self.n_workers):
             raise IndexError(f"worker {worker_idx} of {self.n_workers}")
         if not (0 <= slot < self.slots_per_worker):
             raise IndexError(f"slot {slot} of {self.slots_per_worker}")
-        return HEADER_BYTES + (worker_idx * self.slots_per_worker + slot) * 8
+        return (HEADER_BYTES + self.n_workers * 8
+                + (worker_idx * self.slots_per_worker + slot) * 8)
 
     def set(self, worker_idx: int, slot: int, v: float) -> None:
         struct.pack_into("<d", self._m, self._off(worker_idx, slot), v)

@@ -14,7 +14,13 @@ Routes:
 - ``GET /serving.html``          — live serving view: pool-wide request
   totals + per-stage latency table scraped from a query server's
   ``/metrics`` (ISSUE 1 observability surface);
-- ``GET /metrics``               — the dashboard's own scrape endpoint.
+- ``GET /fleet.html``            — fleet panel (ISSUE 11): member
+  liveness, replication lag and SLO burn rollup from an embedded
+  :class:`~pio_tpu.obs.fleet.FleetAggregator` (enabled by passing
+  ``fleet_targets`` / setting ``PIO_TPU_FLEET_TARGETS``);
+- ``GET /fleet.json``            — the same aggregator's router contract;
+- ``GET /metrics``               — the dashboard's own scrape endpoint
+  (carries the federated member metrics when the fleet panel is on).
 
 All responses carry ``Access-Control-Allow-Origin: *`` (reference
 ``CorsSupport``).
@@ -58,7 +64,8 @@ def _instance_summary(inst) -> dict:
 class DashboardService:
     """≙ reference ``DashboardService`` routes (+ the serving view)."""
 
-    def __init__(self, query_url: str = "http://127.0.0.1:8000"):
+    def __init__(self, query_url: str = "http://127.0.0.1:8000",
+                 fleet_targets: Optional[str] = None):
         #: base URL of the query server (or any pool worker — in pool
         #: mode every worker's /metrics reports pool-wide totals) whose
         #: serving metrics /serving.html renders
@@ -73,12 +80,30 @@ class DashboardService:
         self.obs.add_collector(slog.exposition_lines)
         self.health = HealthMonitor()
         self.health.add_readiness("storage", self._check_storage_ready)
+        # embedded fleet aggregator (ISSUE 11): the lightweight
+        # alternative to a standalone `pio fleet` daemon — same scrape
+        # loop, federating onto the dashboard's own registry
+        import os as _os
+
+        from pio_tpu.obs.fleet import (
+            TARGETS_ENV, FleetAggregator, parse_targets,
+        )
+
+        spec = (fleet_targets if fleet_targets is not None
+                else _os.environ.get(TARGETS_ENV, ""))
+        targets = parse_targets(spec)
+        self.fleet: Optional[FleetAggregator] = (
+            FleetAggregator(targets, registry=self.obs)
+            if targets else None
+        )
         self.router = Router()
         self.router.add("GET", "/", self.index)
         self.router.add("GET", "/instances\\.json", self.list_json)
         self.router.add("GET", "/instances/([^/]+)\\.json", self.get_json)
         self.router.add("GET", "/instances/([^/]+)\\.html", self.get_html)
         self.router.add("GET", "/serving\\.html", self.serving)
+        self.router.add("GET", "/fleet\\.html", self.fleet_html)
+        self.router.add("GET", "/fleet\\.json", self.fleet_json)
         self.router.add("GET", "/metrics", self.get_metrics)
         self.router.add("GET", "/logs\\.json", self.get_logs)
         self.router.add("GET", "/healthz", self.healthz)
@@ -107,7 +132,8 @@ class DashboardService:
             "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
             "padding:.4em .8em;text-align:left}</style></head><body>"
             "<h1>Evaluation Dashboard</h1>"
-            "<p><a href='/serving.html'>serving metrics</a></p>"
+            "<p><a href='/serving.html'>serving metrics</a> &middot; "
+            "<a href='/fleet.html'>fleet</a></p>"
             "<table><tr><th>Instance</th><th>Evaluation</th><th>Start</th>"
             "<th>End</th><th>Result</th></tr>"
             + "".join(rows)
@@ -339,6 +365,116 @@ class DashboardService:
             "padding:1em;overflow-x:auto'>" + "\n".join(lines) + "</pre>"
         )
 
+    # -- fleet federation (ISSUE 11) ----------------------------------------
+    def fleet_json(self, req: Request) -> Tuple[int, Any]:
+        if self.fleet is None:
+            return 404, {
+                "message": "no fleet configured (set PIO_TPU_FLEET_TARGETS "
+                           "or run `pio fleet --targets ...`)"
+            }
+        return 200, self.fleet.fleet_payload()
+
+    def fleet_html(self, req: Request) -> Tuple[int, Any]:
+        """Fleet panel: member liveness table, partlog replication lag,
+        worst SLO burn per objective, and engine placement — rendered
+        from the embedded aggregator's last scrape pass."""
+        self._pageviews.inc(page="fleet")
+        head = (
+            "<!doctype html><html><head><title>pio-tpu fleet</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse;margin-bottom:1em}"
+            "td,th{border:1px solid #ccc;padding:.4em .8em;"
+            "text-align:left}.up{color:#080}.stale{color:#a60}"
+            ".down{color:#a00}</style></head><body><h1>Fleet</h1>"
+        )
+        if self.fleet is None:
+            return 200, _html_response(
+                head + "<p>no fleet configured — set "
+                "<code>PIO_TPU_FLEET_TARGETS=host:port,...</code> or run "
+                "<code>pio fleet --targets ...</code></p></body></html>"
+            )
+        pay = self.fleet.fleet_payload()
+        f = pay["fleet"]
+        summary = (
+            f"<p>{f['members']} members: "
+            f"<span class='up'>{f['up']} up</span>, "
+            f"<span class='stale'>{f['stale']} stale</span>, "
+            f"<span class='down'>{f['down']} down</span> "
+            f"(scrape every {f['scrapeIntervalSeconds']:.1f}s)</p>"
+        )
+        member_rows = "".join(
+            f"<tr><td>{_html.escape(m['member'])}</td>"
+            f"<td class='{_html.escape(m['status'])}'>"
+            f"{_html.escape(m['status'])}</td>"
+            f"<td>{_html.escape(m['role'])}</td>"
+            f"<td>{'yes' if m['ready'] else 'no' if m['ready'] is False else '?'}</td>"
+            f"<td>{m['scrapeAgeSeconds'] if m['scrapeAgeSeconds'] is not None else 'never'}</td>"
+            f"<td>{m['scrapeErrors']}</td>"
+            f"<td>{_html.escape(m['lastError'] or '-')}</td></tr>"
+            for m in pay["members"]
+        )
+        members = (
+            "<h2>Members</h2><table><tr><th>member</th><th>status</th>"
+            "<th>role</th><th>ready</th><th>scrape age (s)</th>"
+            "<th>errors</th><th>last error</th></tr>"
+            + member_rows + "</table>"
+        )
+        lag_rows = []
+        for leader in pay["partlog"]["leaders"]:
+            for part in leader["partitionDetail"]:
+                for fol in part["followers"]:
+                    lag_rows.append(
+                        f"<tr><td>{_html.escape(str(leader['member']))}</td>"
+                        f"<td>{part['partition']}</td>"
+                        f"<td>{_html.escape(str(fol['follower']))}</td>"
+                        f"<td>{part['committedBytes']}</td>"
+                        f"<td>{fol['ackedBytes'] if fol['ackedBytes'] is not None else 'n/a'}</td>"
+                        f"<td>{fol['lagBytes'] if fol['lagBytes'] is not None else 'n/a'}</td>"
+                        f"<td>{'yes' if fol['connected'] else 'no'}</td></tr>"
+                    )
+        lag = (
+            "<h2>Replication lag</h2>"
+            + ("<table><tr><th>leader</th><th>partition</th>"
+               "<th>follower</th><th>committed</th><th>acked</th>"
+               "<th>lag (bytes)</th><th>connected</th></tr>"
+               + "".join(lag_rows) + "</table>"
+               if lag_rows else "<p>no replicated partlog members</p>")
+        )
+        burn_rows = "".join(
+            f"<tr><td>{_html.escape(name)}</td>"
+            f"<td>{_html.escape(str(w['member']))}</td>"
+            f"<td>{w['burn']}</td>"
+            f"<td>{_html.escape(str(w['window']))}</td>"
+            f"<td>{_html.escape(', '.join(w['firing']) or '-')}</td></tr>"
+            for name, w in sorted(pay["slo"]["worstBurn"].items())
+        )
+        slo = (
+            "<h2>Worst SLO burn per objective</h2>"
+            + ("<table><tr><th>objective</th><th>worst member</th>"
+               "<th>burn</th><th>window</th><th>firing</th></tr>"
+               + burn_rows + "</table>"
+               if burn_rows else "<p>no SLOs reported</p>")
+        )
+        place_rows = "".join(
+            f"<tr><td>{_html.escape(p['member'])}</td>"
+            f"<td>{_html.escape(p['mode'])}</td>"
+            f"<td>{p['paramBytes']}</td>"
+            f"<td>{_html.escape(', '.join(str(sc['name']) for sc in p['scorers']) or '-')}</td></tr>"
+            for p in pay["placement"]
+        )
+        placement = (
+            "<h2>Placement</h2>"
+            + ("<table><tr><th>member</th><th>mode</th>"
+               "<th>param bytes</th><th>scorers</th></tr>"
+               + place_rows + "</table>"
+               if place_rows else "<p>no serving members reporting</p>")
+        )
+        return 200, _html_response(
+            head + summary + members + lag + slo + placement
+            + "<p><a href='/fleet.json'>/fleet.json</a> — the router "
+            "contract</p></body></html>"
+        )
+
     def serving(self, req: Request) -> Tuple[int, Any]:
         """Live serving view: pool-wide request totals + avg QPS since
         deploy and a per-stage latency table, from one scrape of the
@@ -421,7 +557,18 @@ class DashboardService:
 def create_dashboard(
     host: str = "0.0.0.0", port: int = 9000,
     query_url: str = "http://127.0.0.1:8000",
+    fleet_targets: Optional[str] = None,
 ) -> JsonHTTPServer:
-    """Build (unstarted) dashboard — reference ``Dashboard.main``."""
-    service = DashboardService(query_url=query_url)
-    return JsonHTTPServer(service.router, host, port, name="pio-tpu-dashboard")
+    """Build (unstarted) dashboard — reference ``Dashboard.main``. When
+    fleet targets are configured the embedded aggregator's scrape loop
+    starts here (daemon thread; it dies with the process)."""
+    service = DashboardService(
+        query_url=query_url, fleet_targets=fleet_targets
+    )
+    server = JsonHTTPServer(
+        service.router, host, port, name="pio-tpu-dashboard"
+    )
+    server.service = service
+    if service.fleet is not None:
+        service.fleet.start()
+    return server

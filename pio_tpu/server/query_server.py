@@ -93,6 +93,23 @@ QUERY_STAGES = (
 QUERY_SUBSTAGES = ("admit.queue", "execute.device")
 
 
+def _stripe_generation_lines(seg) -> list:
+    """Exposition lines for ``pio_tpu_pool_stripe_generation`` — read
+    fresh from the shared segment at every scrape (the supervisor, a
+    different process, owns the generation words)."""
+    lines = [
+        "# HELP pio_tpu_pool_stripe_generation Pool metrics stripe "
+        "ownership generation per worker slot (bumped at every respawn; "
+        "negative = retired, totals frozen)",
+        "# TYPE pio_tpu_pool_stripe_generation gauge",
+    ]
+    for w, g in enumerate(seg.generations()):
+        lines.append(
+            f'pio_tpu_pool_stripe_generation{{worker="{w}"}} {g}'
+        )
+    return lines
+
+
 def _q_ms(cell, q: float):
     """Histogram-cell quantile in milliseconds (None when empty)."""
     v = cell.quantile(q)
@@ -1206,6 +1223,15 @@ class QueryServerService:
             try:
                 seg = PoolMetricsSegment.open(metrics_path)
                 self.obs.bind_pool_segment(seg, idx)
+                # stripe generation export (ISSUE 11): the supervisor
+                # bumps the segment word at every (re)spawn and negates
+                # it at retirement; re-reading at scrape time lets
+                # aggregators tell stripe adoption (counter
+                # discontinuity) from traffic and spot retired stripes
+                # whose retained totals will never move again
+                self.obs.add_collector(
+                    lambda: _stripe_generation_lines(seg)
+                )
                 if self.qos is not None:
                     # the admitted-counter stripes are live now; forget
                     # pre-bind totals so history doesn't drain the bucket

@@ -343,6 +343,15 @@ class ServingPool:
         self._health_ports[idx] = 0  # stale port from a previous life
         self._health_fails[idx] = 0
         self._spawned_at[idx] = monotonic_s()
+        if getattr(self, "_metrics_seg", None) is not None:
+            # stripe ownership handover (ISSUE 11): first spawn takes the
+            # stripe at generation 1; every respawn bumps it so scrapers
+            # can tell counter adoption from traffic
+            try:
+                self._metrics_seg.bump_generation(idx)
+            except (OSError, ValueError, IndexError):
+                log.exception("stripe generation bump failed (worker %d)",
+                              idx)
         p = self._ctx.Process(
             target=_worker_main,
             args=(
@@ -468,6 +477,16 @@ class ServingPool:
                 i, self._respawns[i][reason], reason,
             )
             self._retired[i] = True
+            if getattr(self, "_metrics_seg", None) is not None:
+                # freeze the stripe: negative generation marks "retired,
+                # totals retained" so pool/fleet scrapes keep the sums
+                # but know they will never move again
+                try:
+                    self._metrics_seg.retire_stripe(i)
+                except (OSError, ValueError, IndexError):
+                    log.exception(
+                        "stripe retirement failed (worker %d)", i
+                    )
             return
         self._respawns[i][reason] = self._respawns[i].get(reason, 0) + 1
         self._respawn_counter.inc(reason=reason)

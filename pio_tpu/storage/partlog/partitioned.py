@@ -502,13 +502,34 @@ class PartitionedEventLog(base.LEvents):
             })
         repl = None
         if self._replicator is not None:
+            committed = {
+                str(p["partition"]): p["committed_bytes"] for p in parts
+            }
+            followers = self._replicator.lag_snapshot()
+            # ISSUE 11: per-follower lag and per-partition min-acked as
+            # first-class fields — the fleet aggregator and its router
+            # read the durable floor straight off /storage.json
+            for f in followers:
+                f["lag"] = {
+                    k: max(committed.get(k, 0) - pos, 0)
+                    for k, pos in (f.get("acked") or {}).items()
+                }
+            min_acked = {}
+            for k in committed:
+                acks = [
+                    (f.get("acked") or {}).get(k)
+                    for f in followers
+                ]
+                acks = [a for a in acks if a is not None]
+                min_acked[k] = min(acks) if acks else None
             repl = {
                 "replicas": [
                     link.label for link in self._replicator._links
                 ],
                 "min_acks": self._replicator.min_acks,
                 "ack_timeout_s": self._replicator.ack_timeout_s,
-                "followers": self._replicator.lag_snapshot(),
+                "followers": followers,
+                "min_acked": min_acked,
             }
         return {
             "backend": "partlog",

@@ -86,6 +86,7 @@ _REPL_LAG = REGISTRY.gauge(
 _ACK_SECONDS = REGISTRY.histogram(
     "pio_tpu_repl_ack_seconds",
     "Send-to-ack round trip of one replication append",
+    ("partition", "follower"),
 )
 
 
@@ -377,7 +378,10 @@ class _FollowerLink:
                         f"replication expected ack for partition {k}, "
                         f"got {ack!r}"
                     )
-                _ACK_SECONDS.observe(monotonic_s() - t0)
+                _ACK_SECONDS.observe(
+                    monotonic_s() - t0,
+                    partition=str(k), follower=self.label,
+                )
                 sent = int(ack["pos"])
                 self.sent[k] = sent
                 _REPL_BYTES.inc(len(chunk), follower=self.label)

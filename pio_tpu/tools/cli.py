@@ -311,13 +311,46 @@ def cmd_dashboard(args) -> int:
     from pio_tpu.server import create_dashboard
 
     server = create_dashboard(
-        host=args.ip, port=args.port, query_url=args.query_url
+        host=args.ip, port=args.port, query_url=args.query_url,
+        fleet_targets=args.fleet_targets,
     )
     _out(f"Dashboard listening on {args.ip}:{server.port}")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         _out("shutting down")
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """Run the fleet telemetry aggregator (ISSUE 11): scrape every
+    ``--targets`` member, serve the federated ``/metrics`` and the
+    ``/fleet.json`` cluster status the router steers by."""
+    import os
+
+    from pio_tpu.obs.fleet import TARGETS_ENV
+    from pio_tpu.server.fleetd import create_fleet_server
+
+    targets = args.targets or os.environ.get(TARGETS_ENV, "")
+    if not targets.strip():
+        _err(
+            "no fleet targets: pass --targets host:port,... or set "
+            f"{TARGETS_ENV}"
+        )
+        return 1
+    server = create_fleet_server(
+        targets, host=args.ip, port=args.port, interval_s=args.interval,
+    )
+    server.service.agg.start()
+    members = ", ".join(m.name for m in server.service.agg.members())
+    _out(f"Fleet aggregator listening on {args.ip}:{server.port} "
+         f"(members: {members})")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("shutting down")
+    finally:
+        server.service.agg.stop()
     return 0
 
 
@@ -899,7 +932,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="query server (or any pool worker) whose /metrics the "
              "/serving.html view scrapes",
     )
+    a.add_argument(
+        "--fleet-targets", default=None, metavar="HOST:PORT,...",
+        help="enable the embedded /fleet.html panel scraping these "
+             "members (default: PIO_TPU_FLEET_TARGETS)",
+    )
     a.set_defaults(fn=cmd_dashboard)
+
+    a = sub.add_parser(
+        "fleet", help="run the fleet telemetry aggregator"
+    )
+    a.add_argument("--ip", default="0.0.0.0")
+    a.add_argument("--port", type=int, default=7000)
+    a.add_argument(
+        "--targets", default=None, metavar="HOST:PORT,...",
+        help="comma list of member servers to scrape (falls back to "
+             "PIO_TPU_FLEET_TARGETS)",
+    )
+    a.add_argument(
+        "--interval", type=float, default=None, metavar="SECONDS",
+        help="scrape interval (default 5s, jittered; also "
+             "PIO_TPU_FLEET_INTERVAL_S)",
+    )
+    a.set_defaults(fn=cmd_fleet)
 
     a = sub.add_parser("adminserver", help="run the admin REST API")
     a.add_argument("--ip", default="0.0.0.0")

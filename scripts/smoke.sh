@@ -1001,4 +1001,255 @@ finally:
 PY
 echo "ok   mesh-sharded serving: sharding block populated, retraces flat, host parity"
 
+# -------------------------------------------------- fleet federation
+# ISSUE 11: the fleet telemetry plane. Three live members — a
+# replicated-partlog event leader (subprocess), its follower's status
+# sidecar, and a dashboard — federate into one fleetd whose
+# /fleet.json must report them all up with non-null replication lag;
+# killing the follower must flip it to down within two scrape
+# intervals while the federated counters keep the last-seen snapshot
+# in the sums.
+FLEET_STAGE="$WORKDIR/fleet_stage.py"
+cat > "$FLEET_STAGE" <<'PY'
+"""Smoke stage: cross-host metric federation + cluster status."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+WORKDIR = sys.argv[1]
+
+from pio_tpu.server.dashboard import create_dashboard
+from pio_tpu.server.fleetd import (
+    create_fleet_server, create_follower_status_server,
+)
+from pio_tpu.storage.partlog.replication import FollowerServer
+
+froot = os.path.join(WORKDIR, "fleet-follower")
+follower = FollowerServer(froot)
+
+leader_root = os.path.join(WORKDIR, "fleet-leader")
+port_file = os.path.join(WORKDIR, "fleet-port")
+info_file = os.path.join(WORKDIR, "fleet-info")
+
+LEADER_SRC = r'''
+import json, os, signal, sys
+from pio_tpu.server import create_event_server
+from pio_tpu.storage import AccessKey, App, Storage
+
+app_id = Storage.get_meta_data_apps().insert(App(0, "fleet"))
+key = Storage.get_meta_data_access_keys().insert(AccessKey("", app_id))
+server = create_event_server(host="127.0.0.1", port=0).start()
+info_file, port_file = sys.argv[1], sys.argv[2]
+with open(info_file, "w") as f:
+    json.dump({"key": key}, f)
+with open(port_file + ".tmp", "w") as f:
+    f.write(str(server.port))
+os.rename(port_file + ".tmp", port_file)
+signal.sigwait({signal.SIGTERM, signal.SIGINT})
+server.stop()
+'''
+
+env = dict(os.environ)
+env.update({
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PL",
+    "PIO_STORAGE_SOURCES_PL_TYPE": "partlog",
+    "PIO_STORAGE_SOURCES_PL_PATH": leader_root,
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    "PIO_TPU_PARTLOG_PARTITIONS": "2",
+    "PIO_TPU_PARTLOG_REPLICAS": f"127.0.0.1:{follower.port}",
+    # batch durability: the follower mirrors asynchronously, so the
+    # leader keeps acking (and counters keep summing) after we kill it
+    "PIO_TPU_DURABILITY": "batch",
+})
+proc = subprocess.Popen(
+    [sys.executable, "-c", LEADER_SRC, info_file, port_file], env=env)
+
+servers = []
+
+
+def cleanup():
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait()
+    try:
+        follower.stop()
+    except Exception:
+        pass
+
+
+try:
+    deadline = time.time() + 60
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            raise SystemExit("event leader died during boot")
+        if time.time() > deadline:
+            raise SystemExit("event leader never published its port")
+        time.sleep(0.2)
+    with open(port_file) as f:
+        leader = "127.0.0.1:" + f.read().strip()
+    with open(info_file) as f:
+        key = json.load(f)["key"]
+
+    sidecar = create_follower_status_server(
+        follower, host="127.0.0.1", port=0).start()
+    servers.append(sidecar)
+    dash = create_dashboard(host="127.0.0.1", port=0)
+    dash.start()
+    servers.append(dash)
+
+    def post(n):
+        for i in range(n):
+            body = json.dumps({
+                "event": "fleet", "entityType": "user",
+                "entityId": f"u{i}", "properties": {"seq": i},
+                "eventTime": "2026-03-01T10:00:00Z",
+            }).encode("utf-8")
+            req = urllib.request.Request(
+                f"http://{leader}/events.json?accessKey=" + key,
+                data=body, headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=15) as r:
+                assert r.status == 201, r.status
+
+    post(8)
+    # async replication: wait until the follower acked every committed
+    # byte so the lag the fleet reports is concrete (and zero)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        with urllib.request.urlopen(
+                f"http://{leader}/storage.json", timeout=10) as r:
+            topo = json.loads(r.read().decode("utf-8"))
+        committed = {str(p["partition"]): p["committed_bytes"]
+                     for p in topo["partition_detail"]}
+        acked = (topo["replication"] or {}).get("min_acked") or {}
+        if sum(committed.values()) > 0 and all(
+                acked.get(k) == v for k, v in committed.items()):
+            break
+        time.sleep(0.1)
+    else:
+        raise SystemExit(f"follower never caught up: {topo}")
+
+    members = ",".join([
+        leader,
+        f"127.0.0.1:{sidecar.port}",
+        f"127.0.0.1:{dash.port}",
+    ])
+    fleetd = create_fleet_server(members, host="127.0.0.1", port=0,
+                                 interval_s=0.3)
+    fleetd.start()
+    servers.append(fleetd)
+    agg = fleetd.service.agg
+    furl = f"http://127.0.0.1:{fleetd.port}"
+
+    def get(url, path):
+        with urllib.request.urlopen(url + path, timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+
+    # readiness gates on the first full scrape pass
+    try:
+        status, _ = get(furl, "/readyz")
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 503, f"fleetd ready before any scrape ({status})"
+    agg.start()
+    deadline = time.time() + 30
+    while agg.passes < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert get(furl, "/readyz")[0] == 200, "fleetd never became ready"
+
+    pay = json.loads(get(furl, "/fleet.json")[1])
+    assert pay["fleet"]["members"] == 3, pay["fleet"]
+    assert pay["fleet"]["up"] == 3, pay["fleet"]
+    roles = {m["member"]: m["role"] for m in pay["members"]}
+    assert roles[leader] == "leader", roles
+    assert roles[f"127.0.0.1:{sidecar.port}"] == "follower", roles
+
+    # replication lag is concrete numbers, not nulls
+    lead = pay["partlog"]["leaders"][0]
+    assert len(lead["partitionDetail"]) == 2, lead
+    total_committed = 0
+    for p in lead["partitionDetail"]:
+        total_committed += p["committedBytes"]
+        fol = p["followers"][0]
+        assert fol["ackedBytes"] is not None, p
+        assert fol["lagBytes"] is not None, p
+    assert total_committed > 0, lead
+
+    # federated /metrics: every member's families, member-labeled, and
+    # counter sums matching the leader's own scrape
+    fed = get(furl, "/metrics")[1]
+    for needle in (
+        f'pio_tpu_events_ingested_total{{', f'pio_tpu_member="{leader}"',
+        f'pio_tpu_repl_follower_position_bytes{{partition="0",'
+        f'pio_tpu_member="127.0.0.1:{sidecar.port}"}}',
+        f'pio_tpu_fleet_member_up{{member="{leader}"}} 1',
+    ):
+        assert needle in fed, f"federated scrape missing {needle!r}"
+    own = get(f"http://{leader}", "/metrics")[1]
+    own_ingested = sum(
+        float(line.rsplit(" ", 1)[1])
+        for line in own.splitlines()
+        if line.startswith("pio_tpu_events_ingested_total{"))
+    fed_ingested = sum(
+        float(line.rsplit(" ", 1)[1])
+        for line in fed.splitlines()
+        if line.startswith("pio_tpu_events_ingested_total{")
+        and f'pio_tpu_member="{leader}"' in line)
+    assert fed_ingested == own_ingested >= 8, (fed_ingested, own_ingested)
+
+    # SIGKILL the follower's surfaces: down within two scrape
+    # intervals, last-seen snapshot retained in the federation
+    agg.stale_after_s = 0.3
+    agg.down_after_s = 0.6  # = two scrape intervals
+    sidecar.stop()
+    servers.remove(sidecar)
+    follower.stop()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        pay = json.loads(get(furl, "/fleet.json")[1])
+        by = {m["member"]: m["status"] for m in pay["members"]}
+        if by[f"127.0.0.1:{sidecar.port}"] == "down":
+            break
+        time.sleep(0.1)
+    else:
+        raise SystemExit(f"follower never marked down: {by}")
+    assert by[leader] == "up", by
+
+    post(4)  # live members keep counting while one is dark
+    time.sleep(1.0)  # > one scrape interval
+    fed2 = get(furl, "/metrics")[1]
+    assert (f'pio_tpu_fleet_member_up'
+            f'{{member="127.0.0.1:{sidecar.port}"}} 0') in fed2, "up!=0"
+    assert (f'pio_tpu_repl_follower_position_bytes{{partition="0",'
+            f'pio_tpu_member="127.0.0.1:{sidecar.port}"}}') in fed2, (
+        "dead member's snapshot vanished from the federation")
+    fed2_ingested = sum(
+        float(line.rsplit(" ", 1)[1])
+        for line in fed2.splitlines()
+        if line.startswith("pio_tpu_events_ingested_total{")
+        and f'pio_tpu_member="{leader}"' in line)
+    assert fed2_ingested >= own_ingested + 4, (fed2_ingested, own_ingested)
+
+    print(f"fleet stage: 3 members federated, "
+          f"committed={int(total_committed)}B lag reported, follower "
+          f"down in <2 intervals, sums {int(fed_ingested)} -> "
+          f"{int(fed2_ingested)} with snapshot retained")
+finally:
+    cleanup()
+PY
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" python "$FLEET_STAGE" "$WORKDIR" \
+    || fail "fleet federation stage (liveness/lag/federated-sum assertions)"
+echo "ok   fleet federation: 3 members, lag reported, follower death detected, sums retained"
+
 echo "smoke OK"

@@ -255,3 +255,99 @@ def test_summary_tolerates_missing_stages(bench):
     assert s["value"] == 1.0
     assert s["serving_qps"] is None
     assert "configs" not in s
+
+
+# --------------------------------------------------------------------------
+# bench --history ledger (ISSUE 11 satellite)
+# --------------------------------------------------------------------------
+
+def _row(**over):
+    base = {
+        "timestamp": "2026-08-01T00:00:00+00:00", "git_sha": "abc1234",
+        "smoke": False, "value": 100.0, "serving_qps": 1000.0,
+        "pool_qps": 2000.0, "p50_predict_ms": 10.0, "p95_predict_ms": 20.0,
+        "serving_attributed": 0.9, "serving_h2d_x": 3.0, "shed_rate": 0.01,
+    }
+    base.update(over)
+    return base
+
+
+def test_history_record_pulls_trajectory_fields(bench):
+    full = _full_result()
+    summary = bench.build_summary(full)
+    rec = bench.history_record(full, summary, git_sha="deadbee",
+                               timestamp="2026-08-05T00:00:00+00:00")
+    assert rec["git_sha"] == "deadbee"
+    assert rec["value"] == summary["value"]
+    assert rec["p95_predict_ms"] == full["serving"]["concurrent"]["p95_ms"]
+    ov = full["serving"].get("overload") or {}
+    assert rec["shed_rate"] == ov.get("shed_rate")
+    assert rec["smoke"] in (True, False)
+    json.dumps(rec)  # one jsonl row
+
+
+def test_history_delta_flags_regressions_by_direction(bench):
+    prev = _row()
+    cur = _row(value=80.0,            # down 20% on an up-is-good -> bad
+               p95_predict_ms=15.0,   # down on a down-is-good -> improved
+               serving_qps=1001.0)    # within threshold -> neither
+    lines, regressed = bench.history_delta_table(prev, cur, 0.05)
+    assert regressed == ["value"]
+    text = "\n".join(lines)
+    assert "REGRESSION" in text and "improved" in text
+    assert "-20.0%" in text
+
+
+def test_history_append_read_round_trip_skips_garbage(bench, tmp_path,
+                                                      capsys):
+    path = str(tmp_path / "H.jsonl")
+    bench.append_history(_row(), path)
+    with open(path, "a") as f:
+        f.write("{not json\n")
+    bench.append_history(_row(git_sha="def5678"), path)
+    rows = bench.read_history(path)
+    assert [r["git_sha"] for r in rows] == ["abc1234", "def5678"]
+    assert "malformed history line" in capsys.readouterr().err
+
+
+def test_history_argv_and_env_parsing(bench, monkeypatch):
+    monkeypatch.delenv("PIO_TPU_BENCH_HISTORY", raising=False)
+    monkeypatch.delenv("PIO_TPU_BENCH_HISTORY_FILE", raising=False)
+    opts = bench.parse_history_argv([])
+    assert not opts["history"]
+    opts = bench.parse_history_argv(
+        ["--history", "--history-file=/x/H.jsonl",
+         "--regression-threshold", "0.2"])
+    assert opts["history"] and opts["history_file"] == "/x/H.jsonl"
+    assert opts["threshold"] == 0.2
+    monkeypatch.setenv("PIO_TPU_BENCH_HISTORY", "1")
+    assert bench.parse_history_argv([])["history"]
+    # bad threshold keeps the default, loudly but non-fatally
+    opts = bench.parse_history_argv(["--regression-threshold=eleven"])
+    assert opts["threshold"] == bench.DEFAULT_REGRESSION_THRESHOLD
+
+
+def test_maybe_record_history_appends_and_prints_delta(bench, tmp_path,
+                                                       capsys, monkeypatch):
+    monkeypatch.delenv("PIO_TPU_BENCH_HISTORY", raising=False)
+    path = str(tmp_path / "H.jsonl")
+    full = _full_result()
+    summary = bench.build_summary(full)
+    argv = ["--history", f"--history-file={path}"]
+    bench.maybe_record_history(full, summary, argv)
+    assert "baseline row recorded" in capsys.readouterr().err
+    # second run: delta table on stderr, two ledger rows, stdout untouched
+    bench.maybe_record_history(full, summary, argv)
+    out = capsys.readouterr()
+    assert out.out == ""          # summary-line stdout contract intact
+    assert "bench history delta" in out.err
+    assert len(bench.read_history(path)) == 2
+
+
+def test_maybe_record_history_never_raises(bench, tmp_path, capsys):
+    full = _full_result()
+    summary = bench.build_summary(full)
+    bad = str(tmp_path)  # a directory: open(..., "a") raises
+    bench.maybe_record_history(full, summary,
+                               ["--history", f"--history-file={bad}"])
+    assert "bench history failed" in capsys.readouterr().err

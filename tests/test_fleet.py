@@ -1,0 +1,584 @@
+"""Fleet telemetry plane tests (ISSUE 11): target parsing, federation
+failure modes with an injected fetch, the up -> stale -> down walk,
+fleetd + follower-sidecar routes over real HTTP, the embedded dashboard
+panel, and a 3-member end-to-end federation (query server + replicated
+partlog event server + follower) asserted against ground truth."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pio_tpu.obs import promparse
+from pio_tpu.obs.fleet import (
+    DEFAULT_INTERVAL_S,
+    FleetAggregator,
+    TARGETS_ENV,
+    parse_targets,
+)
+from pio_tpu.obs.metrics import MetricsRegistry, monotonic_s
+from pio_tpu.obs.promparse import parse_prometheus_text
+from pio_tpu.server.fleetd import (
+    FleetService,
+    FollowerStatusService,
+    create_fleet_server,
+    create_follower_status_server,
+)
+
+
+def http(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            raw = resp.read()
+            if "json" in resp.headers.get("Content-Type", ""):
+                return resp.status, json.loads(raw or b"null")
+            return resp.status, raw.decode()
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+class TestParseTargets:
+    def test_bare_and_schemed_and_dedupe(self):
+        got = parse_targets(
+            "h1:9001, http://h2:9002/, h1:9001,, https://h3:443"
+        )
+        assert got == [
+            ("h1:9001", "http://h1:9001"),
+            ("h2:9002", "http://h2:9002"),
+            ("h3:443", "https://h3:443"),
+        ]
+
+    def test_empty_specs(self):
+        assert parse_targets(None) == []
+        assert parse_targets("") == []
+        assert parse_targets(" , ,") == []
+
+
+class _FakeFleet:
+    """Dict-of-endpoints fake backing the injected fetch: tests flip
+    members dead/alive or swap bodies between scrape passes."""
+
+    def __init__(self, members):
+        #: member name -> {path: str-body} | None (None = unreachable)
+        self.members = dict(members)
+
+    def fetch(self, url, timeout):
+        name = url.split("://", 1)[1].split("/", 1)[0]
+        path = "/" + url.split("://", 1)[1].split("/", 1)[1]
+        endpoints = self.members.get(name)
+        if endpoints is None:
+            raise OSError(f"connection refused: {name}")
+        if path not in endpoints:
+            raise urllib.error.HTTPError(url, 404, "nope", {}, None)
+        body = endpoints[path]
+        return body.encode() if isinstance(body, str) else body
+
+
+def _metrics(n):
+    return ("# TYPE pio_tpu_q_total counter\n"
+            f"pio_tpu_q_total {n}\n")
+
+
+def _agg(fake, targets="a:1,b:2", **kw):
+    kw.setdefault("interval_s", 0.05)
+    return FleetAggregator(
+        parse_targets(targets), registry=MetricsRegistry(),
+        fetch=fake.fetch, **kw,
+    )
+
+
+class TestFailureModes:
+    def test_member_down_at_first_scrape(self):
+        """Satellite: a member that never answered is down (not stale —
+        there is no snapshot to grow stale) and contributes nothing."""
+        fake = _FakeFleet({"a:1": {"/metrics": _metrics(5)}, "b:2": None})
+        agg = _agg(fake)
+        assert agg.scrape_once() == 1
+        by = {e["member"]: e for e in agg.fleet_payload()["members"]}
+        assert by["a:1"]["status"] == "up"
+        assert by["b:2"]["status"] == "down"
+        assert "connection refused" in by["b:2"]["lastError"]
+        pm = parse_prometheus_text("\n".join(agg.obs.render()))
+        assert pm.value("pio_tpu_fleet_member_up", member="a:1") == 1
+        assert pm.value("pio_tpu_fleet_member_up", member="b:2") == 0
+        assert pm.value("pio_tpu_fleet_scrape_errors_total",
+                        member="b:2", reason="unreachable") == 1
+        assert pm.value("pio_tpu_q_total", pio_tpu_member="a:1") == 5
+        assert pm.value("pio_tpu_q_total", pio_tpu_member="b:2") is None
+
+    def test_member_dies_mid_interval_snapshot_retained(self):
+        """Satellite: death between scrapes keeps the last-seen counters
+        in the federated sums (no silent disappearance) while the
+        liveness gauge drops to 0."""
+        fake = _FakeFleet({"a:1": {"/metrics": _metrics(5)},
+                           "b:2": {"/metrics": _metrics(7)}})
+        agg = _agg(fake, stale_after_s=0.0, down_after_s=0.01)
+        assert agg.scrape_once() == 2
+        fake.members["b:2"] = None  # SIGKILL between intervals
+        time.sleep(0.02)
+        assert agg.scrape_once() == 1
+        by = {e["member"]: e for e in agg.fleet_payload()["members"]}
+        assert by["b:2"]["status"] == "down"
+        pm = parse_prometheus_text("\n".join(agg.obs.render()))
+        assert pm.value("pio_tpu_fleet_member_up", member="b:2") == 0
+        # retained snapshot still federated — sums keep adding up
+        assert pm.value("pio_tpu_q_total", pio_tpu_member="b:2") == 7
+        assert pm.value("pio_tpu_q_total", pio_tpu_member="a:1") == 5
+
+    def test_malformed_exposition_counted_others_unaffected(self):
+        fake = _FakeFleet({
+            "a:1": {"/metrics": "{} this is not exposition at all"},
+            "b:2": {"/metrics": _metrics(7)},
+        })
+        agg = _agg(fake)
+        assert agg.scrape_once() == 1
+        by = {e["member"]: e for e in agg.fleet_payload()["members"]}
+        assert by["a:1"]["status"] == "down"
+        assert by["a:1"]["scrapeErrors"] == 1
+        assert by["b:2"]["status"] == "up"
+        pm = parse_prometheus_text("\n".join(agg.obs.render()))
+        assert pm.value("pio_tpu_fleet_scrape_errors_total",
+                        member="a:1", reason="parse") == 1
+        assert pm.value("pio_tpu_q_total", pio_tpu_member="b:2") == 7
+
+    def test_http_error_reason_bucketed(self):
+        fake = _FakeFleet({"a:1": {"/other": "x"}})  # 404 on /metrics
+        agg = _agg(fake, targets="a:1")
+        agg.scrape_once()
+        pm = parse_prometheus_text("\n".join(agg.obs.render()))
+        assert pm.value("pio_tpu_fleet_scrape_errors_total",
+                        member="a:1", reason="http") == 1
+
+    def test_up_stale_down_walk(self):
+        """The staleness state machine against a frozen last_ok."""
+        fake = _FakeFleet({"a:1": {"/metrics": _metrics(1)}})
+        agg = _agg(fake, targets="a:1",
+                   stale_after_s=0.04, down_after_s=0.1)
+        agg.scrape_once()
+        m = agg.members()[0]
+        assert m.status(agg.stale_after_s, agg.down_after_s) == "up"
+        time.sleep(0.05)
+        assert m.status(agg.stale_after_s, agg.down_after_s) == "stale"
+        time.sleep(0.07)
+        assert m.status(agg.stale_after_s, agg.down_after_s) == "down"
+        # a fresh scrape resurrects it
+        agg.scrape_once()
+        assert m.status(agg.stale_after_s, agg.down_after_s) == "up"
+
+    def test_member_never_scraped_is_unknown(self):
+        agg = _agg(_FakeFleet({}), targets="a:1")
+        assert agg.fleet_payload()["members"][0]["status"] == "unknown"
+
+    def test_background_loop_scrapes_and_stops(self):
+        fake = _FakeFleet({"a:1": {"/metrics": _metrics(1)}})
+        agg = _agg(fake, targets="a:1", interval_s=0.02)
+        agg.start()
+        deadline = monotonic_s() + 5
+        while agg.passes < 2 and monotonic_s() < deadline:
+            time.sleep(0.01)
+        agg.stop()
+        assert agg.passes >= 2
+        settled = agg.passes
+        time.sleep(0.06)
+        assert agg.passes == settled  # loop actually stopped
+
+    def test_interval_env_fallback(self, monkeypatch):
+        monkeypatch.setenv("PIO_TPU_FLEET_INTERVAL_S", "11.5")
+        agg = FleetAggregator(parse_targets("a:1"),
+                              registry=MetricsRegistry())
+        assert agg.interval_s == 11.5
+        assert agg.stale_after_s == pytest.approx(2.5 * 11.5)
+        monkeypatch.delenv("PIO_TPU_FLEET_INTERVAL_S")
+        agg2 = FleetAggregator(parse_targets("a:1"),
+                               registry=MetricsRegistry())
+        assert agg2.interval_s == DEFAULT_INTERVAL_S
+
+
+class TestRollups:
+    def _scraped(self, endpoints, targets="a:1"):
+        fake = _FakeFleet({t.split("://")[-1]: endpoints
+                           for t in targets.split(",")})
+        agg = _agg(fake, targets=targets)
+        agg.scrape_once()
+        return agg
+
+    def test_slo_worst_burn_across_members(self):
+        def slo(burn, firing):
+            return json.dumps({"slos": [{
+                "name": "latency_p99", "objective": 0.999,
+                "burnRates": {"5m": burn, "1h": burn / 2},
+                "alerts": [{"severity": "page", "firing": firing}],
+                "errorBudgetRemaining": 0.5,
+            }]})
+        fake = _FakeFleet({
+            "a:1": {"/metrics": _metrics(1), "/slo.json": slo(0.4, False)},
+            "b:2": {"/metrics": _metrics(1), "/slo.json": slo(6.0, True)},
+        })
+        agg = _agg(fake)
+        agg.scrape_once()
+        worst = agg.fleet_payload()["slo"]["worstBurn"]["latency_p99"]
+        assert worst["member"] == "b:2"
+        assert worst["burn"] == 6.0 and worst["window"] == "5m"
+        assert worst["firing"] == ["page"]
+
+    def test_partlog_rollup_lag_and_min_acked(self):
+        storage = json.dumps({
+            "backend": "partlog", "role": "leader", "partitions": 2,
+            "durability": "commit",
+            "partition_detail": [
+                {"partition": 0, "committed_bytes": 100},
+                {"partition": 1, "committed_bytes": 50},
+            ],
+            "replication": {
+                "min_acks": 1, "replicas": ["f0", "f1"],
+                "followers": [
+                    {"follower": "f0", "connected": True,
+                     "acked": {"0": 90, "1": 50}},
+                    {"follower": "f1", "connected": False,
+                     "acked": {"0": 40}},
+                ],
+            },
+        })
+        agg = self._scraped({"/metrics": _metrics(1),
+                             "/storage.json": storage})
+        lead = agg.fleet_payload()["partlog"]["leaders"][0]
+        assert lead["durability"] == "commit"
+        p0 = lead["partitionDetail"][0]
+        lag = {f["follower"]: f["lagBytes"] for f in p0["followers"]}
+        assert lag == {"f0": 10, "f1": 60}
+        assert p0["minAckedBytes"] == 40
+        p1 = lead["partitionDetail"][1]
+        assert p1["minAckedBytes"] == 50
+        # f1 never acked partition 1 — explicit unknown, not 0
+        f1 = [f for f in p1["followers"] if f["follower"] == "f1"][0]
+        assert f1["ackedBytes"] is None and f1["lagBytes"] is None
+
+    def test_placement_modes(self):
+        def stats(shard, res):
+            return json.dumps({
+                "residency": {"enabled": res, "paramBytes": 64,
+                              "scorers": [{"name": "als", "paramBytes": 64,
+                                           "sharded": shard,
+                                           "retired": False}]},
+                "sharding": {"enabled": shard, "axis": "model"},
+            })
+        fake = _FakeFleet({
+            "a:1": {"/metrics": _metrics(1),
+                    "/stats.json": stats(True, True)},
+            "b:2": {"/metrics": _metrics(1),
+                    "/stats.json": stats(False, False)},
+        })
+        agg = _agg(fake)
+        agg.scrape_once()
+        pay = agg.fleet_payload()
+        modes = {p["member"]: p["mode"] for p in pay["placement"]}
+        assert modes == {"a:1": "mesh", "b:2": "host"}
+        by = {e["member"]: e for e in pay["members"]}
+        assert by["a:1"]["role"] == "query"  # residency block => query
+
+
+class TestFleetd:
+    def test_empty_targets_rejected(self):
+        with pytest.raises(ValueError, match="at least one target"):
+            FleetService([])
+
+    def test_routes_and_readiness_gate(self):
+        fake = _FakeFleet({"a:1": {"/metrics": _metrics(3)}})
+        service = FleetService(parse_targets("a:1"), interval_s=0.05,
+                               fetch=fake.fetch)
+        # not ready until one full scrape pass — the router must not
+        # steer by an empty snapshot
+        assert service.readyz(None)[0] == 503
+        service.agg.scrape_once()
+        assert service.readyz(None)[0] == 200
+        assert service.healthz(None)[0] == 200
+        st, idx = service.index(None)
+        assert st == 200 and idx["members"] == ["a:1"]
+        st, pay = service.fleet_json(None)
+        assert st == 200 and pay["fleet"]["up"] == 1
+
+    def test_create_fleet_server_over_http(self):
+        fake = _FakeFleet({"a:1": {"/metrics": _metrics(3)}})
+        server = create_fleet_server("a:1", host="127.0.0.1", port=0)
+        server.service.agg._fetch = fake.fetch
+        server.service.agg.interval_s = 0.05
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            assert http("GET", url + "/readyz")[0] == 503
+            server.service.agg.scrape_once()
+            assert http("GET", url + "/readyz")[0] == 200
+            st, pay = http("GET", url + "/fleet.json")
+            assert st == 200 and pay["fleet"]["members"] == 1
+            st, text = http("GET", url + "/metrics")
+            assert st == 200
+            pm = parse_prometheus_text(text)
+            assert pm.value("pio_tpu_q_total", pio_tpu_member="a:1") == 3
+            assert pm.value("pio_tpu_fleet_member_up", member="a:1") == 1
+            # the aggregator's own families are not double-federated
+            assert pm.value("pio_tpu_fleet_member_up", member="a:1",
+                            pio_tpu_member="a:1") is None
+        finally:
+            server.stop()
+
+
+class TestFollowerSidecar:
+    def test_status_surface_over_http(self, tmp_path):
+        from pio_tpu.storage.partlog import framing
+        from pio_tpu.storage.partlog.replication import FollowerServer
+
+        follower = FollowerServer(str(tmp_path / "mirror"))
+        try:
+            # simulate the leader handshake: MANIFEST + mirrored bytes
+            with open(os.path.join(follower.root, "MANIFEST.json"),
+                      "w") as f:
+                json.dump({"version": 1, "partitions": 2}, f)
+            with open(os.path.join(follower.root, "p000.repl"),
+                      "wb") as f:
+                f.write(framing.frame(b"hello"))
+            sidecar = create_follower_status_server(
+                follower, host="127.0.0.1", port=0
+            ).start()
+            try:
+                url = f"http://127.0.0.1:{sidecar.port}"
+                st, topo = http("GET", url + "/storage.json")
+                assert st == 200
+                assert topo["role"] == "follower"
+                assert topo["backend"] == "partlog"
+                assert topo["partitions"] == 2
+                assert topo["replicationPort"] == follower.port
+                want = len(framing.frame(b"hello"))
+                assert topo["positions"] == {"0": want, "1": 0}
+                st, text = http("GET", url + "/metrics")
+                assert st == 200
+                pm = parse_prometheus_text(text)
+                assert pm.value("pio_tpu_repl_follower_position_bytes",
+                                partition="0") == want
+                assert http("GET", url + "/readyz")[0] == 200
+            finally:
+                sidecar.stop()
+        finally:
+            follower.stop()
+
+    def test_no_manifest_means_zero_partitions(self, tmp_path):
+        from pio_tpu.storage.partlog.replication import FollowerServer
+
+        follower = FollowerServer(str(tmp_path / "mirror"))
+        try:
+            service = FollowerStatusService(follower)
+            st, topo = service.storage_json(None)
+            assert st == 200 and topo["partitions"] == 0
+            assert topo["positions"] == {}
+        finally:
+            follower.stop()
+
+
+class TestDashboardPanel:
+    def test_unconfigured_dashboard_serves_pointer(self, monkeypatch):
+        from pio_tpu.server.dashboard import DashboardService
+
+        monkeypatch.delenv(TARGETS_ENV, raising=False)
+        svc = DashboardService()
+        assert svc.fleet is None
+        st, body = svc.fleet_json(None)
+        assert st == 404 and "no fleet configured" in body["message"]
+        st, page = svc.fleet_html(None)
+        assert st == 200 and "no fleet configured" in page.body
+
+    def test_embedded_aggregator_from_env(self, monkeypatch):
+        from pio_tpu.server.dashboard import DashboardService
+
+        monkeypatch.setenv(TARGETS_ENV, "a:1,b:2")
+        fake = _FakeFleet({"a:1": {"/metrics": _metrics(5)},
+                           "b:2": {"/metrics": _metrics(7)}})
+        svc = DashboardService()
+        assert svc.fleet is not None
+        svc.fleet._fetch = fake.fetch
+        svc.fleet.scrape_once()
+        st, pay = svc.fleet_json(None)
+        assert st == 200 and pay["fleet"]["up"] == 2
+        st, page = svc.fleet_html(None)
+        assert st == 200 and "2 up" in page.body
+        # the dashboard's own /metrics carries the federation
+        pm = parse_prometheus_text("\n".join(svc.obs.render()))
+        assert pm.value("pio_tpu_q_total", pio_tpu_member="a:1") == 5
+        assert pm.value("pio_tpu_q_total", pio_tpu_member="b:2") == 7
+
+
+@pytest.fixture()
+def partlog_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path))
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "PL")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_PL_TYPE", "partlog")
+    monkeypatch.setenv(
+        "PIO_STORAGE_SOURCES_PL_PATH", str(tmp_path / "partlog")
+    )
+    monkeypatch.setenv("PIO_TPU_PARTLOG_PARTITIONS", "2")
+    from pio_tpu.storage import Storage
+
+    Storage.reset()
+    yield monkeypatch
+    Storage.reset()
+
+
+class TestThreeMemberE2E:
+    """Satellite: a real fleet — query server + event server with a
+    replicated 2-partition partlog + follower sidecar — federated over
+    real HTTP, /fleet.json asserted against per-member ground truth."""
+
+    def test_federation_matches_ground_truth(self, partlog_env, tmp_path):
+        import pio_tpu.templates  # noqa: F401 — registers engines
+        from tests.test_servers import _train
+        from pio_tpu.server import create_event_server, create_query_server
+        from pio_tpu.storage import AccessKey, App, Storage
+        from pio_tpu.storage.partlog.replication import FollowerServer
+
+        mp = partlog_env
+        follower = FollowerServer(str(tmp_path / "mirror"))
+        mp.setenv("PIO_TPU_PARTLOG_REPLICAS",
+                  f"127.0.0.1:{follower.port}")
+        Storage.reset()
+        app_id = Storage.get_meta_data_apps().insert(App(0, "srv-test"))
+        key = Storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id)
+        )
+        servers = []
+        try:
+            event = create_event_server(host="127.0.0.1", port=0).start()
+            servers.append(event)
+            eurl = f"http://127.0.0.1:{event.port}"
+            for i in range(8):
+                st, _ = http(
+                    "POST", f"{eurl}/events.json?accessKey={key}",
+                    {"event": "rate", "entityType": "user",
+                     "entityId": f"u{i}", "targetEntityType": "item",
+                     "targetEntityId": f"i{i}",
+                     "properties": {"rating": 4.0},
+                     "eventTime": "2026-03-01T10:00:00Z"},
+                )
+                assert st == 201
+            variant, ctx, _ = _train(app_id)
+            query, _svc = create_query_server(
+                variant, host="127.0.0.1", port=0, ctx=ctx,
+                slos=["p99=50ms:99.9"],
+            )
+            query.start()
+            servers.append(query)
+            qurl = f"http://127.0.0.1:{query.port}"
+            assert http("POST", qurl + "/queries.json",
+                        {"user": "u1", "num": 2})[0] == 200
+            sidecar = create_follower_status_server(
+                follower, host="127.0.0.1", port=0
+            ).start()
+            servers.append(sidecar)
+            surl = f"http://127.0.0.1:{sidecar.port}"
+
+            # ground truth: wait until replication fully acked
+            deadline = monotonic_s() + 20
+            while monotonic_s() < deadline:
+                topo = http("GET", eurl + "/storage.json")[1]
+                repl = topo["replication"]
+                committed = {
+                    str(p["partition"]): p["committed_bytes"]
+                    for p in topo["partition_detail"]
+                }
+                if repl and repl["followers"] and all(
+                    repl["min_acked"].get(k) == v
+                    for k, v in committed.items()
+                ):
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail(f"replication never caught up: {topo}")
+
+            members = ",".join(
+                u.split("://")[1] for u in (qurl, eurl, surl)
+            )
+            agg = FleetAggregator(
+                parse_targets(members), registry=MetricsRegistry(),
+                interval_s=0.2,
+            )
+            agg.scrape_once()
+            pay = agg.fleet_payload()
+            assert pay["fleet"]["members"] == 3
+            assert pay["fleet"]["up"] == 3
+            roles = {e["member"]: e["role"] for e in pay["members"]}
+            assert roles[qurl.split("://")[1]] == "query"
+            assert roles[eurl.split("://")[1]] == "leader"
+            assert roles[surl.split("://")[1]] == "follower"
+
+            # replication lag in /fleet.json == ground truth (acked ==
+            # committed, so lag 0 and min-acked == committed bytes)
+            lead = pay["partlog"]["leaders"][0]
+            assert len(lead["partitionDetail"]) == 2
+            for p in lead["partitionDetail"]:
+                k = str(p["partition"])
+                assert p["committedBytes"] == committed[k]
+                assert p["minAckedBytes"] == committed[k]
+                assert p["followers"][0]["lagBytes"] == 0
+                assert p["followers"][0]["connected"] is True
+            assert sum(committed.values()) > 0  # events actually landed
+
+            # burn rollup names the query server's SLO
+            slo_truth = http("GET", qurl + "/slo.json")[1]["slos"][0]
+            worst = pay["slo"]["worstBurn"][slo_truth["name"]]
+            assert worst["member"] == qurl.split("://")[1]
+            assert worst["objective"] == slo_truth["objective"]
+
+            # federated counter sums equal the per-member scrapes
+            fed = parse_prometheus_text(
+                "\n".join(agg.obs.render())
+            )
+            for url in (qurl, eurl, surl):
+                name = url.split("://")[1]
+                raw = parse_prometheus_text(
+                    http("GET", url + "/metrics")[1]
+                )
+                for (mname, ls), v in raw.samples.items():
+                    if promparse._merge_mode(mname, raw.types) != "sum":
+                        continue
+                    fed_key = (mname, frozenset(
+                        set(ls) | {("pio_tpu_member", name)}
+                    ))
+                    # scrapes raced by live traffic can only grow
+                    assert fed.samples.get(fed_key, -1.0) <= v, (
+                        mname, ls
+                    )
+                q = raw.value("pio_tpu_http_requests_total",
+                              code="200", path="/metrics")
+                if q is not None:
+                    assert fed.value(
+                        "pio_tpu_http_requests_total", code="200",
+                        path="/metrics", pio_tpu_member=name,
+                    ) is not None
+
+            # kill the follower sidecar: down within two intervals,
+            # retained snapshot still federated
+            sidecar.stop()
+            servers.remove(sidecar)
+            agg.stale_after_s = 0.0
+            agg.down_after_s = 0.2
+            time.sleep(0.3)
+            agg.scrape_once()
+            by = {e["member"]: e
+                  for e in agg.fleet_payload()["members"]}
+            assert by[surl.split("://")[1]]["status"] == "down"
+            fed2 = parse_prometheus_text("\n".join(agg.obs.render()))
+            assert fed2.value(
+                "pio_tpu_repl_follower_position_bytes",
+                partition="0", pio_tpu_member=surl.split("://")[1],
+            ) is not None
+        finally:
+            for s in servers:
+                s.stop()
+            follower.stop()

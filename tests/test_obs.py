@@ -20,6 +20,7 @@ from pio_tpu.obs import (
     escape_label_value,
     monotonic_s,
 )
+from pio_tpu.obs import promparse
 from pio_tpu.obs.promparse import parse_prometheus_text
 from pio_tpu.obs.shm import PoolMetricsSegment
 
@@ -426,3 +427,199 @@ class TestProfileHook:
         assert hook.enabled
         assert hook.directory == "/tmp/prof"
         assert hook.first_n == 3
+
+
+def _assert_parsed_equal(a, b):
+    assert a.samples == b.samples
+    assert a.types == b.types
+    assert a.helps == b.helps
+    assert a.exemplars == b.exemplars
+
+
+class TestPromMerge:
+    """promparse.merge / with_labels / render — the federation algebra
+    (ISSUE 11 satellite: counters sum, gauges last-write-wins,
+    histograms add bucket-wise, type conflicts are loud)."""
+
+    A = (
+        "# HELP q_total served\n"
+        "# TYPE q_total counter\n"
+        'q_total{code="200"} 3\n'
+        "# TYPE temp gauge\n"
+        "temp 20\n"
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.1"} 1\n'
+        'lat_seconds_bucket{le="+Inf"} 2\n'
+        "lat_seconds_sum 0.6\n"
+        "lat_seconds_count 2\n"
+    )
+    B = (
+        "# TYPE q_total counter\n"
+        'q_total{code="200"} 4\n'
+        'q_total{code="500"} 1\n'
+        "# TYPE temp gauge\n"
+        "temp 25\n"
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.1"} 5\n'
+        'lat_seconds_bucket{le="+Inf"} 7\n'
+        "lat_seconds_sum 2.0\n"
+        "lat_seconds_count 7\n"
+    )
+
+    def test_counter_sum_gauge_lww_histogram_bucketwise(self):
+        m = promparse.merge(parse_prometheus_text(self.A),
+                            parse_prometheus_text(self.B))
+        assert m.value("q_total", code="200") == 7       # summed
+        assert m.value("q_total", code="500") == 1       # union
+        assert m.value("temp") == 25                     # later wins
+        buckets = dict(m.histogram_buckets("lat_seconds"))
+        assert buckets[0.1] == 6 and buckets[float("inf")] == 9
+        assert m.value("lat_seconds_sum") == pytest.approx(2.6)
+        assert m.value("lat_seconds_count") == 9
+
+    def test_merge_is_identity_for_one_scrape(self):
+        pm = parse_prometheus_text(self.A)
+        _assert_parsed_equal(promparse.merge(pm), pm)
+
+    def test_conflicting_types_raise(self):
+        a = parse_prometheus_text("# TYPE x counter\nx 1\n")
+        b = parse_prometheus_text("# TYPE x gauge\nx 2\n")
+        with pytest.raises(ValueError, match="conflicting TYPE"):
+            promparse.merge(a, b)
+
+    def test_untyped_total_suffix_sums_untyped_other_lww(self):
+        a = parse_prometheus_text("mystery_total 2\nmystery_level 9\n")
+        b = parse_prometheus_text("mystery_total 3\nmystery_level 4\n")
+        m = promparse.merge(a, b)
+        assert m.value("mystery_total") == 5   # counter naming discipline
+        assert m.value("mystery_level") == 4   # point sample: last wins
+
+    def test_inf_only_bucket_histogram_merges(self):
+        text = (
+            "# TYPE all_seconds histogram\n"
+            'all_seconds_bucket{le="+Inf"} 3\n'
+            "all_seconds_sum 1.5\n"
+            "all_seconds_count 3\n"
+        )
+        m = promparse.merge(parse_prometheus_text(text),
+                            parse_prometheus_text(text))
+        assert m.histogram_buckets("all_seconds") == [(float("inf"), 6)]
+        rt = parse_prometheus_text("\n".join(promparse.render(m)))
+        _assert_parsed_equal(rt, m)
+
+    def test_with_labels_injects_and_overrides(self):
+        pm = parse_prometheus_text(
+            "# TYPE q_total counter\n"
+            'q_total{code="200",pio_tpu_member="stale"} 3\n'
+        )
+        out = promparse.with_labels(pm, pio_tpu_member="h:1")
+        assert out.value("q_total", code="200", pio_tpu_member="h:1") == 3
+        assert len(out.samples) == 1  # the stale member label was replaced
+
+    def test_member_labeled_sums_equal_per_member_scrapes(self):
+        """The acceptance identity: sum over the injected member label
+        of the federated scrape == sum of the raw per-member scrapes."""
+        pa, pb = parse_prometheus_text(self.A), parse_prometheus_text(self.B)
+        fed = promparse.merge(
+            promparse.with_labels(pa, pio_tpu_member="a:1"),
+            promparse.with_labels(pb, pio_tpu_member="b:2"),
+        )
+        fed_sum = sum(fed.family("q_total").values())
+        raw_sum = (sum(pa.family("q_total").values())
+                   + sum(pb.family("q_total").values()))
+        assert fed_sum == raw_sum == 8
+
+    def test_render_round_trips_escapes_and_exemplars(self):
+        text = (
+            "# HELP odd_total has \\\\ and \\n in help\n"
+            "# TYPE odd_total counter\n"
+            'odd_total{path="a\\\\b",msg="say \\"hi\\"\\nbye"} 2\n'
+            "# TYPE rt_seconds histogram\n"
+            'rt_seconds_bucket{le="0.5"} 1 # {trace_id="q-7"} 0.0042\n'
+            'rt_seconds_bucket{le="+Inf"} 1\n'
+            "rt_seconds_sum 0.0042\n"
+            "rt_seconds_count 1\n"
+        )
+        pm = parse_prometheus_text(text)
+        assert pm.exemplar("rt_seconds_bucket", le="0.5") == (
+            {"trace_id": "q-7"}, 0.0042
+        )
+        rt = parse_prometheus_text("\n".join(promparse.render(pm)))
+        _assert_parsed_equal(rt, pm)
+
+    def test_registry_render_round_trips_through_promparse_render(self):
+        """Property-style: a real registry's exposition survives
+        parse -> render -> parse unchanged."""
+        reg = MetricsRegistry()
+        c = reg.counter("p_q_total", 'weird "help" \\ here', ("code",))
+        c.inc(3, code='2"00')
+        g = reg.gauge("p_depth", "queue depth")
+        g.set(-4.25)
+        h = reg.histogram("p_lat_seconds", "lat", buckets=(0.01, 0.1))
+        for v in (0.005, 0.05, 5.0):
+            h.observe(v)
+        pm = render_parse(reg)
+        once = parse_prometheus_text("\n".join(promparse.render(pm)))
+        _assert_parsed_equal(once, pm)
+        twice = parse_prometheus_text("\n".join(promparse.render(once)))
+        _assert_parsed_equal(twice, once)
+
+    def test_labeled_histogram_quantile_merges_cells(self):
+        """Histogram.quantile() pools every label cell — what bench
+        reads now pio_tpu_repl_ack_seconds is per-partition/follower."""
+        reg = MetricsRegistry()
+        h = reg.histogram("m_seconds", "x", ("part",),
+                          buckets=(0.1, 1.0, 10.0))
+        for _ in range(99):
+            h.observe(0.05, part="0")
+        h.observe(5.0, part="1")
+        q = h.quantile(0.5)
+        assert q is not None and q <= 0.1
+        assert h.quantile(0.999) > 1.0
+        empty = reg.histogram("n_seconds", "y", ("part",))
+        assert empty.quantile(0.95) is None
+
+
+class TestPoolSegmentGenerations:
+    """Stripe generation words (ISSUE 11 satellite): spawn bumps,
+    retirement freezes negative, totals never vanish from sums."""
+
+    def test_lifecycle_bump_adopt_retire(self, seg_path):
+        seg = PoolMetricsSegment.create(seg_path, n_workers=3,
+                                        slots_per_worker=4)
+        assert seg.generations() == [0, 0, 0]   # never owned
+        assert seg.bump_generation(0) == 1      # first spawn
+        assert seg.bump_generation(0) == 2      # respawn adopts
+        assert seg.generation(0) == 2
+        assert seg.retire_stripe(0) == -2       # frozen, history kept
+        assert seg.generation(0) == -2
+        # bump after retire = budget-respawn never happens, but the
+        # algebra stays sane: abs+1
+        assert seg.bump_generation(0) == 3
+        seg.unlink()
+
+    def test_generations_persist_across_reopen_and_data_intact(
+            self, seg_path):
+        seg = PoolMetricsSegment.create(seg_path, n_workers=2,
+                                        slots_per_worker=4)
+        seg.set(0, 1, 7.5)
+        seg.set(1, 1, 2.5)
+        seg.bump_generation(0)
+        seg.bump_generation(1)
+        seg.retire_stripe(1)
+        reopened = PoolMetricsSegment.open(seg_path)
+        assert reopened.generations() == [1, -1]
+        # retired stripe still contributes to the pool-wide sum
+        assert reopened.sum_slot(1) == 10.0
+        assert reopened.read(0, 1) == 7.5
+        reopened.close()
+        seg.unlink()
+
+    def test_set_generation_bounds_checked(self, seg_path):
+        seg = PoolMetricsSegment.create(seg_path, n_workers=1,
+                                        slots_per_worker=2)
+        with pytest.raises(IndexError):
+            seg.generation(1)
+        with pytest.raises(IndexError):
+            seg.bump_generation(-1)
+        seg.unlink()
