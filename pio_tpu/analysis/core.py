@@ -33,6 +33,16 @@ _SUPPRESS_RE = re.compile(
     r"#\s*pio:\s*disable(?P<whole_file>-file)?=(?P<rules>[A-Za-z0-9_,\- ]+)"
 )
 
+#: analysis markers, same comment grammar as suppressions:
+#:   # pio: hotpath              <- function is a hot-path root
+#:   # pio: hotpath=zerocopy     <- additionally no JSON / bytes copies
+#:   # pio: frame=lane-slot      <- struct call site belongs to a frame
+#: A marker alone on its line covers the line below it (so a def whose
+#: signature spans lines can carry the marker above itself).
+_MARKER_RE = re.compile(
+    r"#\s*pio:\s*(?P<kind>hotpath|frame)(?:=(?P<value>[A-Za-z0-9_.\-]+))?"
+)
+
 #: directories never descended into when a lint path is a directory
 _SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
 
@@ -72,12 +82,22 @@ class ModuleInfo:
     module_name: str               # dotted name ("pio_tpu.qos.gate" / "a")
     suppressions: Dict[int, Set[str]] = field(default_factory=dict)
     file_suppressions: Set[str] = field(default_factory=set)
+    #: line -> "" (plain hotpath) | "zerocopy"  (`# pio: hotpath[=...]`)
+    hotpath_markers: Dict[int, str] = field(default_factory=dict)
+    #: line -> frame family name  (`# pio: frame=<family>`)
+    frame_markers: Dict[int, str] = field(default_factory=dict)
 
     def suppressed(self, rule: str, line: int) -> bool:
         if rule in self.file_suppressions:
             return True
         rules = self.suppressions.get(line)
         return bool(rules) and rule in rules
+
+    def suppressed_at_any(self, rule: str, lines: Iterable[int]) -> bool:
+        """True when any of ``lines`` carries a disable for ``rule`` —
+        how project rules honor a disable placed on a root function's
+        def/marker line rather than on the finding's own line."""
+        return any(self.suppressed(rule, ln) for ln in lines)
 
 
 class LintContext:
@@ -164,6 +184,7 @@ def all_rules() -> Dict[str, Rule]:
 
 def _load_rule_modules() -> None:
     # deferred so core can be imported by the rule modules themselves
+    from pio_tpu.analysis import effects  # noqa: F401
     from pio_tpu.analysis import lockgraph  # noqa: F401
     from pio_tpu.analysis import rules_concurrency  # noqa: F401
     from pio_tpu.analysis import rules_convention  # noqa: F401
@@ -225,26 +246,43 @@ def _module_name(path: str) -> str:
 def _collect_suppressions(source: str):
     per_line: Dict[int, Set[str]] = {}
     whole_file: Set[str] = set()
+    hotpath: Dict[int, str] = {}
+    frames: Dict[int, str] = {}
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in tokens:
             if tok.type != tokenize.COMMENT:
                 continue
+            alone = tok.line[:tok.start[1]].strip() == ""
+            line = tok.start[0]
             m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                rules = {
+                    r.strip() for r in m.group("rules").split(",") if r.strip()
+                }
+                if m.group("whole_file"):
+                    whole_file |= rules
+                    continue
+                per_line.setdefault(line, set()).update(rules)
+                # a comment alone on its line covers the line below it
+                if alone:
+                    per_line.setdefault(line + 1, set()).update(rules)
+                continue
+            m = _MARKER_RE.search(tok.string)
             if not m:
                 continue
-            rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
-            if m.group("whole_file"):
-                whole_file |= rules
-                continue
-            line = tok.start[0]
-            per_line.setdefault(line, set()).update(rules)
-            # a comment alone on its line covers the line below it
-            if tok.line[:tok.start[1]].strip() == "":
-                per_line.setdefault(line + 1, set()).update(rules)
+            kind, value = m.group("kind"), m.group("value") or ""
+            if kind == "hotpath":
+                hotpath[line] = value
+                if alone:
+                    hotpath.setdefault(line + 1, value)
+            elif kind == "frame" and value:
+                frames[line] = value
+                if alone:
+                    frames.setdefault(line + 1, value)
     except tokenize.TokenError:
         pass
-    return per_line, whole_file
+    return per_line, whole_file, hotpath, frames
 
 
 def collect_files(paths: Sequence[str]) -> List[str]:
@@ -285,7 +323,7 @@ def parse_module(path: str, display: Optional[str] = None
                        exc.offset or 0, f"syntax error: {exc.msg}")
     except OSError as exc:
         return Finding("parse-error", display, 0, 0, f"unreadable: {exc}")
-    per_line, whole_file = _collect_suppressions(source)
+    per_line, whole_file, hotpath, frames = _collect_suppressions(source)
     return ModuleInfo(
         path=os.path.abspath(path),
         display=display,
@@ -295,6 +333,8 @@ def parse_module(path: str, display: Optional[str] = None
         module_name=_module_name(path),
         suppressions=per_line,
         file_suppressions=whole_file,
+        hotpath_markers=hotpath,
+        frame_markers=frames,
     )
 
 
@@ -309,11 +349,16 @@ def _display_path(path: str) -> str:
 def run_lint(paths: Sequence[str],
              rule_ids: Optional[Sequence[str]] = None,
              catalog: Optional[Set[str]] = None,
-             repo_root: Optional[str] = None) -> List[Finding]:
+             repo_root: Optional[str] = None,
+             only: Optional[Sequence[str]] = None) -> List[Finding]:
     """Lint ``paths`` and return the surviving (unsuppressed) findings,
     sorted by file/line. ``rule_ids`` restricts to a subset of rules;
     ``catalog`` overrides the docs/observability.md metric catalog
-    (tests use this to lint fixtures against a synthetic catalog)."""
+    (tests use this to lint fixtures against a synthetic catalog).
+    ``only`` (absolute or display paths) keeps findings from just those
+    files while every file in ``paths`` still feeds project context —
+    the ``pio lint --changed`` fast path: call graphs and frame
+    families are built whole-tree, findings are reported per-diff."""
     rules = all_rules()
     if rule_ids is not None:
         unknown = set(rule_ids) - set(rules)
@@ -341,11 +386,22 @@ def run_lint(paths: Sequence[str],
                     continue
                 findings.extend(rule.check(m, ctx))
 
+    focus: Optional[Set[str]] = None
+    if only is not None:
+        focus = set()
+        for p in only:
+            focus.add(p)
+            focus.add(os.path.abspath(p))
+
     kept = []
     for f in findings:
         m = mod_by_path.get(f.path)
         if m is not None and m.suppressed(f.rule, f.line):
             continue
+        if focus is not None:
+            fp = m.path if m is not None else os.path.abspath(f.path)
+            if f.path not in focus and fp not in focus:
+                continue
         kept.append(f)
     kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return kept

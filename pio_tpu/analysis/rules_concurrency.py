@@ -12,7 +12,14 @@ from __future__ import annotations
 import ast
 from typing import Callable, Iterable, List, Optional, Tuple
 
-from pio_tpu.analysis.core import Finding, LintContext, ModuleInfo, Rule, register
+from pio_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    ModuleInfo,
+    ProjectRule,
+    Rule,
+    register,
+)
 from pio_tpu.analysis.locks import (
     LockIndex,
     build_lock_index,
@@ -138,30 +145,64 @@ def _blocking_reason(call: ast.Call) -> Optional[str]:
 
 
 @register
-class LockBlockingCallRule(Rule):
+class LockBlockingCallRule(ProjectRule):
     id = "lock-blocking-call"
     family = "concurrency"
     description = (
         "Blocking call (sleep / subprocess / socket / urlopen / sqlite "
-        "commit) inside a `with <lock>:` block stalls every other "
-        "thread contending for that lock."
+        "commit) inside a `with <lock>:` block — directly or through a "
+        "resolvable callee whose effect summary blocks — stalls every "
+        "other thread contending for that lock."
     )
 
     def check(self, module: ModuleInfo, ctx: LintContext) -> Iterable[Finding]:
+        # lexical fallback: no project context, direct calls only
+        return self._check_module(module, None)
+
+    def check_project(self, modules: List[ModuleInfo],
+                      ctx: LintContext) -> Iterable[Finding]:
+        from pio_tpu.analysis.effects import get_analysis
+        analysis = get_analysis(modules, ctx)
         findings: List[Finding] = []
+        for m in modules:
+            findings.extend(self._check_module(m, analysis))
+        return findings
+
+    def _check_module(self, module: ModuleInfo, analysis) -> List[Finding]:
+        findings: List[Finding] = []
+        scanner = analysis.scanner_for(module) if analysis else None
 
         def on_call(call, held, while_depth, cls):
             if not held:
                 return
-            reason = _blocking_reason(call)
-            if reason is None:
-                return
             lock = held[-1][1]
+            reason = _blocking_reason(call)
+            if reason is not None:
+                findings.append(Finding(
+                    self.id, module.display, call.lineno, call.col_offset,
+                    f"blocking {reason} while holding `{lock}`; move the "
+                    f"blocking work outside the lock or suppress if the "
+                    f"serialization is intentional",
+                ))
+                return
+            # interprocedural: a resolvable callee whose effect summary
+            # blocks is just as much of a stall, one-or-more frames down
+            if scanner is None:
+                return
+            key = scanner.callee_key(call, cls)
+            if key is None:
+                return
+            chained = analysis.blocking_chain(key, self.id)
+            if chained is None:
+                return
+            site, chain = chained
+            via = " -> ".join(q.rsplit(".", 1)[-1] for q in chain)
             findings.append(Finding(
                 self.id, module.display, call.lineno, call.col_offset,
-                f"blocking {reason} while holding `{lock}`; move the "
-                f"blocking work outside the lock or suppress if the "
-                f"serialization is intentional",
+                f"call while holding `{lock}` reaches blocking "
+                f"{site.render()} via {via}; move the blocking work "
+                f"outside the lock or suppress if the serialization is "
+                f"intentional",
             ))
 
         LockScanner(module, on_call).run()

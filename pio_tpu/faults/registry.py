@@ -237,6 +237,9 @@ def failpoint(point: str, data: Optional[bytes] = None) -> Optional[bytes]:
         return None
     action = rule.action
     if action == "latency":
+        # the injected stall IS the fault under test; only armed
+        # latency rules (tests) ever reach this sleep
+        # pio: disable=hotpath-blocking
         time.sleep(rule.delay_s or 0.0)
         return None
     if action == "crash":
@@ -246,6 +249,8 @@ def failpoint(point: str, data: Optional[bytes] = None) -> Optional[bytes]:
         sys.stderr.flush()
         os._exit(CRASH_EXIT_CODE)
     if action == "torn-write" and data is not None:
+        # the truncated copy is the injected wound; test-only path
+        # pio: disable=hotpath-zero-copy
         return data[: random.randrange(0, max(1, len(data)))]
     raise FaultInjected(point, action)
 

@@ -122,6 +122,9 @@ def event_log_lib():
     with _lock:
         if "event_log" in _cache:
             return _cache["event_log"]
+        # first-use compile fills the cache: serializing the build
+        # under _lock is the point (one compiler run per library)
+        # pio: disable=lock-blocking-call
         lib = ctypes.CDLL(build_library("event_log"))
         lib.pel_append.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
@@ -156,6 +159,9 @@ def als_pack_lib():
     with _lock:
         if "als_pack" in _cache:
             return _cache["als_pack"]
+        # first-use compile fills the cache: serializing the build
+        # under _lock is the point (one compiler run per library)
+        # pio: disable=lock-blocking-call
         lib = ctypes.CDLL(build_library("als_pack"))
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)
@@ -197,6 +203,9 @@ def topn_host_lib():
     with _lock:
         if "topn_host" in _cache:
             return _cache["topn_host"]
+        # first-use compile fills the cache: serializing the build
+        # under _lock is the point (one compiler run per library)
+        # pio: disable=lock-blocking-call
         lib = ctypes.CDLL(build_library("topn_host"))
         f32p = ctypes.POINTER(ctypes.c_float)
         i32p = ctypes.POINTER(ctypes.c_int32)

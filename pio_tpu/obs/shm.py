@@ -76,6 +76,7 @@ class PoolMetricsSegment:
         size = cls._size(n_workers, slots_per_worker)
         with open(path, "wb") as f:
             f.write(MAGIC)
+            # pio: frame=metrics-header
             f.write(struct.pack("<II", n_workers, slots_per_worker))
             f.write(b"\0" * (size - 16))
         return cls.open(path)
@@ -87,6 +88,7 @@ class PoolMetricsSegment:
             head = f.read(HEADER_BYTES)
             if len(head) < HEADER_BYTES or head[:8] != MAGIC:
                 raise ValueError(f"{path}: not a pool metrics segment")
+            # pio: frame=metrics-header
             n_workers, slots = struct.unpack_from("<II", head, 8)
             m = mmap.mmap(f.fileno(), cls._size(n_workers, slots))
         except BaseException:
@@ -122,11 +124,13 @@ class PoolMetricsSegment:
     def generation(self, worker_idx: int) -> int:
         """0 = never owned; N>0 = owned, adopted N-1 times; -N = stripe
         retired at generation N (frozen totals, still summed)."""
+        # pio: frame=metrics-stripe
         return int(struct.unpack_from(
             "<d", self._m, self._gen_off(worker_idx)
         )[0])
 
     def set_generation(self, worker_idx: int, gen: int) -> None:
+        # pio: frame=metrics-stripe
         struct.pack_into(
             "<d", self._m, self._gen_off(worker_idx), float(gen)
         )
@@ -159,9 +163,10 @@ class PoolMetricsSegment:
                 + (worker_idx * self.slots_per_worker + slot) * 8)
 
     def set(self, worker_idx: int, slot: int, v: float) -> None:
-        struct.pack_into("<d", self._m, self._off(worker_idx, slot), v)
+        struct.pack_into("<d", self._m, self._off(worker_idx, slot), v)  # pio: frame=metrics-stripe
 
     def read(self, worker_idx: int, slot: int) -> float:
+        # pio: frame=metrics-stripe
         return struct.unpack_from("<d", self._m, self._off(worker_idx, slot))[0]
 
     def sum_slot(self, slot: int) -> float:

@@ -93,12 +93,12 @@ DEFAULT_PAYLOAD_BYTES = 16384
 STATUS_OK = 0
 STATUS_ERROR = 1
 
-_SLOT_HDR = struct.Struct("<QQIII4x")
+_SLOT_HDR = struct.Struct("<QQIII4x")  # pio: frame=lane-slot
 
 #: packed int8 request frame: magic + u32 code count + the codes. The
 #: leading NUL is the JSON/binary discriminator (see module docstring).
 PACKED_MAGIC = b"\x00Q8\x01"
-_PACKED_HDR = struct.Struct("<4sI")
+_PACKED_HDR = struct.Struct("<4sI")  # pio: frame=lane-packed
 
 
 class PackedQuery:
@@ -116,15 +116,17 @@ class PackedQuery:
         return len(self.codes)
 
 
-def pack_query_i8(codes) -> bytes:
+def pack_query_i8(codes) -> bytes:  # pio: hotpath=zerocopy
     """Encode a ``[dim]`` int8 code vector as a lane request frame."""
     import numpy as np
 
     codes = np.ascontiguousarray(codes, np.int8).reshape(-1)
+    # the one serialization copy: device codes -> wire frame
+    # pio: disable=hotpath-zero-copy
     return _PACKED_HDR.pack(PACKED_MAGIC, len(codes)) + codes.tobytes()
 
 
-def unpack_query_i8(payload: bytes) -> PackedQuery:
+def unpack_query_i8(payload: bytes) -> PackedQuery:  # pio: hotpath=zerocopy
     """Decode a packed frame (the caller already matched the magic)."""
     import numpy as np
 
@@ -178,6 +180,7 @@ class BatchLaneSegment:
         size = HEADER_BYTES + n_workers * slots_per_worker * slot_bytes
         with open(path, "wb") as f:
             f.write(MAGIC)
+            # pio: frame=lane-header
             f.write(struct.pack(
                 "<III", n_workers, slots_per_worker, payload_bytes
             ))
@@ -191,6 +194,7 @@ class BatchLaneSegment:
             head = f.read(HEADER_BYTES)
             if len(head) < HEADER_BYTES or head[:8] != MAGIC:
                 raise ValueError(f"{path}: not a batch lane segment")
+            # pio: frame=lane-header
             n_workers, slots, payload = struct.unpack_from("<III", head, 8)
             slot_bytes = SLOT_HEADER_BYTES + payload
             size = HEADER_BYTES + n_workers * slots * slot_bytes
@@ -230,7 +234,7 @@ class BatchLaneSegment:
         """(req_seq, resp_seq, req_len, resp_len, status)."""
         return _SLOT_HDR.unpack_from(self._m, self._slot_off(worker, slot))
 
-    def post_request(self, worker: int, slot: int, payload: bytes) -> int:
+    def post_request(self, worker: int, slot: int, payload: bytes) -> int:  # pio: hotpath=zerocopy
         """Submitter side: write the request and publish it by bumping
         ``req_seq`` to odd. Returns the posted seq. The caller must own
         the slot (even ``req_seq`` == ``resp_seq`` state)."""
@@ -239,13 +243,13 @@ class BatchLaneSegment:
         s = req_seq + 1  # even -> odd
         body_off = off + SLOT_HEADER_BYTES
         self._m[body_off:body_off + len(payload)] = payload
-        struct.pack_into("<I", self._m, off + 16, len(payload))
+        struct.pack_into("<I", self._m, off + 16, len(payload))  # pio: frame=lane-slot
         # seq write LAST: publishing the request is the linearization
         # point the drainer scans for
-        struct.pack_into("<Q", self._m, off, s)
+        struct.pack_into("<Q", self._m, off, s)  # pio: frame=lane-slot
         return s
 
-    def read_request(self, worker: int, slot: int) -> Optional[Tuple[int, bytes]]:
+    def read_request(self, worker: int, slot: int) -> Optional[Tuple[int, bytes]]:  # pio: hotpath=zerocopy
         """Drainer side: (req_seq, payload) when the slot holds an
         unanswered request, else None."""
         off = self._slot_off(worker, slot)
@@ -253,8 +257,12 @@ class BatchLaneSegment:
         if req_seq % 2 == 0 or resp_seq == req_seq:
             return None
         body_off = off + SLOT_HEADER_BYTES
+        # copy-out is deliberate: the mmap slot is reused as soon
+        # as the response posts, so the request must not alias it
+        # pio: disable=hotpath-zero-copy
         return req_seq, bytes(self._m[body_off:body_off + req_len])
 
+    # pio: hotpath=zerocopy
     def post_response(self, worker: int, slot: int, req_seq: int,
                       payload: bytes, status: int = STATUS_OK) -> None:
         """Drainer side: write the response and publish it by advancing
@@ -262,9 +270,10 @@ class BatchLaneSegment:
         off = self._slot_off(worker, slot)
         body_off = off + SLOT_HEADER_BYTES
         self._m[body_off:body_off + len(payload)] = payload
-        struct.pack_into("<II", self._m, off + 20, len(payload), status)
-        struct.pack_into("<Q", self._m, off + 8, req_seq)
+        struct.pack_into("<II", self._m, off + 20, len(payload), status)  # pio: frame=lane-slot
+        struct.pack_into("<Q", self._m, off + 8, req_seq)  # pio: frame=lane-slot
 
+    # pio: hotpath=zerocopy
     def read_response(self, worker: int, slot: int,
                       req_seq: int) -> Optional[Tuple[int, bytes]]:
         """Submitter side: (status, payload) once the drainer answered
@@ -274,11 +283,15 @@ class BatchLaneSegment:
         if resp_seq != req_seq:
             return None
         body_off = off + SLOT_HEADER_BYTES
+        # copy-out is deliberate: release() frees the slot for the
+        # next request before the caller finishes with the payload
+        # pio: disable=hotpath-zero-copy
         return status, bytes(self._m[body_off:body_off + resp_len])
 
     def release(self, worker: int, slot: int, req_seq: int) -> None:
         """Submitter side: response consumed; free the slot (odd seq →
         even)."""
+        # pio: frame=lane-slot
         struct.pack_into(
             "<Q", self._m, self._slot_off(worker, slot), req_seq + 1
         )
@@ -351,6 +364,7 @@ class LaneClient:
                 return s
         return None
 
+    # pio: hotpath=zerocopy
     def submit(self, body: dict, timeout_s: Optional[float] = None,
                packed: Optional[bytes] = None):
         """Serve one query body through the device worker; blocks until
@@ -367,6 +381,10 @@ class LaneClient:
             payload = packed
         else:
             try:
+                # legacy JSON envelope for un-packed callers; the
+                # packed int8 branch above is the zero-copy wire
+                # (ROADMAP item 1 retires this encode)
+                # pio: disable=hotpath-zero-copy
                 payload = json.dumps(body).encode("utf-8")
             except (TypeError, ValueError):
                 raise LaneFallback("unserializable")
@@ -392,6 +410,9 @@ class LaneClient:
             got = self._seg.read_response(self._idx, slot, seq)
             if got is not None:
                 break
+            # bounded 2 ms doze between slot-header polls; submit
+            # is synchronous RPC, the caller expects to park here
+            # pio: disable=hotpath-blocking
             self._resp_event.wait(0.002)
         status, payload = got
         self._seg.release(self._idx, slot, seq)
@@ -400,6 +421,9 @@ class LaneClient:
         if status != STATUS_OK:
             raise LaneFallback("remote_error")
         try:
+            # legacy JSON envelope decode, mirror of the encode
+            # above (packed responses bypass submit entirely)
+            # pio: disable=hotpath-zero-copy
             return json.loads(payload.decode("utf-8"))
         except (UnicodeDecodeError, ValueError):
             raise LaneFallback("undecodable_response")
@@ -511,8 +535,11 @@ class LaneDrainer:
             self._on_drain(len(pending), 1)
         return len(pending)
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # pio: hotpath
         while not self._stopped:
+            # the drain loop parks on the doorbell by design; the
+            # wait bounds idle latency, not request latency
+            # pio: disable=hotpath-blocking
             self._doorbell.wait(self._poll_s)
             self._doorbell.clear()
             if self._stopped:

@@ -161,6 +161,7 @@ class BucketExecutionCache:
         }
 
 
+# pio: hotpath
 def dispatch_bucketed(
     cache: BucketExecutionCache,
     queries: list,

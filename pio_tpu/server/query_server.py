@@ -223,7 +223,7 @@ class _MicroBatcher:
         )
         return True
 
-    def submit(self, query, span_sink=None, deadline=None):
+    def submit(self, query, span_sink=None, deadline=None):  # pio: hotpath
         """Serve one query through the current regime; blocks until done.
         If the batch dispatch failed, the fallback per-query predict runs
         HERE — in the request's own thread — so one poisoned query
@@ -261,6 +261,9 @@ class _MicroBatcher:
                 raise HTTPError(503, "undeployed")
             self._queue.append(pend)
             self._cv.notify()
+        # submit IS the synchronous rendezvous: the request thread
+        # parks until its batch completes
+        # pio: disable=hotpath-blocking
         pend[3].wait()
         if mode == "probe_batch" and not pend[5].get("fresh_bucket"):
             # a dispatch that compiled a fresh shape bucket is a one-off
@@ -365,10 +368,13 @@ class _MicroBatcher:
             "bypassed": self.bypassed,
         }
 
-    def _run(self):
+    def _run(self):  # pio: hotpath
         while True:
             with self._cv:
                 while not self._queue and not self._stopped:
+                    # idle park: nothing to batch until an enqueue
+                    # notifies
+                    # pio: disable=hotpath-blocking
                     self._cv.wait()
                 if self._stopped and not self._queue:
                     return
@@ -397,6 +403,9 @@ class _MicroBatcher:
                             )
                         if wait_s <= 0:
                             break
+                        # deadline-bounded collection window (see
+                        # comment above) — not a blind stall
+                        # pio: disable=hotpath-blocking
                         self._cv.wait(wait_s)
             with self._cv:
                 batch = self._queue[: self.MAX_BATCH]
@@ -1364,7 +1373,7 @@ class QueryServerService:
             self._load(None)
             self._seen_gen = target
 
-    def query(self, req: Request):
+    def query(self, req: Request):  # pio: hotpath
         if not self._deployed:
             raise HTTPError(503, "undeployed")
         self._pool_sync()

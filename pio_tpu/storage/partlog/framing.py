@@ -31,7 +31,7 @@ from pio_tpu.storage import base
 
 log = logging.getLogger("pio_tpu.partlog")
 
-_LEN = struct.Struct("<I")
+_LEN = struct.Struct("<I")  # pio: frame=pel2-record
 #: per-frame overhead: 4-byte length prefix + 4-byte crc trailer
 OVERHEAD = 8
 

@@ -176,7 +176,9 @@ class FollowerServer:
         with self._lock:
             for k in range(partitions):
                 path = self._path(k)
-                framing.repair(path)
+                # recovery-time truncation: no append may interleave
+                # with the repair, so the fsync stays under the lock
+                framing.repair(path)  # pio: disable=lock-blocking-call
                 out[k] = (
                     os.path.getsize(path) if os.path.exists(path) else 0
                 )

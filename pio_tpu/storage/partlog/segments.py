@@ -116,7 +116,9 @@ class SegmentLog:
             if self._needs_repair:
                 self._close_fh()
                 path, size = self._segs[-1]
-                framing.repair(path)
+                # torn-tail repair must finish before any append runs,
+                # so its fsync deliberately holds the segment lock
+                framing.repair(path)  # pio: disable=lock-blocking-call
                 if os.path.getsize(path) != size:
                     raise base.StorageError(
                         f"partlog segment {path} lost committed bytes "
@@ -126,6 +128,9 @@ class SegmentLog:
             path, size = self._segs[-1]
             if self._fh is None:
                 self._fh = open(path, "ab")
+            # fault injection only sleeps when a latency rule is armed
+            # (tests); the production path returns immediately
+            # pio: disable=lock-blocking-call
             torn = failpoint("partlog.append.before_write", data)
             if torn is not None:
                 # injected torn write: persist a strict prefix and fail —
@@ -156,7 +161,9 @@ class SegmentLog:
             end = start + len(data)
             _APPENDS.inc(partition=self._label)
             if new_size >= self._seg_bytes:
-                self._seal()
+                # rollover seals + fsyncs under the lock on purpose:
+                # the next append must land in the new segment
+                self._seal()  # pio: disable=lock-blocking-call
             return start, end
 
     def _seal(self) -> None:
@@ -182,6 +189,9 @@ class SegmentLog:
         """Force-fsync the active segment (commit-durability flush)."""
         with self._lock:
             if self._fh is not None:
+                # the durability flush IS the serialization point —
+                # appends must not race the fsync of their own bytes
+                # pio: disable=lock-blocking-call
                 fsync_fileobj(self._fh)
 
     # -- reads ---------------------------------------------------------------

@@ -123,7 +123,9 @@ class SQLiteClient:
         self._init_lock = threading.Lock()
         with self._init_lock:
             conn = self.conn()
-            self._migrate(conn)
+            # the DDL commit inside _migrate is the same one-shot
+            # migration the suppression below covers
+            self._migrate(conn)  # pio: disable=lock-blocking-call
             # one-shot schema migration: serializing the commit is the
             # point (concurrent first-openers must not race the DDL)
             conn.commit()  # pio: disable=lock-blocking-call

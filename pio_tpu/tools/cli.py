@@ -14,6 +14,7 @@ import argparse
 import importlib
 import json
 import logging
+import os
 import sys
 import urllib.request
 from typing import Optional
@@ -714,26 +715,66 @@ def cmd_lint(args) -> int:
         return 0
 
     paths = args.paths or ["pio_tpu", "tests"]
-    if args.dump_failpoints:
+    if args.dump_failpoints or args.dump_callgraph or args.dump_effects:
         modules = []
         for path in collect_files(paths):
             parsed = parse_module(path)
             if hasattr(parsed, "tree"):   # skip unparsable files
                 modules.append(parsed)
-        print(json.dumps(
-            {"failpoints": failpoint_inventory(modules)},
-            indent=2, sort_keys=True,
-        ))
+        if args.dump_failpoints:
+            payload = {"failpoints": failpoint_inventory(modules)}
+        elif args.dump_callgraph:
+            from pio_tpu.analysis.effects import callgraph_inventory
+            payload = {"callgraph": callgraph_inventory(modules)}
+        else:
+            from pio_tpu.analysis.effects import (
+                effects_inventory,
+                frame_inventory,
+            )
+            payload = effects_inventory(modules)
+            payload["frames"] = frame_inventory(modules)
+        print(json.dumps(payload, indent=2, sort_keys=True))
         return 0
+
+    only = None
+    if args.changed:
+        only = _changed_py_files(args.base)
+        if only is not None and not only:
+            print("pio lint: no changed python files")
+            return 0
 
     rule_ids = args.rules.split(",") if args.rules else None
     try:
-        findings = run_lint(paths, rule_ids=rule_ids)
+        findings = run_lint(paths, rule_ids=rule_ids, only=only)
     except ValueError as exc:
         print(f"pio lint: {exc}", file=sys.stderr)
         return 2
     print(render_json(findings) if args.json else render_text(findings))
     return 1 if findings else 0
+
+
+def _changed_py_files(base: str):
+    """``git diff --name-only <base>`` filtered to .py, as absolute
+    paths — or None (fall back to a full lint) when git is unavailable."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", base, "--"],
+            capture_output=True, text=True, check=True,
+        ).stdout
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.CalledProcessError) as exc:
+        print(f"pio lint: --changed unavailable ({exc}); linting all",
+              file=sys.stderr)
+        return None
+    return [
+        os.path.join(top, line)
+        for line in out.splitlines()
+        if line.endswith(".py")
+    ]
 
 
 # -------------------------------------------------------------------- parser
@@ -1032,6 +1073,24 @@ def build_parser() -> argparse.ArgumentParser:
         "--dump-failpoints", action="store_true",
         help="machine-readable inventory of failpoint() call sites "
              "(cross-check chaos specs against real points)",
+    )
+    a.add_argument(
+        "--dump-callgraph", action="store_true",
+        help="resolved call edges (caller -> callees) as JSON",
+    )
+    a.add_argument(
+        "--dump-effects", action="store_true",
+        help="hot-path roots, per-function effect summaries and "
+             "frame-family census as JSON",
+    )
+    a.add_argument(
+        "--changed", action="store_true",
+        help="report findings only for files in `git diff --name-only "
+             "<base>` (whole tree still loads for call-graph context)",
+    )
+    a.add_argument(
+        "--base", default="HEAD", metavar="REV",
+        help="diff base for --changed (default: HEAD)",
     )
     a.set_defaults(fn=cmd_lint)
     return p

@@ -1,28 +1,39 @@
 #!/usr/bin/env bash
-# Run the project-native static analyzer over the tree (or over the
-# paths given as arguments). Exit 0 = clean, 1 = findings, 2 = usage.
+# Run the project-native static analyzer. Exit 0 = clean, 1 = findings,
+# 2 = usage.
 #
-#   scripts/lint.sh                 # whole tree (pio_tpu + tests)
-#   scripts/lint.sh pio_tpu/qos     # one subtree
+# Default is the fast path: findings only for files changed vs HEAD
+# (`pio lint --changed`), with the whole tree still loaded so the
+# interprocedural rules see full call-graph / frame-family context.
+#
+#   scripts/lint.sh                 # changed files vs HEAD (fast)
+#   scripts/lint.sh --all           # whole tree (pio_tpu + tests)
+#   scripts/lint.sh pio_tpu/qos     # one subtree (implies full lint)
 #   scripts/lint.sh --json          # machine-readable findings
 #
-# Flags are passed through to `pio lint` (--json, --rules ID[,ID...],
-# --list-rules, --dump-failpoints).
+# Other flags pass through to `pio lint` (--rules ID[,ID...],
+# --list-rules, --base REV, --dump-failpoints, --dump-callgraph,
+# --dump-effects).
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-args=("$@")
+args=()
 have_path=0
-for a in "${args[@]:-}"; do
+all=0
+for a in "$@"; do
     case "$a" in
-        --*) ;;
+        --all) all=1 ;;
+        --*) args+=("$a") ;;
         "") ;;
-        *) have_path=1 ;;
+        *) have_path=1; args+=("$a") ;;
     esac
 done
 if [ "$have_path" = 0 ]; then
     args+=(pio_tpu tests)
+    if [ "$all" = 0 ]; then
+        args+=(--changed)
+    fi
 fi
 
 exec python -m pio_tpu.tools.cli lint "${args[@]}"

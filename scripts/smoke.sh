@@ -42,6 +42,56 @@ python -m pio_tpu.tools.cli lint pio_tpu tests \
     || fail "pio lint found violations"
 echo "ok   pio lint clean"
 
+# The hot-path contract is CI-enforced here: the three interprocedural
+# rules must report zero findings on their own (not just be drowned in
+# a clean aggregate), the seeded roots must all be discovered, and the
+# effect fixpoint must stay within its latency budget on this host.
+python -m pio_tpu.tools.cli lint pio_tpu tests --json \
+    --rules hotpath-blocking,hotpath-zero-copy,shm-frame-layout \
+    | python -c '
+import json, sys
+doc = json.load(sys.stdin)
+assert doc["count"] == 0, f"hot-path/layout findings: {doc}"
+' || fail "hot-path contract rules not clean"
+echo "ok   hotpath-blocking / hotpath-zero-copy / shm-frame-layout clean"
+
+python -m pio_tpu.tools.cli lint --dump-effects pio_tpu | python -c '
+import json, sys
+doc = json.load(sys.stdin)
+roots = {r["function"].rsplit(".", 1)[-1] + ":" + r["marker"]
+         for r in doc["roots"]}
+need = {
+    "query:hotpath",              # query-server request handler
+    "_run:hotpath",               # _MicroBatcher dispatch / LaneDrainer
+    "submit:hotpath",             # _MicroBatcher admission
+    "dispatch_bucketed:hotpath",  # bucket executor
+    "submit:zerocopy",            # lane submit path
+    "pack_query_i8:zerocopy",     # int8 packed frame
+    "unpack_query_i8:zerocopy",
+}
+missing = need - roots
+assert not missing, f"hot-path roots missing from --dump-effects: {missing}"
+fams = doc["frames"]
+for fam in ("lane-slot", "metrics-stripe", "pel2-record"):
+    assert fams.get(fam, {}).get("verified"), f"frame family {fam}: {fams.get(fam)}"
+' || fail "--dump-effects roots/frames incomplete"
+echo "ok   dump-effects lists every seeded hot-path root + frame family"
+
+python - <<'PY' || fail "effect fixpoint exceeded 10s budget"
+import time
+from pio_tpu.analysis.core import Finding, collect_files, parse_module
+from pio_tpu.analysis.effects import EffectAnalysis
+
+mods = [m for m in (parse_module(p) for p in collect_files(["pio_tpu"]))
+        if not isinstance(m, Finding)]
+t0 = time.monotonic()
+EffectAnalysis(mods)
+dt = time.monotonic() - t0
+assert dt < 10.0, f"effect fixpoint took {dt:.1f}s (budget 10s)"
+print(f"     effect fixpoint over {len(mods)} modules: {dt:.2f}s")
+PY
+echo "ok   effect fixpoint within budget"
+
 # Boot: train the recommendation template on a tiny in-memory corpus,
 # serve it with a declared SLO, publish the ephemeral port, then park.
 python - "$PORT_FILE" <<'PY' &

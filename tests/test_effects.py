@@ -1,0 +1,388 @@
+"""Interprocedural effect analysis + frame-layout verifier units.
+
+Fixture modules live in string literals (the clean gate lints tests/
+too, and only sees constants here). The guard class at the bottom runs
+against the real tree: the three shipped frame families must each parse
+into at least one verified writer/reader pair, and the seeded hot-path
+roots must be discovered from their markers.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+from pio_tpu.analysis import run_lint
+from pio_tpu.analysis.core import Finding, collect_files, parse_module
+from pio_tpu.analysis.effects import (
+    EffectAnalysis,
+    effects_inventory,
+    frame_inventory,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def analyze(tmp_path, source, *, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    module = parse_module(str(p))
+    assert not isinstance(module, Finding), module
+    return EffectAnalysis([module])
+
+
+def lint_src(tmp_path, source, *, name="fixture.py", rules=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return run_lint([str(p)], rule_ids=rules)
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# effect-summary propagation
+
+
+class TestPropagation:
+    def test_direct_effects(self, tmp_path):
+        a = analyze(tmp_path, """
+        import json
+        import time
+
+        def f(payload):
+            time.sleep(0.1)
+            doc = json.loads(payload.decode("utf-8"))
+            items = [x for x in doc]
+            return items
+        """)
+        (qual,) = [q for q in a.fns if q.endswith(".f")]
+        assert a.trans[qual] >= {"blocks", "json_codec",
+                                 "copies_bytes", "allocates"}
+
+    def test_transitive_two_frames(self, tmp_path):
+        a = analyze(tmp_path, """
+        import time
+
+        def leaf():
+            time.sleep(0.1)
+
+        def mid():
+            leaf()
+
+        def top():
+            mid()
+        """)
+        top = next(q for q in a.fns if q.endswith(".top"))
+        assert "blocks" in a.trans[top]
+        sites = a.reachable_sites(top, ("blocks",))
+        assert len(sites) == 1
+        _site, chain = sites[0]
+        assert [q.rsplit(".", 1)[-1] for q in chain] == ["top", "mid", "leaf"]
+
+    def test_recursive_cycle_terminates(self, tmp_path):
+        a = analyze(tmp_path, """
+        import time
+
+        def ping(n):
+            if n:
+                pong(n - 1)
+
+        def pong(n):
+            time.sleep(0.01)
+            ping(n)
+        """)
+        ping = next(q for q in a.fns if q.endswith(".ping"))
+        pong = next(q for q in a.fns if q.endswith(".pong"))
+        assert "blocks" in a.trans[ping]
+        assert "blocks" in a.trans[pong]
+
+    def test_self_method_edges(self, tmp_path):
+        a = analyze(tmp_path, """
+        import time
+
+        class C:
+            def leaf(self):
+                time.sleep(0.1)
+
+            def top(self):
+                self.leaf()
+        """)
+        top = next(q for q in a.fns if q.endswith("C.top"))
+        assert "blocks" in a.trans[top]
+
+    def test_nested_def_not_attributed(self, tmp_path):
+        # a closure defined in f runs elsewhere (or never)
+        a = analyze(tmp_path, """
+        import time
+
+        def f():
+            def later():
+                time.sleep(1.0)
+            return later
+        """)
+        f = next(q for q in a.fns if q.endswith(".f"))
+        assert "blocks" not in a.trans[f]
+
+    def test_wallclock_informational(self, tmp_path):
+        a = analyze(tmp_path, """
+        import time
+
+        def f():
+            return time.time()
+        """)
+        f = next(q for q in a.fns if q.endswith(".f"))
+        assert a.trans[f] == {"wallclock"}
+
+
+# ---------------------------------------------------------------------------
+# hot-path root discovery + rule findings
+
+
+class TestHotpathRules:
+    def test_root_discovery_from_markers(self, tmp_path):
+        a = analyze(tmp_path, """
+        def plain():
+            pass
+
+        def handler(req):  # pio: hotpath
+            pass
+
+        # pio: hotpath=zerocopy
+        def packer(codes):
+            pass
+        """)
+        roots = {r.qual.rsplit(".", 1)[-1]: r.marker for r in a.roots()}
+        assert roots == {"handler": "", "packer": "zerocopy"}
+
+    def test_sleep_two_frames_down_is_finding_with_chain(self, tmp_path):
+        findings = lint_src(tmp_path, """
+        import time
+
+        def leaf():
+            time.sleep(0.1)
+
+        def mid():
+            leaf()
+
+        def handler(req):  # pio: hotpath
+            mid()
+        """, rules=["hotpath-blocking"])
+        assert rule_ids(findings) == ["hotpath-blocking"]
+        assert "handler -> mid -> leaf" in findings[0].message
+        assert "time.sleep" in findings[0].message
+
+    def test_seeded_json_below_zerocopy_root(self, tmp_path):
+        findings = lint_src(tmp_path, """
+        import json
+
+        def encode(body):
+            return json.dumps(body)
+
+        def submit(body):  # pio: hotpath=zerocopy
+            return encode(body)
+        """, rules=["hotpath-zero-copy"])
+        assert rule_ids(findings) == ["hotpath-zero-copy"]
+        assert "json_codec" in findings[0].message
+        assert "submit -> encode" in findings[0].message
+
+    def test_plain_hotpath_allows_json(self, tmp_path):
+        # json is only contraband on zerocopy roots
+        findings = lint_src(tmp_path, """
+        import json
+
+        def handler(body):  # pio: hotpath
+            return json.dumps(body)
+        """, rules=["hotpath-zero-copy"])
+        assert findings == []
+
+    def test_root_suppression_covers_reachable_findings(self, tmp_path):
+        # satellite: disable on the ROOT function suppresses findings
+        # attributed to it, not just same-line module findings
+        findings = lint_src(tmp_path, """
+        import time
+
+        def leaf():
+            time.sleep(0.1)
+
+        def handler(req):  # pio: hotpath  # pio: disable=hotpath-blocking
+            leaf()
+        """, rules=["hotpath-blocking"])
+        assert findings == []
+
+    def test_site_suppression_covers_every_root(self, tmp_path):
+        findings = lint_src(tmp_path, """
+        import time
+
+        def leaf():
+            # pio: disable=hotpath-blocking
+            time.sleep(0.1)
+
+        def a(req):  # pio: hotpath
+            leaf()
+
+        def b(req):  # pio: hotpath
+            leaf()
+        """, rules=["hotpath-blocking"])
+        assert findings == []
+
+    def test_edge_suppression_cuts_the_chain(self, tmp_path):
+        findings = lint_src(tmp_path, """
+        import time
+
+        def leaf():
+            time.sleep(0.1)
+
+        def handler(req):  # pio: hotpath
+            leaf()  # pio: disable=hotpath-blocking
+            time.sleep(0.2)
+        """, rules=["hotpath-blocking"])
+        # the direct sleep still fires; the call edge is cut
+        assert len(findings) == 1
+        assert "time.sleep" in findings[0].message
+        assert "leaf" not in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# frame-layout verifier
+
+
+class TestFrameLayout:
+    def test_field_count_mismatch(self, tmp_path):
+        findings = lint_src(tmp_path, """
+        import struct
+
+        def write(m, n, k):
+            struct.pack_into("<II", m, 0, n, k)  # pio: frame=hdr
+
+        def read(m):
+            return struct.unpack_from("<III", m, 0)  # pio: frame=hdr
+        """, rules=["shm-frame-layout"])
+        assert rule_ids(findings) == ["shm-frame-layout"]
+        text = " ".join(f.message for f in findings)
+        assert "hdr" in text and "field count" in text
+
+    def test_one_byte_size_mismatch(self, tmp_path):
+        # writer pads the record to 12 bytes, reader assumes 11
+        findings = lint_src(tmp_path, """
+        import struct
+
+        def write(m, a, b):
+            struct.pack_into("<QHBx", m, 0, a, b, 1)  # pio: frame=rec
+
+        def read(m):
+            return struct.unpack_from("<QHB", m, 0)  # pio: frame=rec
+        """, rules=["shm-frame-layout"])
+        assert rule_ids(findings) == ["shm-frame-layout"]
+        text = " ".join(f.message for f in findings)
+        assert "rec" in text and "byte size" in text
+
+    def test_endianness_mismatch(self, tmp_path):
+        findings = lint_src(tmp_path, """
+        import struct
+
+        def write(m, n, k):
+            struct.pack_into("<II", m, 0, n, k)  # pio: frame=hdr
+
+        def read(m):
+            return struct.unpack_from(">II", m, 0)  # pio: frame=hdr
+        """, rules=["shm-frame-layout"])
+        assert rule_ids(findings) == ["shm-frame-layout"]
+        assert any("endianness" in f.message for f in findings)
+
+    def test_matching_pair_is_clean(self, tmp_path):
+        findings = lint_src(tmp_path, """
+        import struct
+
+        HDR = struct.Struct("<QQI4x")  # pio: frame=slot
+
+        def write(m, off, a, b):
+            struct.pack_into("<Q", m, off, a)  # pio: frame=slot
+            struct.pack_into("<Q", m, off + 8, b)  # pio: frame=slot
+            struct.pack_into("<I", m, off + 16, 1)  # pio: frame=slot
+
+        def read(m, off):
+            return HDR.unpack_from(m, off)
+        """, rules=["shm-frame-layout"])
+        assert findings == []
+
+    def test_unassigned_struct_site_in_frame_module(self, tmp_path):
+        findings = lint_src(tmp_path, """
+        import struct
+
+        def write(m, n):
+            struct.pack_into("<I", m, 0, n)  # pio: frame=hdr
+
+        def sneak(m, n):
+            struct.pack_into("<H", m, 0, n)
+        """, rules=["shm-frame-layout"])
+        assert rule_ids(findings) == ["shm-frame-layout"]
+        assert any("not" in f.message and "assigned" in f.message
+                   for f in findings)
+
+    def test_reader_inside_magic(self, tmp_path):
+        findings = lint_src(tmp_path, """
+        import struct
+
+        MAGIC = b"PIOTEST1"
+
+        def write(f, n, k):
+            f.write(MAGIC)
+            # pio: frame=hdr
+            f.write(struct.pack("<II", n, k))
+
+        def read(head):
+            return struct.unpack_from("<II", head, 4)  # pio: frame=hdr
+        """, rules=["shm-frame-layout"])
+        assert any("magic" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# guards over the real tree
+
+
+class TestRealTree:
+    def _modules(self):
+        mods = []
+        for p in collect_files([os.path.join(REPO_ROOT, "pio_tpu")]):
+            m = parse_module(p)
+            if not isinstance(m, Finding):
+                mods.append(m)
+        return mods
+
+    def test_real_frame_families_verify(self):
+        fams = frame_inventory(self._modules())
+        for fam in ("lane-slot", "metrics-stripe", "pel2-record"):
+            assert fam in fams, f"frame family {fam} not discovered"
+            info = fams[fam]
+            assert info["writers"] >= 1, (fam, info)
+            assert info["readers"] >= 1, (fam, info)
+            assert info["verified"], (fam, info)
+        assert fams["lane-slot"]["fields"] == 5
+        assert fams["lane-slot"]["extent"] == 28
+
+    def test_seeded_roots_discovered(self):
+        inv = effects_inventory(self._modules())
+        roots = {r["function"] for r in inv["roots"]}
+        expected = {
+            "pio_tpu.server.query_server.QueryServerService.query",
+            "pio_tpu.server.query_server._MicroBatcher._run",
+            "pio_tpu.server.query_server._MicroBatcher.submit",
+            "pio_tpu.server.bucketcache.dispatch_bucketed",
+            "pio_tpu.server.batchlane.LaneClient.submit",
+            "pio_tpu.server.batchlane.LaneDrainer._run",
+            "pio_tpu.server.batchlane.pack_query_i8",
+            "pio_tpu.server.batchlane.unpack_query_i8",
+        }
+        missing = expected - roots
+        assert not missing, f"hot-path roots missing: {missing}"
+
+    def test_reexport_chain_resolves_failpoint(self):
+        # `from pio_tpu.faults import failpoint` goes through the
+        # package __init__ re-export; the summary machinery must land
+        # on the def in faults/registry.py for the sleep to be visible
+        a = EffectAnalysis(self._modules())
+        target = a.resolve("pio_tpu.faults.failpoint")
+        assert target == "pio_tpu.faults.registry.failpoint"
+        assert "blocks" in a.trans[target]

@@ -40,7 +40,7 @@ class TestFramework:
         rules = all_rules().values()
         assert len(rules) >= 8
         families = {r.family for r in rules}
-        assert families == {"concurrency", "convention"}
+        assert families >= {"concurrency", "convention", "hotpath", "layout"}
 
     def test_parse_error_is_a_finding(self, tmp_path):
         findings = lint_src(tmp_path, "def broken(:\n")
@@ -186,6 +186,53 @@ class TestLockBlockingCall:
             return later
         """
         assert lint_src(tmp_path, src, rules=["lock-blocking-call"]) == []
+
+    # interprocedural pair: the blocking call is one frame below the
+    # lock body, visible only through the effect summaries
+    BAD_DEEP = """
+    import threading
+    import time
+
+    def slow_flush():
+        time.sleep(0.5)
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def f(self):
+            with self._lock:
+                slow_flush()
+    """
+
+    CLEAN_DEEP = """
+    import threading
+    import time
+
+    def slow_flush():
+        time.sleep(0.5)
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def f(self):
+            with self._lock:
+                x = 1
+            slow_flush()
+            return x
+    """
+
+    def test_blocking_one_frame_down_flagged(self, tmp_path):
+        findings = lint_src(tmp_path, self.BAD_DEEP,
+                            rules=["lock-blocking-call"])
+        assert rule_ids(findings) == ["lock-blocking-call"]
+        assert "slow_flush" in findings[0].message
+        assert "time.sleep" in findings[0].message
+
+    def test_blocking_one_frame_down_clean(self, tmp_path):
+        assert lint_src(tmp_path, self.CLEAN_DEEP,
+                        rules=["lock-blocking-call"]) == []
 
 
 class TestCvWaitOutsideLoop:

@@ -11,8 +11,18 @@ from __future__ import annotations
 import os
 
 from pio_tpu.analysis import run_lint
+from pio_tpu.analysis.core import all_rules
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_interprocedural_rules_registered():
+    """The hot-path contract rules run as part of the clean gate —
+    losing one of them would silently drop the CI enforcement."""
+    rules = all_rules()
+    for rid in ("hotpath-blocking", "hotpath-zero-copy",
+                "shm-frame-layout", "lock-blocking-call"):
+        assert rid in rules, f"rule {rid} missing from registry"
 
 
 def test_repo_is_lint_clean():
