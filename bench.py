@@ -573,6 +573,294 @@ def _bench_resident_serving(n_queries: int) -> dict:
         Storage.reset()
 
 
+def _bench_evfront(n_queries: int) -> dict:
+    """Event-loop HTTP front vs the threaded baseline (ISSUE 13): the
+    same trained classification engine served over HTTP behind both
+    fronts (``PIO_TPU_HTTP_FRONT``), each driven by a serial raw-socket
+    keep-alive client so every request's wall time is a clean e2e
+    sample. The threaded front serves the JSON wire; the evloop front
+    serves the packed int8 wire — the deployment the tentpole ships.
+    Records per-front qps / p50 / admit+parse+serialize share of e2e,
+    the evloop attributedFraction, and the speedup. Acceptance bar:
+    evloop-packed >= 1.5x threaded-json qps with lower p50 and a
+    strictly smaller overhead share on the same host."""
+    import datetime as dtm
+    import socket as socketlib
+
+    import pio_tpu.templates  # noqa: F401  (registers engine factories)
+    from pio_tpu.controller import ComputeContext
+    from pio_tpu.data import Event
+    from pio_tpu.server import create_query_server
+    from pio_tpu.server.http import PACKED_QUERY_CONTENT_TYPE
+    from pio_tpu.storage import Storage
+    from pio_tpu.storage.records import App
+    from pio_tpu.workflow.core_workflow import run_train
+    from pio_tpu.workflow.engine_json import build_engine, variant_from_dict
+
+    saved = {
+        k: os.environ.get(k)
+        for k in (
+            "PIO_TPU_DEVICE_RESIDENT", "PIO_TPU_SERVE_WIRE",
+            "PIO_TPU_BATCH_BUCKETS", "PIO_TPU_BUCKET_WARMUP",
+            "PIO_TPU_HTTP_FRONT",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE",
+            "PIO_STORAGE_SOURCES_MEM_TYPE",
+        )
+    }
+    # in-memory storage throughout: this stage measures the HTTP front
+    # and the wire, not the storage backend — a sqlite-backed store
+    # adds a per-request cost that compresses the front-to-front ratio
+    os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "MEM"
+    os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "MEM"
+    os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "MEM"
+    os.environ["PIO_STORAGE_SOURCES_MEM_TYPE"] = "memory"
+    # packed wire requires a device-resident int8 scorer on both fronts
+    os.environ["PIO_TPU_DEVICE_RESIDENT"] = "1"
+    os.environ["PIO_TPU_SERVE_WIRE"] = "int8"
+    os.environ["PIO_TPU_BATCH_BUCKETS"] = "1,2,4"
+    os.environ["PIO_TPU_BUCKET_WARMUP"] = "1"
+    Storage.reset()
+    try:
+        app_id = Storage.get_meta_data_apps().insert(App(0, "bench-evfront"))
+        le = Storage.get_levents()
+        t0 = dtm.datetime(2026, 3, 1, tzinfo=dtm.timezone.utc)
+        rng = np.random.default_rng(7)
+        n = 0
+        for plan, hot in (("basic", 0), ("premium", 1), ("pro", 2)):
+            for _ in range(8):
+                attrs = rng.integers(0, 3, size=3)
+                attrs[hot] += 6
+                props = {f"attr{j}": int(attrs[j]) for j in range(3)}
+                props["plan"] = plan
+                le.insert(
+                    Event("$set", "user", f"u{n}", properties=props,
+                          event_time=t0 + dtm.timedelta(minutes=n)),
+                    app_id,
+                )
+                n += 1
+        variant = variant_from_dict({
+            "id": "bench-evfront",
+            "engineFactory": "templates.classification",
+            "datasource": {"params": {"app_name": "bench-evfront"}},
+            "algorithms": [{"name": "logreg", "params": {}}],
+        })
+        engine, ep = build_engine(variant)
+        # no mesh: a size-1 mesh would pin a per-request explicit
+        # device_put (sharded h2d path) on the scorer, burying the
+        # front-to-front difference this stage exists to measure
+        ctx = ComputeContext.local(seed=0)
+        run_train(engine, ep, variant, ctx=ctx)
+
+        body = {"attrs": [9.0, 1.0, 1.0]}
+        json_payload = json.dumps(body).encode("utf-8")
+
+        def mk_req(payload, ctype):
+            return (b"POST /queries.json HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Type: " + ctype.encode("latin-1") + b"\r\n"
+                    b"Content-Length: " + str(len(payload)).encode() +
+                    b"\r\n\r\n" + payload)
+
+        def read_one(sock, buf):
+            # pop one Content-Length-framed response off the socket
+            while True:
+                he = buf.find(b"\r\n\r\n")
+                if he >= 0:
+                    cl = 0
+                    for hline in bytes(buf[:he]).lower().split(b"\r\n"):
+                        if hline.startswith(b"content-length:"):
+                            cl = int(hline.split(b":", 1)[1])
+                    if len(buf) >= he + 4 + cl:
+                        out = bytes(buf[he + 4:he + 4 + cl])
+                        del buf[:he + 4 + cl]
+                        return out
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise RuntimeError("keep-alive connection closed")
+                buf += chunk
+
+        def window(port, req, total):
+            # ONE keep-alive connection, serial requests: every sample
+            # is clean unloaded e2e latency — a concurrent client would
+            # fold queueing delay into p50
+            s = socketlib.create_connection(("127.0.0.1", port))
+            s.setsockopt(socketlib.IPPROTO_TCP, socketlib.TCP_NODELAY, 1)
+            buf = bytearray()
+            lats = []
+            try:
+                w0 = time.perf_counter()
+                for _ in range(total):
+                    q0 = time.perf_counter()
+                    s.sendall(req)
+                    read_one(s, buf)
+                    lats.append(time.perf_counter() - q0)
+                took = time.perf_counter() - w0
+            finally:
+                s.close()
+            lats.sort()
+            return {
+                "qps": round(total / took, 1),
+                "p50_ms": round(lats[len(lats) // 2] * 1e3, 3),
+            }
+
+        def pooled(port, req, n_conns, total):
+            # the ISSUE-13 deployment shape: many keep-alive client
+            # connections, one outstanding request each, multiplexed in
+            # ONE client thread (a thread-per-connection client would
+            # spend more GIL time than either front under test). Each
+            # sample is one connection's send→response wall time, so
+            # p50 includes the server-side queueing the load creates.
+            import selectors as sel_mod
+
+            sel = sel_mod.DefaultSelector()
+            socks = []
+            for _ in range(n_conns):
+                s = socketlib.create_connection(("127.0.0.1", port))
+                s.setblocking(False)
+                s.setsockopt(
+                    socketlib.IPPROTO_TCP, socketlib.TCP_NODELAY, 1
+                )
+                socks.append(s)
+                sel.register(s, sel_mod.EVENT_READ, [bytearray(), 0.0])
+            sent = done = 0
+            lats = []
+            try:
+                w0 = time.perf_counter()
+                for s in socks:
+                    sel.get_key(s).data[1] = time.perf_counter()
+                    s.sendall(req)
+                    sent += 1
+                while done < total:
+                    for key, _ in sel.select(10):
+                        s, d = key.fileobj, key.data
+                        buf = d[0]
+                        chunk = s.recv(65536)
+                        if not chunk:
+                            raise RuntimeError(
+                                "keep-alive connection closed"
+                            )
+                        buf += chunk
+                        he = buf.find(b"\r\n\r\n")
+                        while he >= 0:
+                            cl = 0
+                            for hline in bytes(buf[:he]).lower() \
+                                    .split(b"\r\n"):
+                                if hline.startswith(b"content-length:"):
+                                    cl = int(hline.split(b":", 1)[1])
+                            if len(buf) < he + 4 + cl:
+                                break
+                            del buf[:he + 4 + cl]
+                            done += 1
+                            lats.append(time.perf_counter() - d[1])
+                            if sent < total:
+                                d[1] = time.perf_counter()
+                                s.sendall(req)
+                                sent += 1
+                            he = buf.find(b"\r\n\r\n")
+                took = time.perf_counter() - w0
+            finally:
+                for s in socks:
+                    sel.unregister(s)
+                    s.close()
+                sel.close()
+            lats.sort()
+            return {
+                "qps": round(total / took, 1),
+                "p50_ms": round(lats[len(lats) // 2] * 1e3, 3),
+            }
+
+        def get_json(port, path):
+            s = socketlib.create_connection(("127.0.0.1", port))
+            s.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+            try:
+                return json.loads(read_one(s, bytearray()))
+            finally:
+                s.close()
+
+        servers = {}
+        fronts = {}
+        try:
+            for front, wire in (("threaded", "json"), ("evloop", "packed")):
+                os.environ["PIO_TPU_HTTP_FRONT"] = front
+                server, svc = create_query_server(
+                    variant, host="127.0.0.1", port=0, ctx=ctx
+                )
+                server.start()
+                if wire == "json":
+                    req = mk_req(json_payload, "application/json")
+                else:
+                    req = mk_req(svc.pack_query_body(body),
+                                 PACKED_QUERY_CONTENT_TYPE)
+                servers[front] = (server, req, wire)
+                window(server.port, req, max(32, n_queries // 8))  # settle
+            # Phase 1 — interleaved serial windows (best-of-2): clean
+            # unloaded e2e latency, and the cumulative traffic the
+            # /debug/hotpath.json stage shares are computed over stays
+            # pure serial (pooled load would fold queueing into e2e and
+            # mechanically shrink every stage's share)
+            for _ in range(2):
+                for front, (server, req, wire) in servers.items():
+                    w = window(server.port, req, n_queries)
+                    cur = fronts.setdefault(
+                        front,
+                        {"wire": wire, "serial_qps": w["qps"],
+                         "serial_p50_ms": w["p50_ms"]},
+                    )
+                    cur["serial_qps"] = max(cur["serial_qps"], w["qps"])
+                    cur["serial_p50_ms"] = min(
+                        cur["serial_p50_ms"], w["p50_ms"]
+                    )
+            for front, (server, req, wire) in servers.items():
+                hp = get_json(server.port, "/debug/hotpath.json")
+                e2e = hp["e2e"]["avgMs"]
+                overhead = sum(
+                    st["avgMs"] for st in hp.get("stages", ())
+                    if st["stage"] in ("admit", "parse", "serialize")
+                )
+                fronts[front]["overhead_share"] = round(
+                    overhead / max(1e-9, e2e), 4
+                )
+                if front == "evloop":
+                    fronts[front]["attributed_fraction"] = hp.get(
+                        "attributedFraction"
+                    )
+            # Phase 2 — interleaved pooled windows (best-of-3): the
+            # headline. Both servers stay up and windows alternate front
+            # by front, so host scheduling drift on a shared single-core
+            # box lands on BOTH sides of the ratio instead of biasing
+            # whichever front ran second.
+            for _ in range(3):
+                for front, (server, req, wire) in servers.items():
+                    p = pooled(server.port, req, 16, 2 * n_queries)
+                    cur = fronts[front]
+                    if p["qps"] > cur.get("qps", 0.0):
+                        cur["qps"] = p["qps"]
+                        cur["pooled_p50_ms"] = p["p50_ms"]
+        finally:
+            for server, _, _ in servers.values():
+                server.stop()
+
+        ev, th = fronts["evloop"], fronts["threaded"]
+        # headline: pooled-load qps, unloaded e2e p50 (the pooled p50
+        # is queueing-dominated at saturation and tracks conns/qps, not
+        # the front's per-request cost)
+        return {
+            "qps": ev["qps"],
+            "p50_ms": ev["serial_p50_ms"],
+            "speedup_x": round(ev["qps"] / max(1e-9, th["qps"]), 2),
+            "evloop": ev,
+            "threaded": th,
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        Storage.reset()
+
+
 def _overload_stage(port: int, n_users: int, n_threads=16,
                     per_thread=40) -> dict:
     """16 threads at full speed against a rate-limited server; unlike
@@ -1942,6 +2230,8 @@ def build_summary(full: dict, full_path: str = "BENCH_FULL.json") -> dict:
         "host_cores": get("serving", "pool", "host_cores"),
         "sharded_qps": get("serving", "sharded", "qps"),
         "sharded_scaling_x": get("serving", "sharded", "scaling_x"),
+        "evfront_qps": get("serving", "evfront", "qps"),
+        "evfront_p50_ms": get("serving", "evfront", "p50_ms"),
         "serving_attributed": get(
             "serving", "latency_budget", "attributedFraction"
         ),
@@ -2107,6 +2397,8 @@ HISTORY_FIELDS = (
     ("value", "up"),                 # headline examples/sec/chip
     ("serving_qps", "up"),
     ("pool_qps", "up"),
+    ("evfront_qps", "up"),
+    ("evfront_p50_ms", "down"),
     ("p50_predict_ms", "down"),
     ("p95_predict_ms", "down"),
     ("serving_attributed", "up"),    # latency-attribution coverage
@@ -2152,6 +2444,8 @@ def history_record(full: dict, summary: dict,
         "vs_baseline": summary.get("vs_baseline"),
         "serving_qps": summary.get("serving_qps"),
         "pool_qps": summary.get("pool_qps"),
+        "evfront_qps": summary.get("evfront_qps"),
+        "evfront_p50_ms": summary.get("evfront_p50_ms"),
         "p50_predict_ms": summary.get("p50_predict_ms"),
         "p95_predict_ms": conc.get("p95_ms"),
         "serving_attributed": summary.get("serving_attributed"),
@@ -2384,6 +2678,10 @@ def main() -> None:
         )
     except Exception as exc:
         print(f"# resident serving stage failed: {exc}", file=sys.stderr)
+    try:
+        serving["evfront"] = _bench_evfront(min(n_queries, 400))
+    except Exception as exc:
+        print(f"# evfront serving stage failed: {exc}", file=sys.stderr)
     p50_server = serving.get("p50_ms")
 
     # CPU anchor: same XLA program, single host CPU device, subsampled edges.

@@ -97,6 +97,15 @@ class _Cell:
             if self._seg is not None:
                 self._seg.set(self._widx, self._slot, self._v)
 
+    def inc(self, v: float = 1.0) -> None:
+        """Bound-cell fast path: per-request code resolves ``labels()``
+        once at setup and bumps the cell directly — label-tuple
+        stringification and the registry dict lookup cost more than the
+        add itself on hot paths. Counter callers must keep v >= 0 (the
+        family-level ``Counter.inc`` enforces it; this deliberately
+        doesn't, so gauge cells can decrement)."""
+        self._add(v)
+
     def _set(self, v: float) -> None:
         with self._lock:
             self._v = v

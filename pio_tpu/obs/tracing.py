@@ -266,6 +266,11 @@ class Tracer:
         self.slow_threshold_fn: Optional[Callable[[], Optional[float]]] = None
         self._extra = dict(extra_labels or {})
         self._hist = None
+        #: stage -> bound histogram cell; every span lands ~4-8 observes
+        #: per request on the serving hot path, so per-observe labels()
+        #: resolution (tuple build + stringify + registry lookup) costs
+        #: more than the bucket update itself
+        self._stage_cells: Dict[str, object] = {}
         if registry is not None:
             labelnames = tuple(self._extra) + ("stage",)
             self._hist = registry.histogram(
@@ -277,7 +282,9 @@ class Tracer:
             # pre-create the declared stage cells so pool-mode binding
             # (registration-order slot layout) sees them at init time
             for stage in stages:
-                self._hist.labels(*(tuple(self._extra.values()) + (stage,)))
+                self._stage_cells[stage] = self._hist.labels(
+                    *(tuple(self._extra.values()) + (stage,))
+                )
 
     def set_worker(self, worker: int) -> None:
         """Namespace generated trace ids per pool worker
@@ -289,10 +296,17 @@ class Tracer:
 
     def _observe(self, stage: str, dur_s: float,
                  trace_id: Optional[str] = None) -> None:
-        if self._hist is not None:
-            self._hist.labels(
+        if self._hist is None:
+            return
+        cell = self._stage_cells.get(stage)
+        if cell is None:
+            # undeclared stage: resolve once, then serve from the cache
+            # (benign race — labels() hands every caller the same cell)
+            cell = self._hist.labels(
                 *(tuple(self._extra.values()) + (stage,))
-            ).observe(dur_s, exemplar=trace_id)
+            )
+            self._stage_cells[stage] = cell
+        cell.observe(dur_s, exemplar=trace_id)
 
     def _maybe_slow(self, t: Trace) -> None:
         """Move ``t`` into the slow ring if it breaches the threshold

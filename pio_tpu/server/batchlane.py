@@ -139,6 +139,18 @@ def unpack_query_i8(payload: bytes) -> PackedQuery:  # pio: hotpath=zerocopy
     )
 
 
+def packed_frame_ok(frame) -> bool:  # pio: hotpath=zerocopy
+    """Structural check of a packed frame (any bytes-like) WITHOUT
+    decoding it: magic matches and the declared dim accounts for the
+    length exactly. The HTTP fast path gates on this before shipping
+    body bytes straight into the shm ring — a malformed frame must be a
+    client 400, not a drainer-side ValueError burning a lane slot."""
+    if len(frame) < _PACKED_HDR.size:
+        return False
+    magic, dim = _PACKED_HDR.unpack_from(frame)
+    return magic == PACKED_MAGIC and len(frame) == _PACKED_HDR.size + dim
+
+
 class LaneFallback(Exception):
     """Lane unavailable for this request (stripe full, oversize body,
     response timeout, oversize/failed response) — the caller serves the
@@ -365,29 +377,15 @@ class LaneClient:
         return None
 
     # pio: hotpath=zerocopy
-    def submit(self, body: dict, timeout_s: Optional[float] = None,
-               packed: Optional[bytes] = None):
-        """Serve one query body through the device worker; blocks until
-        the response lands or the timeout elapses. Raises
-        :class:`LaneFallback` whenever the lane cannot answer — the
-        caller's local predict path is the degradation, so the lane can
-        never make a request fail that would have succeeded without it.
-
-        ``packed`` ships a pre-encoded binary frame (``pack_query_i8``)
-        instead of JSON-encoding ``body`` — the int8 wire's request
-        direction."""
+    def _submit_payload(self, payload,
+                        timeout_s: Optional[float] = None):
+        """Ship one pre-encoded request payload (bytes or memoryview)
+        through the ring and park until the drainer answers or the
+        timeout elapses; returns ``(status, response_bytes)``. The
+        payload is written straight into the shm slot by post_request —
+        this function never copies or re-encodes it. Raises
+        :class:`LaneFallback` whenever the lane cannot answer."""
         failpoint("batchlane.submit")
-        if packed is not None:
-            payload = packed
-        else:
-            try:
-                # legacy JSON envelope for un-packed callers; the
-                # packed int8 branch above is the zero-copy wire
-                # (ROADMAP item 1 retires this encode)
-                # pio: disable=hotpath-zero-copy
-                payload = json.dumps(body).encode("utf-8")
-            except (TypeError, ValueError):
-                raise LaneFallback("unserializable")
         if len(payload) > self._seg.payload_bytes:
             raise LaneFallback("oversize")
         slot = self._acquire_slot()
@@ -414,19 +412,61 @@ class LaneClient:
             # is synchronous RPC, the caller expects to park here
             # pio: disable=hotpath-blocking
             self._resp_event.wait(0.002)
-        status, payload = got
+        status, resp = got
         self._seg.release(self._idx, slot, seq)
         with self._alloc_lock:
             self._busy.discard(slot)
+        return status, resp
+
+    # pio: hotpath=zerocopy
+    def submit(self, body: dict, timeout_s: Optional[float] = None,
+               packed: Optional[bytes] = None):
+        """Serve one query body through the device worker; blocks until
+        the response lands or the timeout elapses. Raises
+        :class:`LaneFallback` whenever the lane cannot answer — the
+        caller's local predict path is the degradation, so the lane can
+        never make a request fail that would have succeeded without it.
+
+        ``packed`` ships a pre-encoded binary frame (``pack_query_i8``)
+        instead of JSON-encoding ``body`` — the int8 wire's request
+        direction. Callers that also want the RESPONSE undecoded use
+        :meth:`submit_packed` instead."""
+        if packed is not None:
+            payload = packed
+        else:
+            try:
+                # legacy JSON envelope for un-packed callers; the
+                # packed int8 branch above is the zero-copy wire
+                # (ROADMAP item 1 retires this encode)
+                # pio: disable=hotpath-zero-copy
+                payload = json.dumps(body).encode("utf-8")
+            except (TypeError, ValueError):
+                raise LaneFallback("unserializable")
+        status, resp = self._submit_payload(payload, timeout_s)
         if status != STATUS_OK:
             raise LaneFallback("remote_error")
         try:
             # legacy JSON envelope decode, mirror of the encode
             # above (packed responses bypass submit entirely)
             # pio: disable=hotpath-zero-copy
-            return json.loads(payload.decode("utf-8"))
+            return json.loads(resp.decode("utf-8"))
         except (UnicodeDecodeError, ValueError):
             raise LaneFallback("undecodable_response")
+
+    # pio: hotpath=zerocopy
+    def submit_packed(self, packed,
+                      timeout_s: Optional[float] = None) -> bytes:
+        """Raw-frame submit for the zero-copy HTTP ingest: ``packed``
+        is an already-wire-shaped frame (bytes or a memoryview into the
+        front's connection buffer) and the return value is the
+        drainer's response payload UNDECODED — already JSON bytes that
+        the front hands straight to the response writer. Socket → shm
+        ring → socket with no codec and no intermediate copies on this
+        side of the lane."""
+        status, resp = self._submit_payload(packed, timeout_s)
+        if status != STATUS_OK:
+            raise LaneFallback("remote_error")
+        return resp
 
 
 class LaneDrainer:

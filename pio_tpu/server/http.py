@@ -4,6 +4,12 @@ The reference runs spray [v0.11] / akka-http [v0.12] actor systems; here a
 stdlib ``ThreadingHTTPServer`` with a route table does the same job with no
 external dependencies. Handlers receive a :class:`Request` and return
 ``(status, json_body)``.
+
+The query server can swap this thread-per-connection front for the
+selectors event loop in :mod:`pio_tpu.server.evfront`
+(``PIO_TPU_HTTP_FRONT=evloop``); both fronts share the Router/Request
+contract, the response head caches, and the knobs below, so handlers
+never know which front carried them.
 """
 
 from __future__ import annotations
@@ -56,6 +62,32 @@ _SPOOL_BYTES = 8 << 20
 MAX_JSON_BODY_MB = _env_float("PIO_TPU_MAX_JSON_BODY_MB", 64.0)
 
 
+def http_backlog() -> int:
+    """Listen backlog shared by both HTTP fronts, read at server
+    construction (not import) so one process can honor a changed env
+    between server boots. socketserver's default of 5 overflowed under
+    a 16-client connect burst; 128 keeps dropped-SYN retransmits out of
+    the serving p95."""
+    return envutil.env_int("PIO_TPU_HTTP_BACKLOG", 128, positive=True)
+
+
+def http_idle_timeout_s() -> float:
+    """Idle/slowloris guard shared by both fronts: a connection that
+    produces no bytes for this long is closed. On the threaded front it
+    bounds how long a parked per-connection thread survives; on the
+    event loop it bounds the connection table."""
+    return envutil.env_float("PIO_TPU_HTTP_IDLE_TIMEOUT_S", 30.0,
+                             positive=True)
+
+
+#: Content type of the packed int8 binary query wire: the request body
+#: IS a batch-lane frame (``pack_query_i8`` layout — NUL-led magic +
+#: dim + codes). Both fronts hand it to the handler untouched via
+#: :attr:`Request.packed` — no JSON attempt, no decode; the event-loop
+#: front passes a zero-copy view into its connection buffer.
+PACKED_QUERY_CONTENT_TYPE = "application/x-pio-query-i8"
+
+
 def keys_equal(provided: str, expected: str) -> bool:
     """Constant-time access-key comparison (no prefix-length timing leak)."""
     return hmac.compare_digest(
@@ -89,6 +121,12 @@ class Request:
     #: seconds once the reply is flushed — the "write" stage (the handler
     #: has long returned by then, so tracing needs a callback)
     on_written: Optional[Callable[[float], None]] = None
+    #: body bytes of a :data:`PACKED_QUERY_CONTENT_TYPE` request —
+    #: ``bytes`` from the threaded front, a ``memoryview`` into the
+    #: connection's read buffer from the event loop (valid only for the
+    #: duration of the handler call; the front reclaims the buffer after
+    #: dispatch). ``body``/``raw_body`` stay empty for these requests.
+    packed: Optional[Any] = None
 
     def header(self, name: str, default: Optional[str] = None):
         return self.headers.get(name.lower(), default)
@@ -279,7 +317,11 @@ def _ctype_line(ctype: str) -> bytes:
 #: per-response allocation. Thread-local, NOT per-connection: handlers
 #: are not strictly confined to their accept thread (the batch-lane
 #: drainer answers laned requests from its own thread), and a shared
-#: bytearray would interleave two responses' bytes.
+#: bytearray would interleave two responses' bytes. The single-threaded
+#: event-loop front cannot use this at all — one thread serves every
+#: connection, so it keeps a write buffer PER CONNECTION instead (see
+#: evfront._Conn.obuf); sharing this one would alias pipelined
+#: responses across connections.
 _obuf_local = threading.local()
 
 
@@ -326,6 +368,11 @@ def _make_handler_class(
         rbufsize = 64 * 1024
         wbufsize = 64 * 1024
         disable_nagle_algorithm = True
+        # socket timeout = the shared idle/slowloris guard: a keep-alive
+        # connection (or a stalled mid-request read) that produces no
+        # bytes within the window raises and the thread exits instead of
+        # parking forever. Read once per server construction.
+        timeout = http_idle_timeout_s()
 
         command = ""  # current request method (HEAD gates body writes)
         http10 = False  # current request is HTTP/1.0 (keep-alive echo)
@@ -591,7 +638,14 @@ def _make_handler_class(
                     self._reject(400, "incomplete body")
                     return
             body = None
-            if raw:
+            packed = None
+            if raw and ctype.startswith(PACKED_QUERY_CONTENT_TYPE):
+                # packed binary query wire: the body is a lane frame —
+                # no JSON attempt, no text decode; the handler consumes
+                # req.packed (parity twin of the event-loop fast path)
+                packed = raw
+                raw = b""
+            elif raw:
                 # Try JSON regardless of Content-Type — real clients (curl
                 # -d without -H) post JSON bodies under the default form
                 # type. Non-JSON bodies stay raw strings; handlers that
@@ -610,6 +664,7 @@ def _make_handler_class(
                 body_file=body_file,
                 headers=headers,
                 client_addr=self.client_address[0],
+                packed=packed,
             )
             if t_accept is not None:
                 req.read_s = monotonic_s() - t_accept
@@ -684,9 +739,11 @@ class _TLSThreadingHTTPServer(ThreadingHTTPServer):
 
     ssl_ctx: Optional[ssl.SSLContext] = None
     handshake_timeout = 30.0
-    #: socketserver's default listen backlog is 5 — a 16-client burst
+    #: listen backlog (socketserver default is 5 — a 16-client burst
     #: overflows it and the dropped SYNs retransmit after ~1 s, which
-    #: shows up directly as a serving p95 spike under concurrent load
+    #: shows up directly as a serving p95 spike under concurrent load);
+    #: overwritten per instance from PIO_TPU_HTTP_BACKLOG in
+    #: JsonHTTPServer.__init__, kept as a class default for direct users
     request_queue_size = 128
     #: SO_REUSEPORT before bind — lets N worker processes share one port
     #: with kernel-level connection balancing (serving pool mode)
@@ -741,6 +798,7 @@ class JsonHTTPServer:
             bind_and_activate=False,
         )
         self._httpd.reuse_port = reuse_port
+        self._httpd.request_queue_size = http_backlog()
         try:
             self._httpd.server_bind()
             self._httpd.server_activate()

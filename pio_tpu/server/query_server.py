@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import datetime as _dt
+import json
 import logging
 import os
 import threading
@@ -49,14 +50,15 @@ from pio_tpu.qos import (
 from pio_tpu.utils import envutil
 from pio_tpu.server.batchlane import (
     BatchLaneSegment, LaneClient, LaneDrainer, LaneFallback, PackedQuery,
-    pack_query_i8,
+    pack_query_i8, packed_frame_ok, unpack_query_i8,
 )
 from pio_tpu.server.bucketcache import (
     BucketExecutionCache, dispatch_bucketed,
 )
 from pio_tpu.server.http import (
-    HTTPError, JsonHTTPServer, Request, Router, float_param, int_param,
-    json_response, keys_equal, metrics_response,
+    HTTPError, JsonHTTPServer, RawResponse, Request, Router, float_param,
+    int_param, json_response, keys_equal, metrics_response,
+    ssl_context_from_env,
 )
 from pio_tpu.storage import Storage
 from pio_tpu.workflow.core_workflow import load_models_for_instance
@@ -539,6 +541,25 @@ class QueryServerService:
         self._query_errors_total.labels(eng)
         self._request_cell = self._request_hist.labels(eng)
         self._e2e_cell = self._e2e_hist.labels(eng)
+        #: set by mark_evloop_front() when the evloop HTTP front serves
+        #: this service: handlers run inline in the event loop, so the
+        #: micro-batcher's blocking hand-off must be bypassed
+        self._evloop_front = False
+        self._parse_fastpath_total = self.obs.counter(
+            "pio_tpu_http_parse_fastpath_total",
+            "Packed binary query requests by outcome: hit = zero-copy "
+            "socket→lane frame, local = served by the local packed "
+            "fallback, invalid = malformed frame (400), unavailable = "
+            "no single int8 resident scorer to decode it (400)",
+            ("outcome",),
+        )
+        #: bound outcome cells — the packed hot path bumps one per
+        #: request; labels() resolution there would cost more than the
+        #: increment (see _Cell.inc)
+        self._fastpath_cells = {
+            outcome: self._parse_fastpath_total.labels(outcome)
+            for outcome in ("hit", "local", "invalid", "unavailable")
+        }
         self.tracer = Tracer(
             "query", registry=self.obs,
             stages=QUERY_STAGES + QUERY_SUBSTAGES,
@@ -935,11 +956,19 @@ class QueryServerService:
             return []
         eng = self.variant.engine_id
 
+        # bound cells: these callbacks run inside every score_wire
+        # dispatch — per-call labels() resolution is measurable there
+        h2d_cell = self._h2d_bytes_total.labels(eng)
+        donation_cells = {
+            outcome: self._donation_total.labels(eng, outcome)
+            for outcome in ("hit", "miss")
+        }
+
         def on_h2d(nbytes: int) -> None:
-            self._h2d_bytes_total.inc(nbytes, engine_id=eng)
+            h2d_cell.inc(float(nbytes))
 
         def on_donation(outcome: str) -> None:
-            self._donation_total.inc(engine_id=eng, outcome=outcome)
+            donation_cells[outcome].inc()
 
         placed = []
         for algo, m in pairs:
@@ -1376,6 +1405,11 @@ class QueryServerService:
     def query(self, req: Request):  # pio: hotpath
         if not self._deployed:
             raise HTTPError(503, "undeployed")
+        if req.packed is not None:
+            # packed binary wire (PACKED_QUERY_CONTENT_TYPE): the body
+            # never meets the JSON codec — hand the frame view to the
+            # zero-copy path
+            return self._query_packed(req)
         self._pool_sync()
         t0 = monotonic_s()
         error = True
@@ -1504,7 +1538,11 @@ class QueryServerService:
                             rel_start_s=rel_exec,
                         )
                     elif self._batcher is not None \
-                            and self._batcher.active():
+                            and self._batcher.active() \
+                            and not self._evloop_front:
+                        # (bypassed on the evloop front: submit parks
+                        # the calling thread for the batch window, and
+                        # that thread IS the event loop)
                         result = self._batcher.submit(
                             query, span_sink=tr, deadline=deadline
                         )
@@ -1594,13 +1632,17 @@ class QueryServerService:
                 def _written(write_s: float, _tr=tr, _rel=rel_done_s):
                     # fires after the response bytes hit the socket: the
                     # last stage of the waterfall, and the only moment
-                    # the TRUE end-to-end latency (accept→write) exists
+                    # the TRUE end-to-end latency (accept→write) exists.
+                    # ONE clock read for both: a second elapsed_s after
+                    # the span observe would put the observe's own cost
+                    # into e2e but no stage, eroding attribution
+                    done_s = _tr.elapsed_s
                     _tr.add_span(
-                        "write", _tr.elapsed_s - _rel, rel_start_s=_rel
+                        "write", done_s - _rel, rel_start_s=_rel
                     )
                     _tr.extend_total()
                     self._e2e_cell.observe(
-                        _tr.elapsed_s, exemplar=_tr.trace_id
+                        done_s, exemplar=_tr.trace_id
                     )
 
                 req.on_written = _written
@@ -1625,6 +1667,234 @@ class QueryServerService:
             self._queries_total.inc(engine_id=eng)
             if error:
                 self._query_errors_total.inc(engine_id=eng)
+
+    def _query_packed(self, req: Request):  # pio: hotpath=zerocopy
+        """Packed int8 query path: the body bytes the HTTP front read
+        off the socket ARE the lane frame — validated structurally,
+        admitted through the same QoS gate as JSON queries, and written
+        straight into the shm ring slot by ``LaneClient.submit_packed``.
+        The device worker's response comes back as ready JSON bytes and
+        is returned without re-decoding. No JSON codec, no intermediate
+        dict, no ``bytes()`` copies anywhere on this path — the
+        ``hotpath-zero-copy`` rule proves it from this root.
+
+        Span accounting mirrors :meth:`query` (same end-aligned tiling
+        over QUERY_STAGES), with "parse" covering only the frame
+        validation — which is the point of the fast path."""
+        self._pool_sync()  # pio: disable=hotpath-zero-copy
+        t0 = monotonic_s()
+        error = True
+        eng = self.variant.engine_id
+        adm = None
+        deadline = None
+        bcall = None
+        trace_id = None
+        in_tid, in_parent = parse_trace_header(req.header(TRACE_HEADER))
+        try:
+            frame = req.packed
+            if not packed_frame_ok(frame):
+                self._fastpath_cells["invalid"].inc()
+                raise HTTPError(400, "malformed packed query frame")
+            if self.qos is not None:
+                try:
+                    deadline = Deadline.from_header(
+                        req.header(DEADLINE_HEADER),
+                        default_ms=self.qos.policy.deadline_ms,
+                    )
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+                timeout_s = (
+                    max(deadline.remaining_s(), 0.0)
+                    if deadline is not None else None
+                )
+                adm = self.qos.admit(
+                    priority=req.header(PRIORITY_HEADER),
+                    timeout_s=timeout_s,
+                )
+                if not adm.ok:
+                    # no stale-cache key for a binary body: shed is a
+                    # plain 429/503 (raised inside _shed)
+                    # pio: disable=hotpath-zero-copy
+                    out = self._shed(req, adm.reason, adm.retry_after_s)
+                    error = False
+                    return out
+                if self._scorer_breaker is not None:
+                    bcall = self._scorer_breaker.acquire()
+                    if not bcall.allowed:
+                        # pio: disable=hotpath-zero-copy
+                        out = self._shed(
+                            req, "breaker", bcall.retry_after_s
+                        )
+                        error = False
+                        return out
+            t_admitted = monotonic_s()
+            with self.tracer.trace(
+                "query", trace_id=in_tid, parent=in_parent
+            ) as tr:
+                trace_id = tr.trace_id
+                pre_s = req.read_s + (t_admitted - t0)
+                tr.rebase(pre_s)
+                tr.add_span("accept", req.read_s, rel_start_s=0.0)
+                if adm is not None and adm.queue_wait_s > 0:
+                    tr.add_span(
+                        "admit.queue", adm.queue_wait_s,
+                        rel_start_s=max(pre_s - adm.queue_wait_s, 0.0),
+                    )
+                rel_admit_end = tr.elapsed_s
+                tr.add_span(
+                    "admit", rel_admit_end - req.read_s,
+                    rel_start_s=req.read_s,
+                )
+                # "parse" here is only the frame check already done —
+                # end-aligned so the stage tiling matches the JSON path
+                rel_parse_end = tr.elapsed_s
+                tr.add_span(
+                    "parse", rel_parse_end - rel_admit_end,
+                    rel_start_s=rel_admit_end,
+                )
+                try:
+                    if deadline is not None and deadline.expired():
+                        raise DeadlineExceeded("deadline elapsed")
+                    rel_exec = tr.elapsed_s
+                    tr.add_span(
+                        "queue", rel_exec - rel_parse_end,
+                        rel_start_s=rel_parse_end,
+                    )
+                    if self._lane_client is not None:
+                        timeout_s = None
+                        if deadline is not None:
+                            timeout_s = max(
+                                0.005,
+                                min(self._lane_client.timeout_s,
+                                    deadline.remaining_s() - 0.01),
+                            )
+                        try:
+                            resp = self._lane_client.submit_packed(
+                                frame, timeout_s=timeout_s
+                            )
+                            self._lane_enqueued_total.inc(engine_id=eng)
+                            self._fastpath_cells["hit"].inc()
+                        except LaneFallback as lf:
+                            self._lane_fallback_total.inc(
+                                engine_id=eng, reason=lf.reason
+                            )
+                            if lf.reason == "full":
+                                self._lane_full_total.inc(engine_id=eng)
+                            # pio: disable=hotpath-zero-copy
+                            resp = self._query_packed_local(frame)
+                    else:
+                        # no lane (solo worker): the local fallback
+                        # decodes the frame once — off the proven path
+                        # pio: disable=hotpath-zero-copy
+                        resp = self._query_packed_local(frame)
+                    tr.add_span(
+                        "execute", tr.elapsed_s - rel_exec,
+                        rel_start_s=rel_exec,
+                    )
+                except DeadlineExceeded:
+                    # pio: disable=hotpath-zero-copy
+                    out = self._shed(req, "deadline", 0.0)
+                    error = False
+                    return out
+                except HTTPError:
+                    raise
+                except Exception:
+                    if bcall is not None:
+                        bcall.failure()
+                    raise
+                rel_ser = tr.elapsed_s
+                if bcall is not None:
+                    bcall.success()
+                error = False
+                log.info(
+                    "served packed query engine=%s ms=%.3f", eng,
+                    (monotonic_s() - t0) * 1e3,
+                )
+                rel_done_s = tr.elapsed_s
+                tr.add_span(
+                    "serialize", rel_done_s - rel_ser,
+                    rel_start_s=rel_ser,
+                )
+
+                def _written(write_s: float, _tr=tr, _rel=rel_done_s):
+                    # one clock read for the span AND e2e (see query())
+                    done_s = _tr.elapsed_s
+                    _tr.add_span(
+                        "write", done_s - _rel, rel_start_s=_rel
+                    )
+                    _tr.extend_total()
+                    self._e2e_cell.observe(
+                        done_s, exemplar=_tr.trace_id
+                    )
+
+                req.on_written = _written
+                return 200, RawResponse(
+                    resp,
+                    content_type="application/json; charset=UTF-8",
+                    headers={TRACE_HEADER: tr.trace_id},
+                )
+        finally:
+            if bcall is not None:
+                bcall.cancel()
+            if adm is not None:
+                adm.release()
+            dur_s = monotonic_s() - t0
+            self.stats.record(dur_s * 1e3, error)
+            self._request_cell.observe(dur_s, exemplar=trace_id)
+            self._queries_total.inc(engine_id=eng)
+            if error:
+                self._query_errors_total.inc(engine_id=eng)
+
+    def _query_packed_local(self, frame) -> bytes:
+        """Local fallback for the packed wire (solo worker, or the lane
+        shed this request): decode the frame with this worker's resident
+        scales and predict solo. The unpack copies the codes once — this
+        is the non-zero-copy fallback, deliberately OFF the
+        zerocopy-marked path (its call sites are suppressed)."""
+        pq = unpack_query_i8(frame)
+        with self._swap_lock:
+            serving = self.serving
+            resident = list(self._resident)
+        sc = resident[0] if len(resident) == 1 else None
+        if sc is None or sc.scales is None or sc.query_factory is None:
+            self._fastpath_cells["unavailable"].inc()
+            raise HTTPError(
+                400,
+                "packed queries need exactly one int8 resident scorer",
+            )
+        result = None
+        if sc.result_factory is not None and not sc.retired:
+            # direct wire dispatch: the frame's codes ARE this scorer's
+            # wire encoding, so skip dequantize → Query → re-quantize
+            # and map the argmax code straight to the template's result
+            failpoint("scorer.dispatch.packed")
+            try:
+                out = sc.score_wire(pq.codes.reshape(1, -1))
+                result = sc.result_factory(int(out[0]))
+            except RuntimeError:
+                # a hot swap retired the scorer mid-dispatch: fall back
+                # to the generic path, whose predict re-resolves the
+                # resident (or the host mirror the swap installed)
+                result = None
+        if result is None:
+            query = serving.supplement(
+                sc.query_factory(sc.dequantize(pq.codes))
+            )
+            result = self._predict_one(query)
+        self._fastpath_cells["local"].inc()
+        return json.dumps(_to_jsonable(result)).encode("utf-8")
+
+    def pack_query_body(self, body) -> Optional[bytes]:
+        """Encode a JSON-style query body as the packed int8 wire frame
+        (``PACKED_QUERY_CONTENT_TYPE``), or None when the deployment
+        can't serve packed queries (no single int8 resident scorer).
+        Test/bench helper — a real producer packs features client-side
+        with the published scales."""
+        with self._swap_lock:
+            qc = self.query_class
+            serving = self.serving
+        query = serving.supplement(self._parse_query(body, qc))
+        return self._lane_pack(query)
 
     def _log_feedback(self, query_body, result, pr_id: str):
         """Reference: query server POSTs back to the Event Server with prId;
@@ -1957,6 +2227,14 @@ class QueryServerService:
         embedded servers keep the flag-only behavior unless they opt in)."""
         self._server = server
 
+    def mark_evloop_front(self) -> None:
+        """The evloop HTTP front runs handlers inline in its event loop:
+        disable the in-process micro-batcher hand-off (its submit parks
+        the calling thread for the batch window, and that thread IS the
+        loop). Cross-worker batching via the shm lane still applies —
+        its submit-side wait is bounded by the lane timeout."""
+        self._evloop_front = True
+
 
 def create_query_server(
     variant: EngineVariant,
@@ -1970,7 +2248,7 @@ def create_query_server(
     reuse_port: bool = False,
     slos: Optional[List[str]] = None,
     qos: Optional[Any] = None,
-) -> Tuple[JsonHTTPServer, QueryServerService]:
+) -> Tuple[Any, QueryServerService]:
     from pio_tpu.server.plugins import load_plugins_from_env
 
     load_plugins_from_env()
@@ -1978,8 +2256,35 @@ def create_query_server(
         variant, instance_id, ctx, feedback, feedback_app_id, admin_key,
         slos=slos, qos=qos,
     )
-    server = JsonHTTPServer(
-        service.router, host, port, name="pio-tpu-queryserver",
-        reuse_port=reuse_port,
-    )
+    front = os.environ.get(
+        "PIO_TPU_HTTP_FRONT", "threaded"
+    ).strip().lower() or "threaded"
+    if front not in ("threaded", "evloop"):
+        log.warning(
+            "PIO_TPU_HTTP_FRONT=%r is not threaded|evloop; using "
+            "threaded", front,
+        )
+        front = "threaded"
+    if front == "evloop" and ssl_context_from_env() is not None:
+        # the evloop front has no TLS path: refusing to downgrade the
+        # transport silently, serve threaded instead
+        log.warning(
+            "PIO_TPU_HTTP_FRONT=evloop ignored: TLS is configured and "
+            "only the threaded front terminates it"
+        )
+        front = "threaded"
+    if front == "evloop":
+        from pio_tpu.server.evfront import EvLoopHTTPServer
+
+        server = EvLoopHTTPServer(
+            service.router, host, port, name="pio-tpu-queryserver",
+            ssl_context=None, reuse_port=reuse_port,
+            registry=service.obs,
+        )
+        service.mark_evloop_front()
+    else:
+        server = JsonHTTPServer(
+            service.router, host, port, name="pio-tpu-queryserver",
+            reuse_port=reuse_port,
+        )
     return server, service

@@ -183,6 +183,7 @@ class ResidentLinearScorer:
         scales: Optional[np.ndarray] = None,
         name: str = "",
         query_factory: Optional[Callable[[np.ndarray], object]] = None,
+        result_factory: Optional[Callable[[int], object]] = None,
         mesh=None,
     ):
         import jax
@@ -206,6 +207,12 @@ class ResidentLinearScorer:
         #: lets the lane drainer turn a packed int8 payload back into a
         #: servable query (see batchlane.PackedQuery)
         self.query_factory = query_factory
+        #: maps one argmax class code straight to the template's result
+        #: object. Attaching it is the template's declaration that a
+        #: wire-codes dispatch is result-equivalent to its full
+        #: supplement → predict path, which lets the packed query wire
+        #: skip the dequantize → Query → re-quantize round trip
+        self.result_factory = result_factory
         if self.wire == WIRE_INT8:
             # fold the training scales into the resident weights once:
             # X @ W == (X/s·s) @ W == X_q @ (s ⊙ W) up to quantization
@@ -355,11 +362,14 @@ class ResidentLinearScorer:
             )
         n = wire.shape[0]
         failpoint("scorer.h2d.ship")
-        x_dev = (
-            jax.device_put(wire, self._x_sharding)
-            if self._x_sharding is not None
-            else jax.device_put(wire)
-        )
+        if self._x_sharding is not None:
+            x_dev = jax.device_put(wire, self._x_sharding)
+        else:
+            # let the jitted call ship the host array itself: the
+            # runtime's C++ transfer path is several times cheaper than
+            # an explicit device_put for the per-request single-query
+            # dispatch (the bytes crossing host→device are identical)
+            x_dev = np.ascontiguousarray(wire)
         nbytes = int(wire.nbytes)
         self.h2d_bytes += nbytes
         if self._on_h2d is not None:

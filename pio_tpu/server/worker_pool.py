@@ -110,6 +110,11 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt,
     from pio_tpu.server.http import JsonHTTPServer
     from pio_tpu.server.query_server import create_query_server
 
+    if spec.get("http_front"):
+        # uniform front across the pool (see ServingPool._spec): the
+        # listener keeps SO_REUSEPORT either way, so evloop means one
+        # event loop per worker sharing the same port
+        os.environ["PIO_TPU_HTTP_FRONT"] = spec["http_front"]
     variant = EngineVariant(**spec["variant"])
     # a respawn AFTER a pool-wide /reload must join its siblings on the
     # newest COMPLETED instance, not resurrect the originally pinned one
@@ -149,6 +154,9 @@ def _worker_main(spec: dict, idx: int, gen, shutdown_evt,
     sidecar = None
     if health_ports is not None:
         try:
+            # the sidecar stays on the threaded front regardless of
+            # PIO_TPU_HTTP_FRONT: it serves /healthz to the supervisor
+            # and must answer even while the main front's loop is busy
             sidecar = JsonHTTPServer(
                 service.router, "127.0.0.1", 0,
                 name=f"pio-tpu-health-{idx}",
@@ -201,6 +209,7 @@ class ServingPool:
         mesh_worker: bool = False,
         slos: Optional[list] = None,
         qos: Optional[str] = None,
+        http_front: Optional[str] = None,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -244,6 +253,12 @@ class ServingPool:
             # striped token bucket depends on that alignment to enforce
             # one rps= budget POOL-WIDE (see pio_tpu/qos/limiter.py)
             "qos": qos,
+            # HTTP front for every worker (threaded|evloop); None defers
+            # to the worker's own PIO_TPU_HTTP_FRONT env. MUST be
+            # uniform across the pool: front choice adds metric families
+            # to the registry, and the shared-stripe slot layout
+            # requires identical registration order in every worker
+            "http_front": http_front,
         }
         self.n_workers = n_workers
         self._procs: list = []

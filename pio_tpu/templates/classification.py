@@ -355,6 +355,11 @@ def _linear_resident(algo_name, model, weights, bias, scales):
         query_factory=lambda x: Query(
             attrs=tuple(float(v) for v in np.asarray(x).reshape(-1))
         ),
+        # both linear classifiers serve through FirstServing (identity
+        # supplement), so a wire-codes dispatch is result-equivalent
+        result_factory=lambda c: PredictedResult(
+            label=model.label_index.inverse[int(c)]
+        ),
     )
 
 

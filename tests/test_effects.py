@@ -371,9 +371,18 @@ class TestRealTree:
             "pio_tpu.server.query_server._MicroBatcher.submit",
             "pio_tpu.server.bucketcache.dispatch_bucketed",
             "pio_tpu.server.batchlane.LaneClient.submit",
+            "pio_tpu.server.batchlane.LaneClient._submit_payload",
+            "pio_tpu.server.batchlane.LaneClient.submit_packed",
             "pio_tpu.server.batchlane.LaneDrainer._run",
             "pio_tpu.server.batchlane.pack_query_i8",
             "pio_tpu.server.batchlane.unpack_query_i8",
+            "pio_tpu.server.batchlane.packed_frame_ok",
+            # ISSUE 13: the evloop front's connection path and the
+            # zero-copy packed ingest
+            "pio_tpu.server.evfront.EvLoopHTTPServer._run",
+            "pio_tpu.server.evfront.EvLoopHTTPServer._serve_one",
+            "pio_tpu.server.evfront._packed_view",
+            "pio_tpu.server.query_server.QueryServerService._query_packed",
         }
         missing = expected - roots
         assert not missing, f"hot-path roots missing: {missing}"

@@ -1,0 +1,422 @@
+"""HTTP/1.1 edge cases over BOTH fronts (ISSUE 13).
+
+One parametrized suite runs the same raw-socket scenarios against the
+threaded front (`JsonHTTPServer`) and the event-loop front
+(`EvLoopHTTPServer`): pipelined bursts, byte-by-byte partial arrival,
+oversized-body 413, idle-timeout close, malformed request line 400, and
+keep-alive vs ``Connection: close`` semantics. Plus the evfront-specific
+regressions: per-connection write buffers (two pipelined responses must
+neither interleave nor alias) and the packed int8 zero-copy ingest
+(exact parity with the JSON path, and raw-frame lane submit).
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pio_tpu.templates  # noqa: F401  (registers engine factories)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.server.batchlane import (
+    BatchLaneSegment,
+    LaneClient,
+    LaneDrainer,
+    PACKED_MAGIC,
+    pack_query_i8,
+    packed_frame_ok,
+)
+from pio_tpu.server.evfront import EvLoopHTTPServer
+from pio_tpu.server.http import (
+    JsonHTTPServer,
+    PACKED_QUERY_CONTENT_TYPE,
+    Request,
+    Router,
+)
+from pio_tpu.server.query_server import QueryServerService
+from pio_tpu.storage import Storage
+
+FRONTS = ("threaded", "evloop")
+
+
+def _make_front(front: str, router: Router):
+    if front == "evloop":
+        return EvLoopHTTPServer(
+            router, host="127.0.0.1", port=0, ssl_context=None
+        ).start()
+    return JsonHTTPServer(
+        router, host="127.0.0.1", port=0, ssl_context=None
+    ).start()
+
+
+def _echo_router() -> Router:
+    r = Router()
+
+    def echo(req: Request):
+        return 200, {"got": req.body}
+
+    r.add("POST", "/echo", echo)
+    r.add("GET", "/ping", lambda req: (200, {"pong": True}))
+    return r
+
+
+@pytest.fixture(params=FRONTS)
+def front(request):
+    srv = _make_front(request.param, _echo_router())
+    yield request.param, srv
+    srv.stop()
+
+
+def _drain(sock: socket.socket, timeout: float = 3.0) -> bytes:
+    """Read until the peer closes (or the timeout elapses)."""
+    sock.settimeout(timeout)
+    out = b""
+    try:
+        while True:
+            got = sock.recv(65536)
+            if not got:
+                break
+            out += got
+    except socket.timeout:
+        pass
+    return out
+
+
+def _request(port: int, payload: bytes, timeout: float = 3.0) -> bytes:
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        s.sendall(payload)
+        return _drain(s, timeout)
+    finally:
+        s.close()
+
+
+def _post(path: str, body: bytes, ctype: str = "application/json",
+          close: bool = False) -> bytes:
+    conn = b"Connection: close\r\n" if close else b""
+    return (
+        b"POST %s HTTP/1.1\r\nHost: t\r\nContent-Type: %s\r\n"
+        b"Content-Length: %d\r\n%s\r\n%s"
+        % (path.encode(), ctype.encode(), len(body), conn, body)
+    )
+
+
+def _split_responses(blob: bytes):
+    """Parse a byte stream of HTTP/1.1 responses into
+    ``[(status, headers, body)]`` using Content-Length framing — any
+    interleaving or mis-framing breaks the parse or the count."""
+    out = []
+    rest = blob
+    while rest:
+        head, sep, rest = rest.partition(b"\r\n\r\n")
+        assert sep, f"unterminated head: {head[:120]!r}"
+        lines = head.split(b"\r\n")
+        status = int(lines[0].split()[1])
+        headers = {}
+        for ln in lines[1:]:
+            k, _, v = ln.partition(b":")
+            headers[k.strip().lower()] = v.strip()
+        n = int(headers.get(b"content-length", b"0"))
+        body, rest = rest[:n], rest[n:]
+        assert len(body) == n, "truncated body"
+        out.append((status, headers, body))
+    return out
+
+
+class TestFrontEdgeCases:
+    def test_basic_roundtrip(self, front):
+        _, srv = front
+        resp = _request(
+            srv.port, b"GET /ping HTTP/1.1\r\nHost: t\r\n"
+            b"Connection: close\r\n\r\n",
+        )
+        [(status, headers, body)] = _split_responses(resp)
+        assert status == 200
+        assert json.loads(body) == {"pong": True}
+        assert headers[b"connection"] == b"close"
+
+    def test_pipelined_burst_in_order(self, front):
+        _, srv = front
+        bodies = [json.dumps({"i": i}).encode() for i in range(8)]
+        blob = b"".join(_post("/echo", b) for b in bodies[:-1])
+        blob += _post("/echo", bodies[-1], close=True)
+        resp = _request(srv.port, blob)
+        got = _split_responses(resp)
+        assert [st for st, _, _ in got] == [200] * 8
+        for i, (_, _, body) in enumerate(got):
+            assert json.loads(body) == {"got": {"i": i}}
+
+    def test_pipelined_responses_do_not_interleave_or_alias(self, front):
+        # per-connection write buffers (satellite 2): two pipelined
+        # responses of very different sizes must come back exactly
+        # framed, in order, each with its own payload bytes
+        _, srv = front
+        big = json.dumps({"blob": "x" * 30000}).encode()
+        small = json.dumps({"tiny": 1}).encode()
+        blob = _post("/echo", big) + _post("/echo", small, close=True)
+        got = _split_responses(_request(srv.port, blob))
+        assert len(got) == 2
+        assert json.loads(got[0][2]) == {"got": {"blob": "x" * 30000}}
+        assert json.loads(got[1][2]) == {"got": {"tiny": 1}}
+
+    def test_byte_by_byte_arrival(self, front):
+        _, srv = front
+        body = json.dumps({"slow": True}).encode()
+        payload = _post("/echo", body, close=True)
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        try:
+            for i in range(len(payload)):
+                s.sendall(payload[i:i + 1])
+            [(status, _, got)] = _split_responses(_drain(s))
+        finally:
+            s.close()
+        assert status == 200
+        assert json.loads(got) == {"got": {"slow": True}}
+
+    def test_oversized_body_413(self, front):
+        # a structured Content-Length over the JSON cap is refused from
+        # the headers alone — no body needs to be sent (or read)
+        _, srv = front
+        resp = _request(
+            srv.port,
+            b"POST /echo HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: 104857600\r\n\r\n",
+        )
+        assert resp.split(b"\r\n", 1)[0] == b"HTTP/1.1 413 Content Too Large"
+
+    def test_malformed_request_line_400(self, front):
+        _, srv = front
+        resp = _request(srv.port, b"NONSENSE\r\n\r\n")
+        assert resp.split(b"\r\n", 1)[0] == b"HTTP/1.1 400 Bad Request"
+
+    def test_keep_alive_sequential_then_close(self, front):
+        _, srv = front
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        try:
+            s.sendall(_post("/echo", json.dumps({"a": 1}).encode()))
+            # wait for the first full response before the second request
+            s.settimeout(3)
+            first = b""
+            while b"\r\n\r\n" not in first or not first.endswith(b"}"):
+                got = s.recv(65536)
+                assert got, "server closed a keep-alive connection"
+                first += got
+            [(st1, h1, b1)] = _split_responses(first)
+            assert st1 == 200 and json.loads(b1) == {"got": {"a": 1}}
+            assert h1.get(b"connection") != b"close"
+            s.sendall(_post("/echo", json.dumps({"b": 2}).encode(),
+                            close=True))
+            [(st2, h2, b2)] = _split_responses(_drain(s))
+            assert st2 == 200 and json.loads(b2) == {"got": {"b": 2}}
+            assert h2[b"connection"] == b"close"
+        finally:
+            s.close()
+
+    def test_idle_timeout_closes_connection(self, monkeypatch, front):
+        name, srv = front
+        srv.stop()
+        monkeypatch.setenv("PIO_TPU_HTTP_IDLE_TIMEOUT_S", "0.5")
+        srv2 = _make_front(name, _echo_router())
+        try:
+            s = socket.create_connection(
+                ("127.0.0.1", srv2.port), timeout=5
+            )
+            try:
+                # send nothing: the idle/slowloris guard must close
+                s.settimeout(5)
+                assert s.recv(1) == b""  # orderly close, not a hang
+            finally:
+                s.close()
+        finally:
+            srv2.stop()
+
+
+class TestEvloopSpecifics:
+    def test_tls_refused(self, monkeypatch):
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        with pytest.raises(ValueError, match="TLS"):
+            EvLoopHTTPServer(_echo_router(), ssl_context=ctx)
+
+    def test_large_uploads_refused(self):
+        with pytest.raises(ValueError, match="threaded"):
+            EvLoopHTTPServer(
+                _echo_router(), ssl_context=None, large_uploads=True
+            )
+
+    def test_max_pipeline_knob_batches_but_serves_all(self, monkeypatch):
+        monkeypatch.setenv("PIO_TPU_HTTP_MAX_PIPELINE", "2")
+        srv = _make_front("evloop", _echo_router())
+        try:
+            bodies = [json.dumps({"i": i}).encode() for i in range(7)]
+            blob = b"".join(_post("/echo", b) for b in bodies[:-1])
+            blob += _post("/echo", bodies[-1], close=True)
+            got = _split_responses(_request(srv.port, blob))
+            assert [json.loads(b)["got"]["i"] for _, _, b in got] \
+                == list(range(7))
+        finally:
+            srv.stop()
+
+    def test_connection_metrics_registered(self):
+        from pio_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        srv = EvLoopHTTPServer(
+            _echo_router(), host="127.0.0.1", ssl_context=None,
+            registry=reg,
+        ).start()
+        try:
+            blob = _post("/echo", b'{"a":1}') \
+                + _post("/echo", b'{"b":2}', close=True)
+            _split_responses(_request(srv.port, blob))
+            lines = "\n".join(reg.render())
+            assert "pio_tpu_http_connections_active" in lines
+            assert "pio_tpu_http_pipelined_total" in lines
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------------------ packed int8 wire
+class TestPackedFrameCheck:
+    def test_structural_check(self):
+        frame = pack_query_i8(np.array([1, -2, 3], np.int8))
+        assert packed_frame_ok(frame)
+        assert packed_frame_ok(memoryview(frame))
+        assert not packed_frame_ok(frame[:-1])  # truncated
+        assert not packed_frame_ok(b"\x01" + frame[1:])  # bad magic
+        assert not packed_frame_ok(b"")
+
+    def test_submit_packed_returns_raw_json_bytes(self, tmp_path):
+        seg = BatchLaneSegment.create(str(tmp_path / "lane.shm"), 2)
+        doorbell = threading.Event()
+        resp = [threading.Event() for _ in range(2)]
+        seen = []
+
+        def dispatch(bodies):
+            seen.extend(bodies)
+            return [{"n": int(len(b))} for b in bodies]
+
+        drainer = LaneDrainer(seg, dispatch, doorbell, resp,
+                              poll_s=0.01).start()
+        try:
+            client = LaneClient(seg, 1, doorbell, resp[1], timeout_s=5.0)
+            frame = pack_query_i8(np.array([5, -7, 9, 11], np.int8))
+            out = client.submit_packed(frame)
+            # raw JSON bytes, NOT a decoded dict: the front writes them
+            # straight to the socket
+            assert isinstance(out, bytes)
+            assert json.loads(out.decode()) == {"n": 4}
+            # and a memoryview frame (the evfront hand-off) works too
+            out2 = client.submit_packed(memoryview(frame))
+            assert json.loads(out2.decode()) == {"n": 4}
+        finally:
+            drainer.stop()
+
+
+@pytest.fixture
+def isolated_storage(tmp_home):
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def _resident_service(monkeypatch):
+    # mirrors tests/test_device_resident.py's harness: an int8 resident
+    # classification deployment whose lane pack/unpack is exact
+    import datetime as dt
+
+    from pio_tpu.data import Event
+    from pio_tpu.storage import App
+    from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+    monkeypatch.setenv("PIO_TPU_DEVICE_RESIDENT", "1")
+    monkeypatch.setenv("PIO_TPU_BATCH_BUCKETS", "1,2,4")
+    monkeypatch.setenv("PIO_TPU_BUCKET_WARMUP", "1")
+    app_id = Storage.get_meta_data_apps().insert(App(0, "evfront-test"))
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+    rng = np.random.default_rng(7)
+    n = 0
+    for plan, hot in (("basic", 0), ("premium", 1), ("pro", 2)):
+        for _ in range(8):
+            attrs = rng.integers(0, 3, size=3)
+            attrs[hot] += 6
+            props = {f"attr{j}": int(attrs[j]) for j in range(3)}
+            props["plan"] = plan
+            le.insert(
+                Event("$set", "user", f"u{n}", properties=props,
+                      event_time=t0 + dt.timedelta(minutes=n)),
+                app_id,
+            )
+            n += 1
+    variant = variant_from_dict({
+        "id": "evfront-e2e",
+        "engineFactory": "templates.classification",
+        "datasource": {"params": {"app_name": "evfront-test"}},
+        "algorithms": [{"name": "logreg", "params": {}}],
+    })
+    engine, ep = build_engine(variant)
+    ctx = ComputeContext.create(seed=0)
+    run_train(engine, ep, variant, ctx=ctx)
+    return QueryServerService(variant, ctx=ctx)
+
+
+class TestPackedHTTPPath:
+    @pytest.mark.parametrize("front_name", FRONTS)
+    def test_packed_request_parity_vs_json(
+        self, monkeypatch, isolated_storage, front_name
+    ):
+        svc = _resident_service(monkeypatch)
+        srv = _make_front(front_name, svc.router)
+        try:
+            for attrs, want in (
+                ((9.0, 1.0, 1.0), "basic"),
+                ((1.0, 9.0, 1.0), "premium"),
+                ((1.0, 1.0, 9.0), "pro"),
+            ):
+                body = {"attrs": list(attrs)}
+                raw = json.dumps(body).encode()
+                [(st, _, out_json)] = _split_responses(_request(
+                    srv.port, _post("/queries.json", raw, close=True),
+                ))
+                assert st == 200
+                frame = svc.pack_query_body(body)
+                assert frame is not None and frame[:4] == PACKED_MAGIC
+                [(st2, _, out_packed)] = _split_responses(_request(
+                    srv.port,
+                    _post("/queries.json", frame,
+                          ctype=PACKED_QUERY_CONTENT_TYPE, close=True),
+                ))
+                assert st2 == 200
+                # exact parity: the packed wire answers byte-identically
+                # to the JSON path (both decode to the same label too)
+                assert json.loads(out_packed) == json.loads(out_json)
+                assert json.loads(out_packed)["label"] == want
+            # no lane here, so every packed request took the local
+            # fallback; none were invalid
+            assert svc._parse_fastpath_total.value("local") == 3.0
+            assert svc._parse_fastpath_total.value("invalid") == 0.0
+        finally:
+            srv.stop()
+
+    @pytest.mark.parametrize("front_name", FRONTS)
+    def test_malformed_packed_frame_400(
+        self, monkeypatch, isolated_storage, front_name
+    ):
+        svc = _resident_service(monkeypatch)
+        srv = _make_front(front_name, svc.router)
+        try:
+            [(st, _, body)] = _split_responses(_request(
+                srv.port,
+                _post("/queries.json", b"\x00Q8\x01\xff\xff",
+                      ctype=PACKED_QUERY_CONTENT_TYPE, close=True),
+            ))
+            assert st == 400
+            assert "packed" in json.loads(body)["message"]
+            assert svc._parse_fastpath_total.value("invalid") == 1.0
+        finally:
+            srv.stop()
