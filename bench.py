@@ -1687,6 +1687,110 @@ def _bench_twotower(ctx, scale: float) -> dict:
     return out
 
 
+def _bench_train_streamed(ctx, scale: float) -> dict:
+    """ISSUE 14: the streamed training feed (parallel/stream.py) —
+    examples/sec/chip for a streamed two-tower run on the full mesh,
+    the profiled h2d/device phase split, the achieved h2d/compute
+    overlap ratio, and the mesh-vs-single-chip scaling factor.
+
+    The overlap ratio comes from a controlled executor-level probe (a
+    profiled serialized pass vs an overlapped double-buffered pass over
+    the SAME chunk workload) rather than from the e2e trainer, whose
+    wall time also carries init/readback and would drown the feed
+    phases in noise. record_overlap_ratio publishes the gauge."""
+    import jax
+    import jax.numpy as jnp
+
+    from pio_tpu.models.two_tower import TwoTowerConfig, train_two_tower
+    from pio_tpu.parallel.mesh import MeshSpec, build_mesh
+    from pio_tpu.parallel.stream import record_overlap_ratio, stream_feed
+
+    n_pairs = max(4096, int(200_000 * scale))
+    n_users, n_items = int(50_000 * scale) + 64, int(20_000 * scale) + 64
+    # batch capped so the epoch always has several spans to stream,
+    # even at smoke scale (one batch = nothing to overlap)
+    steps = 60
+    batch = max(256, min(_TT_BATCH, n_pairs // 8))
+    rng = np.random.default_rng(14)
+    u = rng.integers(0, n_users, n_pairs).astype(np.int32)
+    i = rng.integers(0, n_items, n_pairs).astype(np.int32)
+    cfg = TwoTowerConfig(
+        embed_dim=_TT_EMBED, hidden=_TT_HIDDEN, out_dim=_TT_OUT,
+        steps=steps, batch_size=batch, stream="on",
+    )
+    devices = list(ctx.mesh.devices.flat)
+    mesh = build_mesh(MeshSpec(data=-1, model=1), devices=devices)
+
+    times, _ = _timed_runs(
+        lambda: train_two_tower(mesh, u, i, n_users, n_items, cfg),
+        repeats=3,
+    )
+    rate = steps * batch / times[len(times) // 2]
+    st: dict = {}
+    train_two_tower(mesh, u, i, n_users, n_items, cfg, stats=st)
+
+    # single-chip anchor: same streamed program without collectives
+    t_single, _ = _timed_runs(
+        lambda: train_two_tower(None, u, i, n_users, n_items, cfg),
+        repeats=3,
+    )
+    rate_single = steps * batch / t_single[len(t_single) // 2]
+
+    # executor-level overlap probe: heavy async chunk programs vs
+    # multi-MB puts — the serialized pass measures the phases, the
+    # double-buffered pass measures how much of the put time hides
+    side = 512 if scale < 1 else 1024
+    n_chunks, burn_iters = 6, 4
+    host_chunks = [
+        rng.normal(size=(side, side)).astype(np.float32) * 0.01
+        for _ in range(n_chunks)
+    ]
+
+    @jax.jit
+    def _burn(carry, dev):
+        x = carry
+        for _ in range(burn_iters):
+            x = jnp.tanh(x @ dev)
+        return x
+
+    def _probe(stats=None, lookahead=0):
+        from pio_tpu.obs import monotonic_s
+
+        t0 = monotonic_s()
+        out = stream_feed(
+            list(range(n_chunks)),
+            encode=lambda c: host_chunks[c],
+            dispatch=lambda carry, dev, _i: _burn(carry, dev),
+            init_carry=lambda: jnp.eye(side, dtype=jnp.float32),
+            lookahead=lookahead,
+            stats=stats,
+        )
+        jax.block_until_ready(out)
+        return monotonic_s() - t0
+
+    pst: dict = {}
+    _probe(stats=pst)  # warm compile + serialized phases
+    pst = {}
+    _probe(stats=pst)
+    wall = min(_probe(lookahead=2) for _ in range(3))
+    overlap = record_overlap_ratio(pst["h2d_s"], pst["device_s"], wall)
+
+    return {
+        "value": rate / max(1, len(devices)),
+        "examples_per_sec": round(rate, 1),
+        "sharded_scaling_x": round(rate / rate_single, 2),
+        "n_devices": len(devices),
+        "overlap_ratio": round(overlap, 3),
+        "probe_h2d_s": round(pst["h2d_s"], 4),
+        "probe_device_s": round(pst["device_s"], 4),
+        "probe_wall_s": round(wall, 4),
+        "phases": {
+            k: round(v, 3) if isinstance(v, float) else v
+            for k, v in st.items()
+        },
+    }
+
+
 #: v5e bf16 peak, GFLOP/s — the roofline anchor for utilization notes
 _V5E_BF16_PEAK_GFLOPS = 197_000.0
 
@@ -2276,6 +2380,20 @@ def build_summary(full: dict, full_path: str = "BENCH_FULL.json") -> dict:
             "tokens_s": sq.get("tokens_per_sec"),
             "gflops": sq.get("achieved_gflops"),
         }
+    ts = sec.get("train_streamed")
+    if isinstance(ts, dict):
+        configs["train_streamed"] = {
+            "v": ts.get("value"),
+            "overlap": ts.get("overlap_ratio"),
+            "shard_x": ts.get("sharded_scaling_x"),
+            "h2d_s": (ts.get("phases") or {}).get("h2d_s"),
+            "device_s": (ts.get("phases") or {}).get("device_s"),
+        }
+        # trajectory fields ride the summary top level so the history
+        # delta table can watch them (see HISTORY_FIELDS)
+        s["train_streamed_eps"] = ts.get("value")
+        s["train_stream_overlap"] = ts.get("overlap_ratio")
+        s["train_sharded_x"] = ts.get("sharded_scaling_x")
     if isinstance(sec.get("textclassification"), dict):
         tc = sec["textclassification"]
         configs["textclass"] = {
@@ -2404,6 +2522,9 @@ HISTORY_FIELDS = (
     ("serving_attributed", "up"),    # latency-attribution coverage
     ("serving_h2d_x", "up"),         # f32/i8 h2d byte ratio (wire win)
     ("shed_rate", "down"),           # overload stage shed fraction
+    ("train_streamed_eps", "up"),    # streamed-feed examples/sec/chip
+    ("train_stream_overlap", "up"),  # h2d hidden behind compute
+    ("train_sharded_x", "up"),       # mesh vs single-chip train rate
 )
 
 
@@ -2451,6 +2572,9 @@ def history_record(full: dict, summary: dict,
         "serving_attributed": summary.get("serving_attributed"),
         "serving_h2d_x": summary.get("serving_h2d_x"),
         "shed_rate": overload.get("shed_rate"),
+        "train_streamed_eps": summary.get("train_streamed_eps"),
+        "train_stream_overlap": summary.get("train_stream_overlap"),
+        "train_sharded_x": summary.get("train_sharded_x"),
         "shed_counts": {
             "offered": overload.get("offered"),
             "admitted": overload.get("admitted"),
@@ -2786,6 +2910,15 @@ def main() -> None:
                 "the host link (see phases), training is one "
                 "compiled scan"
             )
+
+        if not over_deadline("train.streamed"):
+            try:
+                secondary["train_streamed"] = _bench_train_streamed(
+                    ctx, sscale
+                )
+            except Exception as exc:
+                print(f"# secondary train.streamed failed: {exc}",
+                      file=sys.stderr)
 
         if not over_deadline("seqrec"):
             try:

@@ -120,6 +120,8 @@ FAILPOINT_NAMESPACES = (
     "repl.",
     # mesh-sharded placement + shard-manifest reassembly (ISSUE 10)
     "shard.",
+    # streamed training feed executor (parallel/stream.py, ISSUE 14)
+    "stream.",
 )
 
 

@@ -802,44 +802,52 @@ def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
                else r_ship[e0:e1])
         return d_lo, d_hi, ovf_idx, ovf_val, r_c
 
-    encoded: list = []
-    if stats is not None:
-        # profiling: pre-encode every chunk so host CPU time lands in
-        # pack_s, not in the transfer phase it would otherwise pollute
-        t0 = monotonic_s()
-        encoded = [
-            _encode_chunk(e0, e1, lc)
-            for (e0, e1), lc in zip(spans, local_slices)
-        ]
-        stats["pack_s"] = stats.get("pack_s", 0.0) + (
-            monotonic_s() - t0
+    # the shared streamed-feed executor (parallel/stream.py) runs the
+    # encode → queued-put → chained-dispatch loop; ALS retains the wire
+    # chunks (finalize re-decodes them for the item side) so it rides
+    # the queue-ahead mode (lookahead=0), and maps the executor's
+    # encode phase onto its historical ``pack_s`` stats key
+    from pio_tpu.parallel.stream import stream_feed
+
+    def encode(chunk):
+        (e0, e1), lc = chunk
+        return (*_encode_chunk(e0, e1, lc), lc)
+
+    def put(host, _idx):
+        *wire, lc = host
+        return tuple(jax.device_put(a) for a in wire), jax.device_put(lc)
+
+    extra = {}
+
+    def put_extra():
+        extra["cu"] = jax.device_put(counts_u.astype(np.int32))
+        extra["ci"] = jax.device_put(
+            np.ascontiguousarray(counts_i, np.int32)
         )
+        return extra["cu"], extra["ci"]
 
-    t0 = monotonic_s()
-    wire_dev, lc_dev = [], []
-    for c, ((e0, e1), lc) in enumerate(zip(spans, local_slices)):
-        wire = encoded[c] if encoded else _encode_chunk(e0, e1, lc)
-        wire_dev.append(tuple(jax.device_put(a) for a in wire))
-        lc_dev.append(jax.device_put(lc))
-    cu_dev = jax.device_put(counts_u.astype(np.int32))
-    ci_dev = jax.device_put(np.ascontiguousarray(counts_i, np.int32))
-    if stats is not None:
-        jax.block_until_ready((wire_dev, lc_dev, cu_dev, ci_dev))
-        stats["h2d_s"] = monotonic_s() - t0
-        t0 = monotonic_s()
+    def init_carry():
+        Q0, A, b = init(seed)
+        return Q0, A, b, ()
 
-    Q0, A, b = init(seed)
-    user_blocks = []
-    for acc, lc, wire in zip(accums, lc_dev, wire_dev):
-        A, b, blk = acc(A, b, Q0, lc, *wire)
-        user_blocks.append(blk)
-    P_f, Q_f = finalize(A, b, Q0, cu_dev, ci_dev,
-                        tuple(user_blocks), tuple(wire_dev),
-                        tuple(lc_dev))
-    if stats is not None:
-        jax.block_until_ready((P_f, Q_f))
-        stats["device_s"] = monotonic_s() - t0
-    return P_f, Q_f
+    def dispatch(carry, dev, c):
+        Q0, A, b, user_blocks = carry
+        wire, lc = dev
+        A, b, blk = accums[c](A, b, Q0, lc, *wire)
+        return Q0, A, b, user_blocks + (blk,)
+
+    def fin(carry, devs):
+        Q0, A, b, user_blocks = carry
+        return finalize(A, b, Q0, extra["cu"], extra["ci"], user_blocks,
+                        tuple(d[0] for d in devs),
+                        tuple(d[1] for d in devs))
+
+    return stream_feed(
+        list(zip(spans, local_slices)),
+        encode=encode, put=put, put_extra=put_extra,
+        init_carry=init_carry, dispatch=dispatch, finalize=fin,
+        stats=stats, encode_stat_key="pack_s",
+    )
 
 
 def _nibble_pack(codes: np.ndarray) -> np.ndarray:

@@ -56,6 +56,17 @@ class SeqRecConfig:
     #: count divisible by the seq-axis size). See pio_tpu/parallel/.
     attention: str = "ring"
     seed: int = 0
+    #: rows per optimizer step. 0 = full-batch (every step consumes the
+    #: whole dataset — the historical path); > 0 = minibatch SGD over
+    #: wrapped contiguous row blocks, which is what lets the epoch
+    #: STREAM through the mesh instead of staging on device.
+    batch_size: int = 0
+    #: epoch feed for the minibatch path: "off" stages the full epoch
+    #: on device, "on" streams row spans through parallel/stream.py,
+    #: "auto" streams only when staging would exceed
+    #: PIO_TPU_DEVICE_BUDGET_BYTES. Streamed and staged runs with the
+    #: same seed/config produce identical params.
+    stream: str = "auto"
 
 
 @dataclasses.dataclass
@@ -322,6 +333,7 @@ def train_seqrec(
     config: SeqRecConfig = SeqRecConfig(),
     checkpoint=None,
     checkpoint_every: int = 0,
+    stats=None,
 ) -> SeqRecModel:
     """Next-item training over padded histories.
 
@@ -333,6 +345,15 @@ def train_seqrec(
         checkpoint/checkpoint_every: optional
             pio_tpu.workflow.checkpoint.CheckpointManager + snapshot
             interval in steps; resumes from the newest snapshot on restart.
+        stats: optional dict — streamed runs report the executor phases
+            (h2d_s/device_s/h2d_bytes/encode_s) plus n_stream; all runs
+            report place_s/steps_s (profiling only: phases serialize).
+
+    Raises:
+        DeviceBudgetExceeded: the params can't fit (single-chip or even
+            sharded), or the staged epoch can't fit next to them and
+            ``batch_size`` is 0 so the feed cannot stream (full-batch
+            steps need the whole dataset resident).
     """
     import jax
     import jax.numpy as jnp
@@ -349,6 +370,15 @@ def train_seqrec(
     s_axis = "seq" if mesh is not None else None
     p_axis = "pipe" if (mesh is not None and n_pipe > 1) else None
 
+    if cfg.stream not in ("auto", "on", "off"):
+        raise ValueError(
+            f"stream must be auto/on/off, got {cfg.stream!r}"
+        )
+    if cfg.stream == "on" and cfg.batch_size <= 0:
+        raise ValueError(
+            "stream='on' needs batch_size > 0 (full-batch steps consume "
+            "the whole dataset every step — nothing to stream)"
+        )
     if cfg.n_heads % n_model:
         raise ValueError("n_heads must divide by the model axis")
     if cfg.n_layers % max(n_pipe, 1):
@@ -386,16 +416,97 @@ def train_seqrec(
             buf[r, : len(codes)] = codes
     seqs = buf
 
+    if cfg.batch_size > 0:
+        # minibatch SGD: contiguous row blocks with wraparound so every
+        # scan step slices a full batch (the two_tower discipline)
+        B = _round_up(min(cfg.batch_size, max(n, 1)), n_data)
+        reps = _round_up(max(n, B), B)
+        seqs = np.resize(seqs[:max(n, 1)], (reps, t_pad))
+        n_batches = reps // B
+    else:
+        B, n_batches = seqs.shape[0], 1
+
     # next-item targets: target[t] = seq[t+1]; last position unsupervised
     targets = np.zeros_like(seqs)
     targets[:, :-1] = seqs[:, 1:]
     mask = (targets > 0) & (seqs > 0)
 
     vocab = _round_up(n_items + 1, n_model)  # +1 for the pad row
-    params = init_params(vocab, cfg)
-    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
     tx = optax.adam(cfg.learning_rate)
     specs = param_specs(cfg)
+
+    # placement accounting BEFORE anything lands on device (the
+    # two_tower discipline): sharded params must fit the per-chip
+    # budget, and the staged epoch must fit NEXT TO them or the feed
+    # streams row spans instead
+    from pio_tpu.parallel.partition import (
+        DeviceBudgetExceeded,
+        assert_device_budget,
+        device_budget_bytes,
+        per_device_nbytes,
+    )
+
+    def _skeleton():
+        D, F, L, T = cfg.d_model, cfg.ffn, cfg.n_layers, cfg.max_len
+        z = np.zeros((), np.float32)
+
+        def bt(*shape):
+            return np.broadcast_to(z, shape)
+
+        return {
+            "emb": bt(vocab, D),
+            "pos": bt(T, D),
+            "blocks": {
+                "ln1_g": bt(L, D), "ln1_b": bt(L, D),
+                "wq": bt(L, D, D), "wk": bt(L, D, D), "wv": bt(L, D, D),
+                "wo": bt(L, D, D), "ln2_g": bt(L, D), "ln2_b": bt(L, D),
+                "w1": bt(L, D, F), "b1": bt(L, F),
+                "w2": bt(L, F, D), "b2": bt(L, D),
+            },
+            "lnf_g": bt(D), "lnf_b": bt(D),
+        }
+
+    skeleton = _skeleton()
+    params_nbytes = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(skeleton)
+    )
+    if mesh is None:
+        assert_device_budget(
+            params_nbytes, 1, "seqrec params (single-chip placement)"
+        )
+        params_pd = params_nbytes
+    else:
+        params_pd = per_device_nbytes(mesh, skeleton, specs)
+        assert_device_budget(params_pd, 1, "seqrec sharded params")
+    # seqs + targets (int32) + mask (float32), sharded over data × seq
+    staged_pd = -(-12 * seqs.shape[0] * t_pad // (n_data * n_seq))
+    budget = device_budget_bytes()
+    over = budget > 0 and params_pd + staged_pd > budget
+    streamed = cfg.batch_size > 0 and (
+        cfg.stream == "on" or (cfg.stream == "auto" and over)
+    )
+    if over and cfg.batch_size <= 0 and cfg.stream != "off":
+        raise DeviceBudgetExceeded(
+            f"seqrec staged epoch ({staged_pd} B/device) does not fit "
+            f"beside the params ({params_pd} B/device) under "
+            f"PIO_TPU_DEVICE_BUDGET_BYTES={budget}; set batch_size > 0 "
+            f"so the feed can stream row spans"
+        )
+    n_stream = 0
+    if streamed:
+        from pio_tpu.parallel.stream import n_stream_chunks
+
+        n_stream = max(
+            2,
+            n_stream_chunks(12 * seqs.shape[0] * t_pad,
+                            "PIO_TPU_TRAIN_STREAM_MB",
+                            default="64", cap=256),
+        )
+        if budget > params_pd:
+            n_stream = max(n_stream, -(-staged_pd // (budget - params_pd)))
+        n_stream = min(n_batches, n_stream)
+    if stats is not None:
+        stats["n_stream"] = n_stream
 
     def global_loss(params, seqs, targets, mask):
         if mesh is None:
@@ -424,48 +535,135 @@ def train_seqrec(
         )(params, seqs, targets, mask)
 
     mask = mask.astype(np.float32)
+
+    def _init_all():
+        p = init_params(vocab, cfg)
+        return jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), p)
+
+    from pio_tpu.obs import monotonic_s
+
+    t0 = monotonic_s()
+    dsh = None
     if mesh is not None:
         psh = jax.tree.map(
             lambda spec: NamedSharding(mesh, spec),
             specs,
             is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
         )
-        params = jax.tree.map(jax.device_put, params, psh)
+        # each device materializes only its shard — the vocab-sharded
+        # table never exists unsharded on any chip
+        params = jax.jit(_init_all, out_shardings=psh)()
         dsh = NamedSharding(mesh, P("data", "seq"))
-        seqs_d = jax.device_put(jnp.asarray(seqs), dsh)
-        targets_d = jax.device_put(jnp.asarray(targets), dsh)
-        mask_d = jax.device_put(jnp.asarray(mask), dsh)
     else:
-        seqs_d = jnp.asarray(seqs)
-        targets_d = jnp.asarray(targets)
-        mask_d = jnp.asarray(mask)
+        params = jax.jit(_init_all)()
 
-    @functools.partial(jax.jit, static_argnums=1)
-    def chunk_fn(state, n):
+    def _put_epoch(s_np, t_np, m_np):
+        if mesh is None:
+            return jnp.asarray(s_np), jnp.asarray(t_np), jnp.asarray(m_np)
+        return tuple(
+            jax.device_put(jnp.asarray(a), dsh) for a in (s_np, t_np, m_np)
+        )
+
+    seqs_d = targets_d = mask_d = None
+    if not streamed:
+        seqs_d, targets_d, mask_d = _put_epoch(seqs, targets, mask)
+    if stats is not None:
+        jax.block_until_ready((params, seqs_d, targets_d, mask_d))
+        stats["place_s"] = monotonic_s() - t0
+
+    def _scan_steps(state, n, batch_fn):
         step0, params, opt_state = state
 
-        def step(carry, _):
+        def step(carry, i):
             params, opt_state = carry
             loss, grads = jax.value_and_grad(global_loss)(
-                params, seqs_d, targets_d, mask_d
+                params, *batch_fn(i, step0)
             )
             updates, opt_state = tx.update(grads, opt_state, params)
             return (optax.apply_updates(params, updates), opt_state), loss
 
         (params, opt_state), _ = jax.lax.scan(
-            step, (params, opt_state), None, length=n
+            step, (params, opt_state), jnp.arange(n)
         )
         return step0 + n, params, opt_state
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def chunk_full(state, n):
+        return _scan_steps(
+            state, n, lambda i, step0: (seqs_d, targets_d, mask_d)
+        )
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def chunk_staged(state, n):
+        def batch_fn(i, step0):
+            start = ((step0 + i) % n_batches) * B
+            return tuple(
+                jax.lax.dynamic_slice_in_dim(a, start, B)
+                for a in (seqs_d, targets_d, mask_d)
+            )
+
+        return _scan_steps(state, n, batch_fn)
+
+    @functools.partial(jax.jit, static_argnums=4)
+    def chunk_span(state, s_span, t_span, m_span, n):
+        def batch_fn(i, step0):
+            return tuple(
+                jax.lax.dynamic_slice_in_dim(a, i * B, B)
+                for a in (s_span, t_span, m_span)
+            )
+
+        return _scan_steps(state, n, batch_fn)
+
+    if streamed:
+        from pio_tpu.parallel.stream import (
+            epoch_spans,
+            span_bounds,
+            stream_feed,
+        )
+
+        bounds = span_bounds(n_batches, n_stream)
+
+        def chunk_fn(state, n):
+            step0 = int(jax.device_get(state[0]))
+            work = epoch_spans(step0, n, n_batches, bounds)
+
+            def encode(span):
+                b0, b1 = span
+                return tuple(
+                    np.ascontiguousarray(a[b0 * B:b1 * B])
+                    for a in (seqs, targets, mask)
+                )
+
+            def dispatch(st, dev, i):
+                b0, b1 = work[i]
+                return chunk_span(st, *dev, b1 - b0)
+
+            return stream_feed(
+                work,
+                encode=encode,
+                put=lambda host, _i: _put_epoch(*host),
+                init_carry=lambda: state,
+                dispatch=dispatch,
+                lookahead=2,
+                stats=stats,
+            )
+
+    elif cfg.batch_size > 0:
+        chunk_fn = chunk_staged
+    else:
+        chunk_fn = chunk_full
 
     from pio_tpu.workflow.checkpoint import (
         run_chunked_steps,
         state_fingerprint,
     )
 
-    # steps excluded: resume with a different total must still match
+    # steps excluded: resume with a different total must still match.
+    # stream normalized: streamed and staged feeds walk the SAME batch
+    # schedule, so their snapshots are interchangeable
     fingerprint = state_fingerprint(
-        "seqrec", dataclasses.replace(cfg, steps=0), n_items, seqs.shape,
-        int(seqs.sum()),
+        "seqrec", dataclasses.replace(cfg, steps=0, stream="auto"),
+        n_items, seqs.shape, int(seqs.sum()),
     )
     state = (jnp.int32(0), params, jax.jit(tx.init)(params))
     state = run_chunked_steps(

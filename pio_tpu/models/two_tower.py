@@ -58,6 +58,14 @@ class TwoTowerConfig:
     #: still float32 (values rounded to bf16 precision — ~3 decimal
     #: digits, standard practice for retrieval embeddings).
     table_wire: str = "float32"
+    #: epoch feed: "off" stages the full id arrays on device (the
+    #: historical path), "on" streams per-step batch spans through
+    #: parallel/stream.py (double-buffered h2d overlapping compute),
+    #: "auto" streams only when staging (params + epoch arrays) would
+    #: exceed PIO_TPU_DEVICE_BUDGET_BYTES. Streamed and staged runs
+    #: with the same seed/config produce identical params (the span
+    #: schedule replays the staged batch order exactly).
+    stream: str = "auto"
 
 
 @dataclasses.dataclass
@@ -163,15 +171,18 @@ def _contrastive_loss(user_p, item_p, uids, iids, cfg, d_axis, m_axis):
 class _TTTrainer:
     """Cached jitted pieces of one (mesh, static-config) two-tower setup."""
 
-    place: "callable"  # (params, uids, iids) → sharded device trees
+    init_params: "callable"  # (seed) → sharded param trees (never host)
+    place_data: "callable"  # (uids, iids) → staged device id arrays
+    put_span: "callable"  # (uids_np, iids_np) → streamed span arrays
     chunk: "callable"  # (state, uids_d, iids_d, n static) → state
+    stream_chunk: "callable"  # (state, u_span, i_span, n static) → state
     tx_init: "callable"
     vectors: "callable"  # (tower_params, vocab static) → [vocab, D]
 
 
 @functools.lru_cache(maxsize=32)
 def _build_tt_trainer(mesh, cfg: TwoTowerConfig, n_batches: int,
-                      batch: int) -> _TTTrainer:
+                      batch: int, vu: int, vi: int) -> _TTTrainer:
     """One compiled trainer per (mesh, shape-static config) — the
     als._build_trainer discipline, so bench repeats / eval sweeps /
     retrains don't pay XLA again."""
@@ -205,31 +216,52 @@ def _build_tt_trainer(mesh, cfg: TwoTowerConfig, n_batches: int,
             check_vma=False,
         )(params["user"], params["item"], ub, ib)
 
-    def place(params, uids, iids):
-        if mesh is None:
-            return params, jnp.asarray(uids), jnp.asarray(iids)
+    def _init_all(seed):
+        ku, ki = jax.random.split(jax.random.PRNGKey(seed))
+        return {
+            "user": _init_tower(ku, vu, cfg),
+            "item": _init_tower(ki, vi, cfg),
+        }
+
+    if mesh is None:
+        init_params = jax.jit(_init_all)
+    else:
+        # each device materializes only its table shard — a 10⁷–10⁸ row
+        # vocab never exists unsharded on any chip (or on host)
         param_shardings = jax.tree.map(
             lambda spec: NamedSharding(mesh, spec),
             specs,
             is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
         )
-        params = jax.tree.map(jax.device_put, params, param_shardings)
+        init_params = jax.jit(_init_all, out_shardings=param_shardings)
+
+    def place_data(uids, iids):
+        if mesh is None:
+            return jnp.asarray(uids), jnp.asarray(iids)
         data_sh = NamedSharding(mesh, P(None))
         return (
-            params,
             jax.device_put(jnp.asarray(uids), data_sh),
             jax.device_put(jnp.asarray(iids), data_sh),
         )
 
-    @functools.partial(jax.jit, static_argnums=3)
-    def chunk(state, uids_d, iids_d, n):
+    def put_span(u_np, i_np):
+        # span ids replicate like the staged epoch arrays (the batch
+        # rows split over "data" inside shard_map) so streamed steps
+        # see bit-identical inputs to staged ones
+        if mesh is None:
+            return jnp.asarray(u_np), jnp.asarray(i_np)
+        data_sh = NamedSharding(mesh, P(None))
+        return (
+            jax.device_put(u_np, data_sh),
+            jax.device_put(i_np, data_sh),
+        )
+
+    def _scan_steps(state, n, slice_fn):
         step0, params, opt_state = state
 
         def step(carry, i):
             params, opt_state = carry
-            start = ((step0 + i) % n_batches) * batch
-            ub = jax.lax.dynamic_slice_in_dim(uids_d, start, batch)
-            ib = jax.lax.dynamic_slice_in_dim(iids_d, start, batch)
+            ub, ib = slice_fn(i, step0)
             loss, grads = jax.value_and_grad(global_loss)(params, ub, ib)
             updates, opt_state = tx.update(grads, opt_state, params)
             return (optax.apply_updates(params, updates), opt_state), loss
@@ -238,6 +270,26 @@ def _build_tt_trainer(mesh, cfg: TwoTowerConfig, n_batches: int,
             step, (params, opt_state), jnp.arange(n)
         )
         return step0 + n, params, opt_state
+
+    @functools.partial(jax.jit, static_argnums=3)
+    def chunk(state, uids_d, iids_d, n):
+        def slice_fn(i, step0):
+            start = ((step0 + i) % n_batches) * batch
+            return (jax.lax.dynamic_slice_in_dim(uids_d, start, batch),
+                    jax.lax.dynamic_slice_in_dim(iids_d, start, batch))
+
+        return _scan_steps(state, n, slice_fn)
+
+    @functools.partial(jax.jit, static_argnums=3)
+    def stream_chunk(state, u_span, i_span, n):
+        # the span holds this chunk's batches contiguously: step i of
+        # the chunk is span row block i (the host scheduler aligned the
+        # span to the staged batch order)
+        def slice_fn(i, step0):
+            return (jax.lax.dynamic_slice_in_dim(u_span, i * batch, batch),
+                    jax.lax.dynamic_slice_in_dim(i_span, i * batch, batch))
+
+        return _scan_steps(state, n, slice_fn)
 
     @functools.partial(jax.jit, static_argnums=1)
     def vectors(tower_params, vocab):
@@ -257,7 +309,8 @@ def _build_tt_trainer(mesh, cfg: TwoTowerConfig, n_batches: int,
         )(tower_params, all_ids)
 
     return _TTTrainer(
-        place=place, chunk=chunk, tx_init=jax.jit(tx.init),
+        init_params=init_params, place_data=place_data, put_span=put_span,
+        chunk=chunk, stream_chunk=stream_chunk, tx_init=jax.jit(tx.init),
         vectors=vectors,
     )
 
@@ -285,6 +338,14 @@ def train_two_tower(
         stats: optional dict receiving the phase split — place_s (h2d),
             steps_s (compiled scan), tables_d2h_s (output readback) —
             measured by blocking between phases (profiling runs only).
+            Streamed runs additionally report the executor phases
+            (h2d_s/device_s/h2d_bytes/encode_s) and n_stream.
+
+    Raises:
+        DeviceBudgetExceeded: the params can't fit — single-chip when
+            ``mesh`` is None, or even sharded across the mesh. An epoch
+            that merely doesn't fit NEXT TO the params falls back to the
+            streamed feed instead (``stream="auto"``).
     """
     import jax
     import jax.numpy as jnp
@@ -293,6 +354,10 @@ def train_two_tower(
     if cfg.table_wire not in ("float32", "bfloat16"):
         raise ValueError(
             f"table_wire must be float32/bfloat16, got {cfg.table_wire!r}"
+        )
+    if cfg.stream not in ("auto", "on", "off"):
+        raise ValueError(
+            f"stream must be auto/on/off, got {cfg.stream!r}"
         )
     n_data = mesh_axis_size(mesh, "data")
     n_model = mesh_axis_size(mesh, "model")
@@ -313,46 +378,141 @@ def train_two_tower(
     iids = np.resize(iids, reps)
     n_batches = reps // batch
 
+    # placement accounting BEFORE anything lands on device: params must
+    # fit (sharded when a mesh is given — DeviceBudgetExceeded is the
+    # honest single-chip answer for a giant table), and staging the
+    # epoch id arrays next to them must fit or the feed streams instead
+    from pio_tpu.parallel.partition import (
+        assert_device_budget,
+        device_budget_bytes,
+        per_device_nbytes,
+    )
+
+    def _tower_skeleton(vocab):
+        shapes = {
+            "emb": (vocab, cfg.embed_dim),
+            "w1": (cfg.embed_dim, cfg.hidden),
+            "b1": (cfg.hidden,),
+            "w2": (cfg.hidden, cfg.out_dim),
+            "b2": (cfg.out_dim,),
+        }
+        z = np.zeros((), np.float32)
+        return {k: np.broadcast_to(z, s) for k, s in shapes.items()}
+
+    skeleton = {"user": _tower_skeleton(vu), "item": _tower_skeleton(vi)}
+    params_nbytes = sum(
+        a.nbytes for tower in skeleton.values() for a in tower.values()
+    )
+    staged_nbytes = 2 * reps * 4  # uids + iids, replicated per device
+    if mesh is None:
+        assert_device_budget(
+            params_nbytes, 1, "two_tower params (single-chip placement)"
+        )
+        params_pd = params_nbytes
+    else:
+        specs_pd = {"user": _tower_specs(), "item": _tower_specs()}
+        params_pd = per_device_nbytes(mesh, skeleton, specs_pd)
+        assert_device_budget(params_pd, 1, "two_tower sharded params")
+    budget = device_budget_bytes()
+    streamed = cfg.stream == "on" or (
+        cfg.stream == "auto"
+        and budget > 0
+        and params_pd + staged_nbytes > budget
+    )
+    n_stream = 0
+    if streamed:
+        from pio_tpu.parallel.stream import n_stream_chunks
+
+        n_stream = max(
+            2,
+            n_stream_chunks(staged_nbytes, "PIO_TPU_TRAIN_STREAM_MB",
+                            default="64", cap=256),
+        )
+        if budget > params_pd:
+            # every span must fit in the budget headroom beside params
+            n_stream = max(
+                n_stream, -(-staged_nbytes // (budget - params_pd))
+            )
+        n_stream = min(n_batches, n_stream)
+
     # jitted trainer cached per (mesh, static config) — repeated calls
     # (bench repeats, eval sweeps, serving retrains) recompile only on
     # shape changes (the als._build_trainer discipline). seed/steps/
-    # batch_size are zeroed in the key: they don't shape the program.
+    # batch_size/stream are zeroed in the key: they don't shape the
+    # program (both feed paths compile lazily off one trainer).
     tt = _build_tt_trainer(
         mesh,
         dataclasses.replace(cfg, steps=0, seed=0, batch_size=0,
-                            table_wire="float32"),
-        n_batches, batch,
+                            table_wire="float32", stream="auto"),
+        n_batches, batch, vu, vi,
     )
 
-    ku, ki = jax.random.split(jax.random.PRNGKey(cfg.seed))
-    params = {
-        "user": _init_tower(ku, vu, cfg),
-        "item": _init_tower(ki, vi, cfg),
-    }
-    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
     from pio_tpu.obs import monotonic_s
 
     t0 = monotonic_s()
-    params, uids_d, iids_d = tt.place(params, uids, iids)
+    params = tt.init_params(cfg.seed)
+    uids_d = iids_d = None
+    if not streamed:
+        uids_d, iids_d = tt.place_data(uids, iids)
     if stats is not None:
         jax.block_until_ready((params, uids_d, iids_d))
         stats["place_s"] = monotonic_s() - t0
+        stats["n_stream"] = n_stream
         t0 = monotonic_s()
 
-    def chunk_fn(state, n):
-        return tt.chunk(state, uids_d, iids_d, n)
+    if streamed:
+        from pio_tpu.parallel.stream import (
+            epoch_spans,
+            span_bounds,
+            stream_feed,
+        )
+
+        # span boundaries in batch units: n_stream near-even contiguous
+        # ranges of the epoch's batch sequence
+        bounds = span_bounds(n_batches, n_stream)
+
+        def chunk_fn(state, n):
+            step0 = int(jax.device_get(state[0]))
+            work = epoch_spans(step0, n, n_batches, bounds)
+
+            def encode(span):
+                b0, b1 = span
+                return (
+                    np.ascontiguousarray(uids[b0 * batch:b1 * batch]),
+                    np.ascontiguousarray(iids[b0 * batch:b1 * batch]),
+                )
+
+            def dispatch(st, dev, i):
+                b0, b1 = work[i]
+                return tt.stream_chunk(st, dev[0], dev[1], b1 - b0)
+
+            return stream_feed(
+                work,
+                encode=encode,
+                put=lambda host, _i: tt.put_span(*host),
+                init_carry=lambda: state,
+                dispatch=dispatch,
+                lookahead=2,
+                stats=stats,
+            )
+
+    else:
+        def chunk_fn(state, n):
+            return tt.chunk(state, uids_d, iids_d, n)
 
     from pio_tpu.workflow.checkpoint import (
         run_chunked_steps,
         state_fingerprint,
     )
 
-    # steps + table_wire excluded: neither shapes the trained state, so
-    # resuming an interrupted run with a different total or readback
-    # wire must still match the recorded identity
+    # steps + table_wire + stream excluded: none shapes the trained
+    # state (streamed and staged runs are parity-identical), so resuming
+    # an interrupted run with a different total, readback wire, or feed
+    # mode must still match the recorded identity
     fingerprint = state_fingerprint(
         "two_tower",
-        dataclasses.replace(cfg, steps=0, table_wire="float32"),
+        dataclasses.replace(cfg, steps=0, table_wire="float32",
+                            stream="auto"),
         n_users, n_items,
         reps, int(uids.sum()), int(iids.sum()),
     )

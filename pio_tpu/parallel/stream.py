@@ -1,0 +1,256 @@
+"""Streamed host→device feed executor — ONE streaming discipline in-tree.
+
+Generalizes the double-buffered shipment loop that ALS grew privately
+(``models/als.py _run_streamed``): an epoch is sliced into chunks, each
+chunk is encoded on host (quantize/pack/slice), its ``device_put``s are
+queued on the transfer stream, and the per-chunk compute program is
+dispatched so it waits only on its *own* inputs — chunk k's compute runs
+while chunk k+1 is still crossing the link. The same loop now feeds the
+two-tower and seqrec trainers (per-step minibatch spans instead of a
+staged epoch) and the ALS normal-equation accumulators.
+
+Two scheduling modes:
+
+- **queue-ahead** (``lookahead=0``, the ALS discipline): every chunk's
+  ``device_put`` is issued up front — they drain in order on the
+  transfer stream — then the chunk programs are chained. Right when all
+  chunks together fit on device (ALS retains the wire chunks for its
+  finalize program anyway).
+- **double-buffered** (``lookahead=k``): at most ``k`` chunks are
+  encoded/shipped ahead of the chunk whose compute the host last
+  synced, bounding device residency to ~``k+1`` chunks — the training
+  feed, where the whole epoch deliberately does NOT fit under
+  ``PIO_TPU_DEVICE_BUDGET_BYTES``. The host blocks on chunk
+  ``i-lookahead``'s carry before shipping further, which keeps the pipe
+  full (the next ``k`` chunks are already queued) without ever staging
+  the epoch.
+
+With a ``stats`` dict the phases are *serialized* (encode all → ship
+all + block → dispatch all + block) so each is measurable — overlap
+off, exactly ALS's profiling contract: ``h2d_s`` (transfer),
+``device_s`` (compute), the encode time under ``encode_stat_key``
+(ALS maps it onto its ``pack_s``), plus ``h2d_bytes``. Overlap itself
+is proven by comparing a profiled run's ``h2d_s + device_s`` against an
+overlapped run's wall time — :func:`record_overlap_ratio` computes the
+ratio and publishes the gauge.
+
+Failpoints: ``stream.encode`` / ``stream.put`` / ``stream.dispatch``
+fire per chunk per phase (fault-injection surface for the feed loop).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from pio_tpu.obs import REGISTRY
+from pio_tpu.utils.envutil import env_float
+
+#: host→device bytes shipped by the streamed training feed (all
+#: stream_feed callers: two-tower/seqrec batch spans, ALS wire chunks)
+_H2D_BYTES = REGISTRY.counter(
+    "pio_tpu_train_h2d_bytes_total",
+    "Host-to-device bytes shipped by the streamed training feed",
+)
+
+#: transfer time hidden behind compute, from the last profiled pair
+_OVERLAP = REGISTRY.gauge(
+    "pio_tpu_train_stream_overlap_ratio",
+    "Fraction of streamed-feed transfer time hidden behind compute "
+    "(profiled h2d_s + device_s vs overlapped wall time)",
+)
+
+
+def n_stream_chunks(n_bytes: int, env_var: str, default: str = "8",
+                    cap: int = 8) -> int:
+    """Chunk count for a streamed host→device shipment: ``ceil(bytes /
+    chunk_mb)`` capped at ``cap``; 1 (streaming off) when the env knob
+    is ≤ 0. THE sizing rule for every streamed wire (ALS edges, logreg
+    features, training batch spans) so the threshold semantics can't
+    drift — ``utils.numutil.n_stream_chunks`` delegates here."""
+    mb = env_float(env_var, float(default))
+    if mb <= 0:
+        return 1
+    return int(min(cap, -(-n_bytes // max(1, int(mb * 2 ** 20)))))
+
+
+def span_bounds(n_batches: int, n_stream: int) -> list:
+    """``n_stream`` near-even contiguous span boundaries over an epoch
+    of ``n_batches`` batches (``n_stream`` ≤ ``n_batches`` — strictly
+    increasing by construction)."""
+    n_stream = max(1, min(n_batches, n_stream))
+    return [n_batches * c // n_stream for c in range(n_stream + 1)]
+
+
+def epoch_spans(step0: int, n_steps: int, n_batches: int,
+                bounds: Sequence[int]) -> list:
+    """Batch spans covering steps ``[step0, step0 + n_steps)`` of a
+    wrapped epoch schedule (step ``s`` consumes batch ``s % n_batches``)
+    as ``(b0, b1)`` ranges — each a contiguous run of batches inside one
+    span of ``bounds``, clipped to the step range per epoch pass. The
+    streamed feed replays EXACTLY the staged batch order, which is what
+    makes streamed-vs-staged training parity bit-exact."""
+    import bisect
+
+    work = []
+    s, end = step0, step0 + n_steps
+    while s < end:
+        base = (s // n_batches) * n_batches
+        b0 = s - base
+        c = bisect.bisect_right(bounds, b0) - 1
+        b1 = min(bounds[c + 1], end - base)
+        work.append((b0, b1))
+        s = base + b1
+    return work
+
+
+def _tree_nbytes(tree: Any) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def stream_feed(
+    chunks: Sequence[Any],
+    *,
+    encode: Callable[[Any], Any],
+    dispatch: Callable[[Any, Any, int], Any],
+    init_carry: Callable[[], Any],
+    put: Optional[Callable[[Any, int], Any]] = None,
+    put_extra: Optional[Callable[[], Any]] = None,
+    finalize: Optional[Callable[[Any, tuple], Any]] = None,
+    lookahead: int = 0,
+    stats: Optional[dict] = None,
+    encode_stat_key: str = "encode_s",
+) -> Any:
+    """Run the streamed feed over ``chunks``; returns the final carry
+    (or ``finalize``'s result).
+
+    Args:
+        chunks: opaque per-chunk descriptors (span bounds, slices, …).
+        encode: ``chunk → host pytree`` — host-side slice/quantize/pack.
+        dispatch: ``(carry, device_chunk, idx) → carry`` — the chunk's
+            compute program; must not block (async dispatch is the
+            overlap).
+        init_carry: builds the initial carry at dispatch-phase start
+            (inside ``device_s`` when profiling — ALS's ``init(seed)``).
+        put: ``(host_pytree, idx) → device pytree``; default is a
+            tree-mapped ``jax.device_put``. Callers supply sharded puts
+            (``NamedSharding`` over batch axes) here — the "per-shard"
+            in per-shard streaming.
+        put_extra: optional once-per-run extra shipment (ALS's
+            counts_u/counts_i), issued after every chunk put so it rides
+            the same transfer-stream tail; timed inside ``h2d_s``.
+        finalize: ``(carry, device_chunks) → result``. When present the
+            device chunks are RETAINED and handed over (ALS re-decodes
+            the wire for the item side); when absent each chunk is
+            dropped right after its dispatch so streamed epochs never
+            accumulate on device.
+        lookahead: 0 → queue every put up front; k>0 → double-buffer,
+            at most k chunks in flight ahead of synced compute.
+        stats: phase-serialized profiling (see module docstring) —
+            overlap is OFF while measuring.
+        encode_stat_key: stats key the encode time accumulates under.
+    """
+    import jax
+
+    from pio_tpu.faults import failpoint
+    from pio_tpu.obs import monotonic_s
+
+    if put is None:
+        def put(host, _idx):
+            return jax.tree_util.tree_map(jax.device_put, host)
+
+    def _encode(i):
+        failpoint("stream.encode")
+        return encode(chunks[i])
+
+    def _put(host, i):
+        failpoint("stream.put")
+        nbytes = _tree_nbytes(host)
+        _H2D_BYTES.inc(nbytes)
+        if stats is not None:
+            stats["h2d_bytes"] = stats.get("h2d_bytes", 0) + nbytes
+        return put(host, i)
+
+    def _dispatch(carry, dev, i):
+        failpoint("stream.dispatch")
+        return dispatch(carry, dev, i)
+
+    n = len(chunks)
+    retain = finalize is not None
+
+    if stats is not None:
+        # serialized phases: host encode cost must not pollute the
+        # transfer measurement, so every chunk encodes first
+        t0 = monotonic_s()
+        encoded = [_encode(i) for i in range(n)]
+        stats[encode_stat_key] = stats.get(encode_stat_key, 0.0) + (
+            monotonic_s() - t0
+        )
+        t0 = monotonic_s()
+        devs = [_put(encoded[i], i) for i in range(n)]
+        extra = put_extra() if put_extra is not None else None
+        jax.block_until_ready((devs, extra))
+        stats["h2d_s"] = stats.get("h2d_s", 0.0) + (monotonic_s() - t0)
+        t0 = monotonic_s()
+        carry = init_carry()
+        for i in range(n):
+            carry = _dispatch(carry, devs[i], i)
+            if not retain:
+                devs[i] = None
+        result = finalize(carry, tuple(devs)) if retain else carry
+        jax.block_until_ready(result)
+        stats["device_s"] = stats.get("device_s", 0.0) + (
+            monotonic_s() - t0
+        )
+        return result
+
+    # overlapped: puts drain on the transfer stream while earlier
+    # chunks' (async-dispatched) programs compute
+    window = n if lookahead <= 0 else lookahead
+    devs: dict = {}
+    put_idx = 0
+    extra_done = put_extra is None
+    synced: list = []  # per-chunk carry leaf, for lookahead throttling
+    carry = init_carry()
+    for i in range(n):
+        while put_idx < min(n, i + window):
+            devs[put_idx] = _put(_encode(put_idx), put_idx)
+            put_idx += 1
+        if put_idx == n and not extra_done:
+            put_extra()
+            extra_done = True
+        carry = _dispatch(carry, devs[i], i)
+        if not retain:
+            del devs[i]
+        if lookahead > 0:
+            # bound device residency: before shipping chunk i+window,
+            # chunk i-lookahead's compute must be done (its carry is
+            # ready). The next `lookahead` chunks are already queued,
+            # so the device never starves while the host waits here.
+            synced.append(jax.tree_util.tree_leaves(carry)[:1])
+            j = i - lookahead
+            if j >= 0 and synced[j] is not None:
+                jax.block_until_ready(synced[j])
+                synced[j] = None
+    if not extra_done:
+        put_extra()
+    return finalize(carry, tuple(devs[i] for i in range(n))) if retain \
+        else carry
+
+
+def record_overlap_ratio(h2d_s: float, device_s: float,
+                         wall_s: float) -> float:
+    """Overlap achieved by a (profiled, overlapped) run pair: the
+    fraction of the smaller phase hidden inside the larger one —
+    ``(h2d_s + device_s - wall_s) / min(h2d_s, device_s)`` clamped to
+    [0, 1]. Publishes ``pio_tpu_train_stream_overlap_ratio``."""
+    lo = min(h2d_s, device_s)
+    ratio = 0.0 if lo <= 0 else max(
+        0.0, min(1.0, (h2d_s + device_s - wall_s) / lo)
+    )
+    _OVERLAP.set(ratio)
+    return ratio

@@ -197,6 +197,12 @@ class SeqRecParams(Params):
     seed: int = 0
     #: sequence-parallel attention mode: "ring" or "ulysses" (all-to-all)
     attention: str = "ring"
+    #: rows per optimizer step; 0 = full-batch (historical path),
+    #: > 0 enables minibatch SGD and the streamed epoch feed
+    batch_size: int = 0
+    #: epoch feed: "off" stages on device, "on" streams row spans,
+    #: "auto" streams only past PIO_TPU_DEVICE_BUDGET_BYTES
+    stream: str = "auto"
     #: mesh splits; remaining devices ride the data axis
     seq_parallel: int = 1
     pipe_parallel: int = 1
@@ -277,6 +283,8 @@ class SeqRecAlgorithm(Algorithm):
                 steps=p.steps,
                 attention=p.attention,
                 seed=p.seed,
+                batch_size=p.batch_size,
+                stream=p.stream,
             ),
             checkpoint=ctx.checkpoint,
             checkpoint_every=ctx.checkpoint_every,

@@ -64,6 +64,10 @@ class TwoTowerParams(Params):
     steps: int = 500
     batch_size: int = 256
     seed: int = 0
+    #: epoch feed: "off" stages the batches on device, "on" streams batch
+    #: spans through parallel/stream.py, "auto" streams only when staging
+    #: would exceed PIO_TPU_DEVICE_BUDGET_BYTES
+    stream: str = "auto"
     #: mesh split: model axis size (tp/ep); remaining devices ride data (dp)
     model_parallel: int = 1
 
@@ -131,6 +135,7 @@ class TwoTowerAlgorithm(Algorithm):
                 steps=p.steps,
                 batch_size=p.batch_size,
                 seed=p.seed,
+                stream=p.stream,
             ),
             checkpoint=ctx.checkpoint,
             checkpoint_every=ctx.checkpoint_every,

@@ -2,8 +2,6 @@
 
 from __future__ import annotations
 
-from pio_tpu.utils.envutil import env_float
-
 
 def round_up(x: int, mult: int) -> int:
     """Smallest multiple of ``mult`` ≥ ``x``."""
@@ -12,11 +10,11 @@ def round_up(x: int, mult: int) -> int:
 
 def n_stream_chunks(n_bytes: int, env_var: str, default: str = "8",
                     cap: int = 8) -> int:
-    """Chunk count for a streamed host→device shipment: ``ceil(bytes /
-    chunk_mb)`` capped at ``cap``; 1 (streaming off) when the env knob
-    is ≤ 0. Shared by the ALS single-device/mesh wires and the logreg
-    feature wire so the threshold semantics can't drift."""
-    mb = env_float(env_var, float(default))
-    if mb <= 0:
-        return 1
-    return int(min(cap, -(-n_bytes // max(1, int(mb * 2 ** 20)))))
+    """Chunk count for a streamed host→device shipment — the sizing
+    rule lives with the executor (``parallel/stream.py``); this wrapper
+    keeps the historical import path for the model trainers. Lazy
+    import: numutil must stay importable without the parallel package
+    (and its obs registration) on the path."""
+    from pio_tpu.parallel.stream import n_stream_chunks as impl
+
+    return impl(n_bytes, env_var, default=default, cap=cap)

@@ -1268,6 +1268,152 @@ finally:
 PY
 echo "ok   mesh-sharded serving: sharding block populated, retraces flat, host parity"
 
+# --------------------------------------------- streamed sharded training
+# ISSUE 14: the stream.* failpoints must be dump-visible, then a
+# two-tower engine whose params exceed a tiny per-chip budget must (a)
+# refuse single-chip placement, (b) train mesh-sharded with the epoch
+# STREAMING through parallel/stream.py (the h2d counter moves), (c)
+# persist sharded, and (d) deploy on the mesh answering at exact parity
+# with the host-scored reference.
+python -m pio_tpu.tools.cli lint --dump-failpoints pio_tpu | python -c '
+import json, sys
+inv = {f["point"] for f in json.load(sys.stdin)["failpoints"]}
+need = {"stream.encode", "stream.put", "stream.dispatch"}
+missing = need - inv
+assert not missing, f"stream failpoints missing from inventory: {missing}"
+' || fail "stream.* failpoints missing from --dump-failpoints"
+echo "ok   stream.encode/put/dispatch failpoints in lint inventory"
+
+python - <<'PY' || fail "streamed-training stage (budget/stream/persist/parity assertions)"
+"""Smoke stage: streamed sharded training end to end.
+
+Budget arithmetic at this scale: the two-tower params are 1792 B
+unsharded, ~930 B/device sharded over model=2, and the staged epoch id
+arrays are 768 B — so a 1200 B/chip budget rejects single-chip
+placement, fits the sharded tables, and forces the auto feed to stream
+batch spans (params + staged epoch would be ~1700 B).
+"""
+import datetime as dt
+import json
+import os
+import urllib.request
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "MEM"
+os.environ["PIO_STORAGE_SOURCES_MEM_TYPE"] = "memory"
+os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "MEM"
+os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "MEM"
+os.environ["PIO_TPU_SHARDED_PERSIST"] = "1"
+os.environ["PIO_TPU_MESH_SERVE"] = "1"
+
+import numpy as np
+
+import pio_tpu.templates  # noqa: F401  (registers the factory)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.server import create_query_server
+from pio_tpu.storage import App, Storage
+from pio_tpu.templates.recommendation import Query
+from pio_tpu.workflow import (
+    build_engine, load_models_for_instance, run_train, variant_from_dict,
+)
+
+app_id = Storage.get_meta_data_apps().insert(App(0, "smoke-stream"))
+le = Storage.get_levents()
+t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+for u in range(12):
+    for i in range(8):
+        in_block = (u < 6) == (i < 4)
+        le.insert(
+            Event("rate", "user", f"u{u}", "item", f"i{i}",
+                  properties={"rating": 5.0 if in_block else 1.0},
+                  event_time=t0 + dt.timedelta(minutes=u * 60 + i)),
+            app_id,
+        )
+variant = variant_from_dict({
+    "id": "smoke-streamed",
+    "engineFactory": "templates.twotower",
+    "datasource": {"params": {"app_name": "smoke-stream"}},
+    "algorithms": [{"name": "twotower", "params": {
+        "embed_dim": 8, "hidden": 8, "out_dim": 8, "steps": 30,
+        "batch_size": 16, "model_parallel": 2, "seed": 1}}],
+})
+engine, ep = build_engine(variant)
+ctx = ComputeContext.create(seed=0)
+assert ctx.num_devices == 8, f"expected 8 simulated devices, got {ctx.num_devices}"
+
+os.environ["PIO_TPU_DEVICE_BUDGET_BYTES"] = "1200"
+
+# (a) single-chip placement must refuse the budget
+from pio_tpu.models.two_tower import TwoTowerConfig, train_two_tower
+from pio_tpu.parallel.partition import DeviceBudgetExceeded
+
+rng = np.random.default_rng(0)
+cfg = TwoTowerConfig(embed_dim=8, hidden=8, out_dim=8, steps=30,
+                     batch_size=16, seed=1)
+try:
+    train_two_tower(None, rng.integers(0, 12, 96).astype(np.int32),
+                    rng.integers(0, 8, 96).astype(np.int32), 12, 8, cfg)
+except DeviceBudgetExceeded:
+    pass
+else:
+    raise AssertionError("single-chip placement ignored the budget")
+
+# (b) mesh training streams: the feed's h2d counter must move
+from pio_tpu.parallel.stream import _H2D_BYTES
+
+h2d0 = _H2D_BYTES.value()
+iid = run_train(engine, ep, variant, ctx=ctx)
+h2d = _H2D_BYTES.value() - h2d0
+assert h2d > 0, "training under budget did not stream (h2d counter flat)"
+
+# (c) sharded persist artifacts exist (blob is shard-stripped)
+ms = Storage.get_model_data_models()
+assert ms.get(iid + ".shards") is not None, "shard manifest missing"
+
+# (d) mesh deploy answers at exact parity with the host reference
+models = load_models_for_instance(iid, engine, ep, ctx)
+serving = engine.make_serving(ep)
+os.environ["PIO_TPU_SERVE_DEVICE"] = "host"
+pairs = engine.algorithms_with_models(ep, models)
+os.environ.pop("PIO_TPU_SERVE_DEVICE", None)
+
+def host_ref(user, num):
+    q = Query(user=user, num=num)
+    preds = [algo.predict(m, q) for algo, m in pairs]
+    return [s.item for s in serving.serve(q, preds).item_scores]
+
+server, _service = create_query_server(
+    variant, host="127.0.0.1", port=0, ctx=ctx
+)
+server.start()
+try:
+    base = f"http://127.0.0.1:{server.port}"
+
+    def post(body):
+        req = urllib.request.Request(
+            base + "/queries.json",
+            data=json.dumps(body).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    for q in range(24):
+        user = f"u{q % 12}"
+        got = post({"user": user, "num": 4})
+        assert [s["item"] for s in got["itemScores"]] == host_ref(user, 4), (
+            user, got)
+    print(f"streamed stage: h2d={int(h2d)}B streamed through the feed, "
+          f"sharded persist + mesh deploy, parity exact over 24 requests")
+finally:
+    server.stop()
+PY
+echo "ok   streamed sharded training: budget refusal, streamed feed, sharded persist, serve parity"
+
 # -------------------------------------------------- fleet federation
 # ISSUE 11: the fleet telemetry plane. Three live members — a
 # replicated-partlog event leader (subprocess), its follower's status

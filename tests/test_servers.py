@@ -348,7 +348,11 @@ class TestEventServer:
     def test_traces_json(self, eventserver, app_and_key):
         _, key = app_and_key
         http("POST", f"{eventserver}/events.json?accessKey={key}", EV)
-        _, body = http("GET", f"{eventserver}/traces.json?n=5")
+        # commits=0: the default merged view ranks this request against
+        # the process-global commit ring (slowest first), so an unlucky
+        # slow flush from an EARLIER test would displace it — the merge
+        # itself is covered by test_commit_ring_merged_into_traces
+        _, body = http("GET", f"{eventserver}/traces.json?n=5&commits=0")
         traces = body["traces"]
         assert traces and traces[0]["kind"] == "event"
         stages = {s["stage"] for t in traces for s in t["spans"]}
