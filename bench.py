@@ -2611,31 +2611,21 @@ def history_delta_table(prev: dict, cur: dict,
                         threshold: float) -> tuple:
     """``(table_lines, regressed_fields)`` comparing two history rows.
     A field counts as a regression when it moves AGAINST its good
-    direction by more than ``threshold`` (fractional, e.g. 0.05)."""
+    direction by more than ``threshold`` (fractional, e.g. 0.05).
+    The direction-aware comparison itself is shared with the training
+    run ledger (``pio runs --diff``) via trainwatch."""
+    from pio_tpu.obs.trainwatch import delta_rows
+
+    rows, regressed = delta_rows(prev, cur, HISTORY_FIELDS, threshold)
     lines = [
         f"bench history delta vs {prev.get('git_sha') or '?'} "
         f"({prev.get('timestamp') or '?'}), threshold "
         f"{threshold * 100:.1f}%:",
         f"  {'field':<20} {'prev':>12} {'now':>12} {'delta':>9}",
     ]
-    regressed = []
-    for field, direction in HISTORY_FIELDS:
-        a, b = prev.get(field), cur.get(field)
-        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
-            continue
-        pct = (b - a) / a if a else None
-        if pct is None:
-            tag = ""
-            delta = "n/a"
-        else:
-            delta = f"{pct * 100:+.1f}%"
-            bad = pct < -threshold if direction == "up" else pct > threshold
-            good = pct > threshold if direction == "up" else pct < -threshold
-            tag = "  REGRESSION" if bad else ("  improved" if good else "")
-            if bad:
-                regressed.append(field)
+    for field, a, b, delta, tag in rows:
         lines.append(f"  {field:<20} {a:>12} {b:>12} {delta:>9}{tag}")
-    if len(lines) == 2:
+    if not rows:
         lines.append("  (no comparable numeric fields)")
     return lines, regressed
 
@@ -2710,6 +2700,39 @@ def maybe_record_history(full: dict, summary: dict, argv: list) -> None:
                   "recorded", file=sys.stderr)
     except Exception as exc:
         print(f"# bench history failed: {exc}", file=sys.stderr)
+
+
+def run_check_history(argv: list) -> int:
+    """``bench.py --check-history``: no benchmark run — read the ledger,
+    diff the last two rows with the matching smoke flag, exit 1 on a
+    regression past the threshold. Smoke wires this after its bench
+    stage so a silent slowdown fails the pipeline loudly (ISSUE 16).
+    Must run before :func:`main`'s PIO_TPU_HOME override — it only
+    reads the ledger, it must not create a throwaway home."""
+    opts = parse_history_argv(argv)
+    path = opts["history_file"] or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), HISTORY_BASENAME
+    )
+    rows = read_history(path)
+    if not rows:
+        print(f"# no bench history at {path}; nothing to check",
+              file=sys.stderr)
+        return 0
+    same = [r for r in rows if r.get("smoke") == rows[-1].get("smoke")]
+    if len(same) < 2:
+        print("# only one comparable run in ledger; baseline recorded, "
+              "nothing to diff", file=sys.stderr)
+        return 0
+    lines, regressed = history_delta_table(
+        same[-2], same[-1], opts["threshold"]
+    )
+    for line in lines:
+        print(f"# {line}", file=sys.stderr)
+    if regressed:
+        print(f"# REGRESSION in: {', '.join(regressed)}", file=sys.stderr)
+        return 1
+    print("# no regression past threshold", file=sys.stderr)
+    return 0
 
 
 def main() -> None:
@@ -3009,4 +3032,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--check-history" in sys.argv[1:]:
+        sys.exit(run_check_history(sys.argv[1:]))
     main()

@@ -122,6 +122,8 @@ FAILPOINT_NAMESPACES = (
     "shard.",
     # streamed training feed executor (parallel/stream.py, ISSUE 14)
     "stream.",
+    # training telemetry plane (obs/trainwatch.py, ISSUE 16)
+    "trainwatch.",
 )
 
 
@@ -358,7 +360,8 @@ class SpanNameRule(Rule):
 #: high-churn metric namespaces whose docs/observability.md rows must
 #: have a live registration (or collector emission) in the source set —
 #: a row surviving a family rename/removal would document a phantom
-_CATALOG_DRIFT_PREFIXES = ("pio_tpu_fleet_", "pio_tpu_repl_")
+_CATALOG_DRIFT_PREFIXES = ("pio_tpu_fleet_", "pio_tpu_repl_",
+                           "pio_tpu_train_")
 
 _CATALOG_ROW_RE = re.compile(r"^\|\s*`(pio_tpu_[a-z0-9_]+)`\s*\|")
 
@@ -368,7 +371,8 @@ class MetricCatalogDriftRule(ProjectRule):
     id = "metric-catalog-drift"
     family = "convention"
     description = (
-        "Every documented pio_tpu_fleet_*/pio_tpu_repl_* catalog row in "
+        "Every documented pio_tpu_fleet_*/pio_tpu_repl_*/pio_tpu_train_* "
+        "catalog row in "
         "docs/observability.md must correspond to a live registration "
         "or collector emission in the linted sources (the inverse of "
         "metric-name: code->doc there, doc->code here)."

@@ -164,14 +164,36 @@ class Engine:
         (``read``, ``prepare``, ``train:<i>_<algo>``) — the rebuild's
         answer to the reference's Spark-UI stage view (SURVEY.md §5
         tracing).
+
+        When a trace is open (run_train's TRAIN_TRACER), each phase runs
+        inside a LIVE span named with the dot convention (``read``,
+        ``prepare``, ``train.<i>_<algo>``) — its log records carry
+        ``(trace_id, span)`` so ``/logs.json?trace_id=`` reassembles the
+        run, and the trainwatch recorder's ``phase`` field follows along
+        for ``/train.json``. The ``timings`` keys keep their historical
+        colon form (instance env ``phase_train:<i>_<algo>`` is an API).
         """
-        from pio_tpu.obs import monotonic_s
+        import contextlib as _ctxlib
+
+        from pio_tpu.obs import active_trace, monotonic_s, trainwatch
 
         def _phase(name, fn):
+            span_name = name.replace(":", ".")
+            trainwatch.set_phase(span_name)
+            tr = active_trace()
+            span_cm = (
+                tr.span(span_name) if tr is not None
+                else _ctxlib.nullcontext()
+            )
             t0 = monotonic_s()
-            out = fn()
+            with span_cm:
+                out = fn()
+            dur = round(monotonic_s() - t0, 3)
             if timings is not None:
-                timings[name] = round(monotonic_s() - t0, 3)
+                timings[name] = dur
+            trainwatch_rec = trainwatch.active_recorder()
+            if trainwatch_rec is not None:
+                trainwatch_rec.set_phase_seconds(span_name, dur)
             return out
 
         data_source = self.data_source_class(engine_params.data_source_params)

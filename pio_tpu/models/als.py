@@ -43,7 +43,7 @@ import dataclasses
 import functools
 import itertools
 import os
-from pio_tpu.obs import monotonic_s
+from pio_tpu.obs import monotonic_s, trainwatch
 from typing import Optional, Tuple
 
 import numpy as np
@@ -834,6 +834,10 @@ def _run_streamed(config: "ALSConfig", rank: int, U_pad: int, I_pad: int,
         Q0, A, b, user_blocks = carry
         wire, lc = dev
         A, b, blk = accums[c](A, b, Q0, lc, *wire)
+        # chunk progress for the telemetry plane: ALS has no per-step
+        # loss (normal equations), so progress is edges accumulated
+        e0, e1 = spans[c]
+        trainwatch.record_steps(0, examples=e1 - e0)
         return Q0, A, b, user_blocks + (blk,)
 
     def fin(carry, devs):
@@ -1224,6 +1228,14 @@ def train_als(
     U_pad = _round_up(max(n_users, 1), n_shards)
     I_pad = _round_up(max(n_items, 1), n_shards)
 
+    # telemetry window: ALS "steps" are the alternating solve iterations
+    # (no per-step loss — normal equations); edges count as examples
+    trainwatch.begin_algo(
+        "als", total_steps=int(config.iterations),
+        per_device_bytes=(U_pad + I_pad) * K * 4 // max(1, n_shards),
+    )
+    edges_recorded = False
+
     w_user = config.block_width or _auto_width(n_edges, n_users)
     w_item = config.block_width or _auto_width(n_edges, n_items)
 
@@ -1400,6 +1412,8 @@ def train_als(
         if stats is not None:
             stats["n_stream"] = max(1, n_stream)
         if n_stream > 1:
+            trainwatch.set_stream(True, n_stream)
+            edges_recorded = True  # _run_streamed records per chunk
             P_f, Q_f = _run_streamed(
                 config, K, U_pad, I_pad, w_user, w_item, S_i, chunk_item,
                 counts_u, counts_i, i_sorted, r_ship, rating_wire,
@@ -1436,6 +1450,10 @@ def train_als(
                 P_f, Q_f = run(*args, seed)
 
     P_f, Q_f = jax.device_get((P_f, Q_f))
+    trainwatch.record_steps(
+        int(config.iterations),
+        examples=0 if edges_recorded else n_edges,
+    )
     return ALSFactors(
         user_factors=np.asarray(P_f)[:n_users],
         item_factors=np.asarray(Q_f)[:n_items],

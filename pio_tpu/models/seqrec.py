@@ -582,10 +582,12 @@ def train_seqrec(
             updates, opt_state = tx.update(grads, opt_state, params)
             return (optax.apply_updates(params, updates), opt_state), loss
 
-        (params, opt_state), _ = jax.lax.scan(
+        (params, opt_state), losses = jax.lax.scan(
             step, (params, opt_state), jnp.arange(n)
         )
-        return step0 + n, params, opt_state
+        # per-step losses ride along for the telemetry plane; callers
+        # that don't want them drop the array undereferenced (no sync)
+        return (step0 + n, params, opt_state), losses
 
     @functools.partial(jax.jit, static_argnums=1)
     def chunk_full(state, n):
@@ -614,6 +616,36 @@ def train_seqrec(
 
         return _scan_steps(state, n, batch_fn)
 
+    from pio_tpu.obs import trainwatch
+
+    trainwatch.begin_algo(
+        "seqrec", total_steps=cfg.steps, n_batches=n_batches,
+        streamed=streamed, n_stream=n_stream,
+        per_device_bytes=params_pd,
+    )
+    # lagged loss drain (the two_tower discipline): per-step losses come
+    # back as device arrays and are fetched one chunk behind the
+    # dispatch frontier; no recorder → dropped undereferenced.
+    _pending: list = []
+    _last_drain = [monotonic_s()]
+
+    def _drain(keep: int = 0):
+        while len(_pending) > keep:
+            n_s, dev = _pending.pop(0)
+            vals = np.asarray(jax.device_get(dev), np.float32)
+            now = monotonic_s()
+            trainwatch.record_steps(
+                int(n_s), losses=[float(v) for v in vals],
+                examples=int(n_s) * B, dur_s=now - _last_drain[0],
+            )
+            _last_drain[0] = now
+
+    def _note_chunk(n_s, losses_dev, keep: int):
+        if trainwatch.active_recorder() is None:
+            return
+        _pending.append((n_s, losses_dev))
+        _drain(keep)
+
     if streamed:
         from pio_tpu.parallel.stream import (
             epoch_spans,
@@ -624,6 +656,7 @@ def train_seqrec(
         bounds = span_bounds(n_batches, n_stream)
 
         def chunk_fn(state, n):
+            _drain()
             step0 = int(jax.device_get(state[0]))
             work = epoch_spans(step0, n, n_batches, bounds)
 
@@ -636,7 +669,9 @@ def train_seqrec(
 
             def dispatch(st, dev, i):
                 b0, b1 = work[i]
-                return chunk_span(st, *dev, b1 - b0)
+                st, losses = chunk_span(st, *dev, b1 - b0)
+                _note_chunk(b1 - b0, losses, keep=2)
+                return st
 
             return stream_feed(
                 work,
@@ -649,9 +684,17 @@ def train_seqrec(
             )
 
     elif cfg.batch_size > 0:
-        chunk_fn = chunk_staged
+        def chunk_fn(state, n):
+            _drain()
+            state, losses = chunk_staged(state, n)
+            _note_chunk(n, losses, keep=1)
+            return state
     else:
-        chunk_fn = chunk_full
+        def chunk_fn(state, n):
+            _drain()
+            state, losses = chunk_full(state, n)
+            _note_chunk(n, losses, keep=1)
+            return state
 
     from pio_tpu.workflow.checkpoint import (
         run_chunked_steps,
@@ -671,6 +714,7 @@ def train_seqrec(
         checkpoint=checkpoint, checkpoint_every=checkpoint_every,
         fingerprint=fingerprint,
     )
+    _drain()  # flush the telemetry tail (no-op without a recorder)
     fitted = state[1]
 
     # ONE fused pull (device_get returns host numpy): per-leaf

@@ -174,8 +174,8 @@ class _TTTrainer:
     init_params: "callable"  # (seed) → sharded param trees (never host)
     place_data: "callable"  # (uids, iids) → staged device id arrays
     put_span: "callable"  # (uids_np, iids_np) → streamed span arrays
-    chunk: "callable"  # (state, uids_d, iids_d, n static) → state
-    stream_chunk: "callable"  # (state, u_span, i_span, n static) → state
+    chunk: "callable"  # (state, uids_d, iids_d, n static) → (state, losses)
+    stream_chunk: "callable"  # (state, u_span, i_span, n static) → (state, losses)
     tx_init: "callable"
     vectors: "callable"  # (tower_params, vocab static) → [vocab, D]
 
@@ -266,10 +266,12 @@ def _build_tt_trainer(mesh, cfg: TwoTowerConfig, n_batches: int,
             updates, opt_state = tx.update(grads, opt_state, params)
             return (optax.apply_updates(params, updates), opt_state), loss
 
-        (params, opt_state), _ = jax.lax.scan(
+        (params, opt_state), losses = jax.lax.scan(
             step, (params, opt_state), jnp.arange(n)
         )
-        return step0 + n, params, opt_state
+        # per-step losses ride along for the telemetry plane; callers
+        # that don't want them drop the array undereferenced (no sync)
+        return (step0 + n, params, opt_state), losses
 
     @functools.partial(jax.jit, static_argnums=3)
     def chunk(state, uids_d, iids_d, n):
@@ -447,7 +449,38 @@ def train_two_tower(
         n_batches, batch, vu, vi,
     )
 
-    from pio_tpu.obs import monotonic_s
+    from pio_tpu.obs import monotonic_s, trainwatch
+
+    trainwatch.begin_algo(
+        "two_tower", total_steps=cfg.steps, n_batches=n_batches,
+        streamed=streamed, n_stream=n_stream,
+        per_device_bytes=params_pd,
+    )
+    # lagged loss drain: the scan chunks hand their per-step losses back
+    # as device arrays; each is fetched one chunk BEHIND the dispatch
+    # frontier (that chunk's compute is already proven done by the feed
+    # throttle / the state dependency), so telemetry never stalls the
+    # pipe. With no active recorder the arrays drop undereferenced —
+    # library callers (tests, bench) pay nothing.
+    _pending: list = []
+    _last_drain = [monotonic_s()]
+
+    def _drain(keep: int = 0):
+        while len(_pending) > keep:
+            n_s, dev = _pending.pop(0)
+            vals = np.asarray(jax.device_get(dev), np.float32)
+            now = monotonic_s()
+            trainwatch.record_steps(
+                int(n_s), losses=[float(v) for v in vals],
+                examples=int(n_s) * batch, dur_s=now - _last_drain[0],
+            )
+            _last_drain[0] = now
+
+    def _note_chunk(n_s, losses_dev, keep: int):
+        if trainwatch.active_recorder() is None:
+            return
+        _pending.append((n_s, losses_dev))
+        _drain(keep)
 
     t0 = monotonic_s()
     params = tt.init_params(cfg.seed)
@@ -472,6 +505,7 @@ def train_two_tower(
         bounds = span_bounds(n_batches, n_stream)
 
         def chunk_fn(state, n):
+            _drain()
             step0 = int(jax.device_get(state[0]))
             work = epoch_spans(step0, n, n_batches, bounds)
 
@@ -484,7 +518,9 @@ def train_two_tower(
 
             def dispatch(st, dev, i):
                 b0, b1 = work[i]
-                return tt.stream_chunk(st, dev[0], dev[1], b1 - b0)
+                st, losses = tt.stream_chunk(st, dev[0], dev[1], b1 - b0)
+                _note_chunk(b1 - b0, losses, keep=2)
+                return st
 
             return stream_feed(
                 work,
@@ -498,7 +534,10 @@ def train_two_tower(
 
     else:
         def chunk_fn(state, n):
-            return tt.chunk(state, uids_d, iids_d, n)
+            _drain()
+            state, losses = tt.chunk(state, uids_d, iids_d, n)
+            _note_chunk(n, losses, keep=1)
+            return state
 
     from pio_tpu.workflow.checkpoint import (
         run_chunked_steps,
@@ -522,6 +561,7 @@ def train_two_tower(
         checkpoint=checkpoint, checkpoint_every=checkpoint_every,
         fingerprint=fingerprint,
     )
+    _drain()  # flush the telemetry tail (no-op without a recorder)
     fitted = state[1]
     if stats is not None:
         jax.block_until_ready(fitted)

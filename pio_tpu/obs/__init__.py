@@ -30,8 +30,10 @@ The ops plane on top (ISSUE 2):
   multi-window burn rates — ``GET /slo.json`` + ``pio_tpu_slo_*`` gauges.
 
 Plus :mod:`pio_tpu.obs.profile` (the opt-in ``PIO_TPU_PROFILE=dir`` JAX
-profiler hook) and :mod:`pio_tpu.obs.promparse` (a small text-format
-parser shared by tests, bench.py and the dashboard).
+profiler hook), :mod:`pio_tpu.obs.promparse` (a small text-format
+parser shared by tests, bench.py and the dashboard) and
+:mod:`pio_tpu.obs.trainwatch` (the training telemetry plane — step
+stream, ``/train.json`` progress, run ledger).
 
 ``monotonic_s`` is THE process-wide monotonic clock for durations —
 serving paths used to mix ``time.monotonic()`` and
@@ -54,6 +56,7 @@ from pio_tpu.obs.metrics import (
     escape_label_value,
     monotonic_s,
 )
+from pio_tpu.obs import trainwatch
 from pio_tpu.obs.health import Heartbeat, HealthMonitor
 from pio_tpu.obs.hotpath import hotpath_payload
 from pio_tpu.obs.slo import SLOEngine, SLObjective, parse_duration_s, parse_slo
@@ -92,4 +95,5 @@ __all__ = [
     "parse_trace_header",
     "parse_slo",
     "parse_duration_s",
+    "trainwatch",
 ]

@@ -102,6 +102,7 @@ class _Member:
         self.slo: Optional[dict] = None
         self.storage: Optional[dict] = None
         self.stats: Optional[dict] = None
+        self.train: Optional[dict] = None
 
     def age_s(self) -> Optional[float]:
         if self.last_ok is None:
@@ -121,6 +122,8 @@ class _Member:
     def role(self) -> str:
         if self.storage is not None and "role" in self.storage:
             return str(self.storage["role"])
+        if self.train is not None:
+            return "trainer"
         if self.stats is not None and "residency" in self.stats:
             return "query"
         if self.storage is not None:
@@ -256,6 +259,7 @@ class FleetAggregator:
         slo = self._get_json(m, "/slo.json")
         storage = self._get_json(m, "/storage.json")
         stats = self._get_json(m, "/stats.json")
+        train = self._get_json(m, "/train.json")
         with self._lock:
             m.metrics = parsed
             m.last_ok = monotonic_s()
@@ -268,6 +272,8 @@ class FleetAggregator:
                 m.storage = storage
             if stats is not None:
                 m.stats = stats
+            if train is not None:
+                m.train = train
         return True
 
     def _record_error(self, m: _Member, reason: str, msg: str) -> None:
@@ -386,6 +392,21 @@ class FleetAggregator:
 
     def _member_entry(self, m: _Member) -> dict:
         age = m.age_s()
+        training = None
+        if m.train is not None:
+            # compact view of the member's /train.json (full payload on
+            # the member itself; the fleet view carries the progress row)
+            training = {
+                "runId": m.train.get("runId"),
+                "phase": m.train.get("phase"),
+                "algo": m.train.get("algo"),
+                "step": m.train.get("step"),
+                "totalSteps": m.train.get("totalSteps"),
+                "epoch": m.train.get("epoch"),
+                "progress": m.train.get("progress"),
+                "etaSeconds": m.train.get("etaSeconds"),
+                "loss": m.train.get("loss"),
+            }
         return {
             "member": m.name,
             "url": m.url,
@@ -396,6 +417,7 @@ class FleetAggregator:
             "scrapes": m.attempts,
             "scrapeErrors": m.errors,
             "lastError": m.last_error,
+            "training": training,
         }
 
     def _slo_rollup(self) -> dict:

@@ -32,7 +32,12 @@ off, exactly ALS's profiling contract: ``h2d_s`` (transfer),
 (ALS maps it onto its ``pack_s``), plus ``h2d_bytes``. Overlap itself
 is proven by comparing a profiled run's ``h2d_s + device_s`` against an
 overlapped run's wall time — :func:`record_overlap_ratio` computes the
-ratio and publishes the gauge.
+ratio and publishes the gauge. With an active trainwatch recorder
+(a real ``pio train``), overlapped runs self-measure: chunk 0 runs
+phase-serialized as a probe (extra blocks only — the math stays
+bit-exact) and the remaining chunks' wall time yields the ratio, so
+``pio_tpu_train_stream_overlap_ratio`` reports from real runs, not
+just bench.
 
 Failpoints: ``stream.encode`` / ``stream.put`` / ``stream.dispatch``
 fire per chunk per phase (fault-injection surface for the feed loop).
@@ -157,7 +162,7 @@ def stream_feed(
     import jax
 
     from pio_tpu.faults import failpoint
-    from pio_tpu.obs import monotonic_s
+    from pio_tpu.obs import monotonic_s, trainwatch
 
     if put is None:
         def put(host, _idx):
@@ -167,10 +172,14 @@ def stream_feed(
         failpoint("stream.encode")
         return encode(chunks[i])
 
+    shipped = [0]  # bytes shipped this call (overlap-probe bookkeeping)
+
     def _put(host, i):
         failpoint("stream.put")
         nbytes = _tree_nbytes(host)
         _H2D_BYTES.inc(nbytes)
+        shipped[0] += nbytes
+        trainwatch.record_h2d(nbytes)
         if stats is not None:
             stats["h2d_bytes"] = stats.get("h2d_bytes", 0) + nbytes
         return put(host, i)
@@ -216,7 +225,33 @@ def stream_feed(
     extra_done = put_extra is None
     synced: list = []  # per-chunk carry leaf, for lookahead throttling
     carry = init_carry()
-    for i in range(n):
+    probe = None
+    start = 0
+    rec = trainwatch.active_recorder()
+    if rec is not None and lookahead > 0 and n >= 3:
+        # overlap probe for REAL runs (the ISSUE-14 proof lived only in
+        # bench's profiled/overlapped pair): chunk 0 runs phase-
+        # serialized — extra blocks only, bit-exact math — to sample its
+        # transfer and compute costs; the remaining chunks run
+        # overlapped under a wall clock, and the serialized pair scales
+        # by shipped bytes to estimate how much transfer hid.
+        host0 = _encode(0)
+        bytes0 = _tree_nbytes(host0)
+        t0 = monotonic_s()
+        devs[0] = _put(host0, 0)
+        jax.block_until_ready(devs[0])
+        h2d_s0 = monotonic_s() - t0
+        t0 = monotonic_s()
+        carry = _dispatch(carry, devs[0], 0)
+        jax.block_until_ready(jax.tree_util.tree_leaves(carry)[:1])
+        device_s0 = monotonic_s() - t0
+        if not retain:
+            del devs[0]
+        put_idx = 1
+        start = 1
+        synced.append(None)  # chunk 0 already synced
+        probe = (bytes0, h2d_s0, device_s0, monotonic_s())
+    for i in range(start, n):
         while put_idx < min(n, i + window):
             devs[put_idx] = _put(_encode(put_idx), put_idx)
             put_idx += 1
@@ -238,6 +273,17 @@ def stream_feed(
                 synced[j] = None
     if not extra_done:
         put_extra()
+    if probe is not None:
+        jax.block_until_ready(jax.tree_util.tree_leaves(carry)[:1])
+        bytes0, h2d_s0, device_s0, t_rest = probe
+        wall_rest = monotonic_s() - t_rest
+        bytes_rest = shipped[0] - bytes0
+        if bytes0 > 0 and bytes_rest > 0:
+            scale = bytes_rest / bytes0
+            ratio = record_overlap_ratio(
+                h2d_s0 * scale, device_s0 * scale, wall_rest
+            )
+            rec.set_overlap(ratio)
     return finalize(carry, tuple(devs[i] for i in range(n))) if retain \
         else carry
 

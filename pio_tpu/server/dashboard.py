@@ -19,6 +19,9 @@ Routes:
   :class:`~pio_tpu.obs.fleet.FleetAggregator` (enabled by passing
   ``fleet_targets`` / setting ``PIO_TPU_FLEET_TARGETS``);
 - ``GET /fleet.json``            — the same aggregator's router contract;
+- ``GET /training.html``         — live training progress (ISSUE 16):
+  one scrape of a ``pio train`` status sidecar's ``/train.json``
+  (``--train-url`` / ``PIO_TPU_TRAIN_STATUS_URL``, or ``?url=``);
 - ``GET /metrics``               — the dashboard's own scrape endpoint
   (carries the federated member metrics when the fleet panel is on).
 
@@ -65,11 +68,19 @@ class DashboardService:
     """≙ reference ``DashboardService`` routes (+ the serving view)."""
 
     def __init__(self, query_url: str = "http://127.0.0.1:8000",
-                 fleet_targets: Optional[str] = None):
+                 fleet_targets: Optional[str] = None,
+                 train_url: Optional[str] = None):
         #: base URL of the query server (or any pool worker — in pool
         #: mode every worker's /metrics reports pool-wide totals) whose
         #: serving metrics /serving.html renders
         self.query_url = query_url.rstrip("/")
+        import os as _os0
+
+        #: base URL of a `pio train` status sidecar whose /train.json
+        #: the /training.html view follows
+        self.train_url = (
+            train_url or _os0.environ.get("PIO_TPU_TRAIN_STATUS_URL", "")
+        ).rstrip("/")
         self.obs = MetricsRegistry()
         self._pageviews = self.obs.counter(
             "pio_tpu_dashboard_pageviews_total",
@@ -104,6 +115,7 @@ class DashboardService:
         self.router.add("GET", "/serving\\.html", self.serving)
         self.router.add("GET", "/fleet\\.html", self.fleet_html)
         self.router.add("GET", "/fleet\\.json", self.fleet_json)
+        self.router.add("GET", "/training\\.html", self.training_html)
         self.router.add("GET", "/metrics", self.get_metrics)
         self.router.add("GET", "/logs\\.json", self.get_logs)
         self.router.add("GET", "/healthz", self.healthz)
@@ -133,7 +145,8 @@ class DashboardService:
             "padding:.4em .8em;text-align:left}</style></head><body>"
             "<h1>Evaluation Dashboard</h1>"
             "<p><a href='/serving.html'>serving metrics</a> &middot; "
-            "<a href='/fleet.html'>fleet</a></p>"
+            "<a href='/fleet.html'>fleet</a> &middot; "
+            "<a href='/training.html'>training</a></p>"
             "<table><tr><th>Instance</th><th>Evaluation</th><th>Start</th>"
             "<th>End</th><th>Result</th></tr>"
             + "".join(rows)
@@ -475,6 +488,104 @@ class DashboardService:
             "contract</p></body></html>"
         )
 
+    # -- training telemetry (ISSUE 16) --------------------------------------
+    def training_html(self, req: Request) -> Tuple[int, Any]:
+        """Live training view: one scrape of a trainer status sidecar's
+        /train.json — run/phase header, step progress with ETA, the
+        recent-loss window, and stream/phase breakdowns."""
+        self._pageviews.inc(page="training")
+        url = (req.params.get("url") or self.train_url).rstrip("/")
+        head = (
+            "<!doctype html><html><head><title>pio-tpu training</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse;margin-bottom:1em}"
+            "td,th{border:1px solid #ccc;padding:.4em .8em;"
+            "text-align:right}th,td:first-child{text-align:left}"
+            ".bar{background:#dfd;display:inline-block;height:1em}"
+            "</style></head><body><h1>Training</h1>"
+        )
+        if not url:
+            return 200, _html_response(
+                head + "<p>no trainer configured — start "
+                "<code>pio train</code> (its status sidecar prints a "
+                "loopback port) and pass <code>--train-url</code>, set "
+                "<code>PIO_TPU_TRAIN_STATUS_URL</code>, or use "
+                "<code>?url=http://127.0.0.1:PORT</code></p></body></html>"
+            )
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(url + "/train.json", timeout=3.0) as r:
+                data = json.loads(r.read().decode("utf-8"))
+        except Exception as e:
+            return 200, _html_response(
+                head + f"<p>scraping <code>{_html.escape(url)}"
+                "/train.json</code> (override with ?url=)</p>"
+                f"<p>scrape failed: {_html.escape(f'{type(e).__name__}: {e}')}"
+                " — no run in flight, or the sidecar exited with its "
+                "run</p></body></html>"
+            )
+        fmt = lambda v, spec="{:.3f}": (
+            spec.format(v) if isinstance(v, (int, float)) else "n/a"
+        )
+        progress = data.get("progress")
+        pct = progress * 100 if isinstance(progress, (int, float)) else None
+        bar = (
+            f"<p><span class='bar' style='width:{pct:.0f}%'>&nbsp;</span>"
+            f" {pct:.1f}%</p>" if pct is not None else ""
+        )
+        summary = (
+            f"<p>run <code>{_html.escape(str(data.get('runId') or '?'))}</code>"
+            f" &middot; engine <code>"
+            f"{_html.escape(str(data.get('engineId') or '?'))}</code>"
+            f" &middot; phase <b>{_html.escape(str(data.get('phase') or '?'))}"
+            f"</b> &middot; algo "
+            f"{_html.escape(str(data.get('algo') or '-'))}</p>" + bar
+            + "<table><tr><th>step</th><th>of</th><th>epoch</th>"
+            "<th>examples</th><th>examples/s</th><th>loss</th>"
+            "<th>eta (s)</th><th>elapsed (s)</th></tr>"
+            f"<tr><td>{data.get('step', 0)}</td>"
+            f"<td>{data.get('totalSteps', 0)}</td>"
+            f"<td>{fmt(data.get('epoch'), '{:.2f}')}</td>"
+            f"<td>{data.get('examples', 0)}</td>"
+            f"<td>{fmt(data.get('examplesPerSecond'), '{:.0f}')}</td>"
+            f"<td>{fmt(data.get('loss'), '{:.5f}')}</td>"
+            f"<td>{fmt(data.get('etaSeconds'), '{:.0f}')}</td>"
+            f"<td>{fmt(data.get('elapsedSeconds'), '{:.1f}')}</td></tr>"
+            "</table>"
+        )
+        window = data.get("lossWindow") or []
+        losses = (
+            "<h2>Loss window</h2><pre style='background:#f6f6f6;"
+            "padding:1em;overflow-x:auto'>"
+            + _html.escape(" ".join(f"{v:.5f}" for v in window))
+            + "</pre>" if window else ""
+        )
+        stream = data.get("stream") or {}
+        stream_table = (
+            "<h2>Stream feed</h2><table>"
+            "<tr><th>streamed</th><th>chunks</th><th>h2d bytes</th>"
+            "<th>overlap ratio</th></tr>"
+            f"<tr><td>{'yes' if stream.get('streamed') else 'no'}</td>"
+            f"<td>{stream.get('chunks', 0)}</td>"
+            f"<td>{stream.get('h2dBytes', 0)}</td>"
+            f"<td>{fmt(stream.get('overlapRatio'))}</td></tr></table>"
+        )
+        phases = data.get("phases") or {}
+        phase_rows = "".join(
+            f"<tr><td>{_html.escape(k)}</td><td>{fmt(v)}</td></tr>"
+            for k, v in phases.items()
+        )
+        phase_table = (
+            "<h2>Phases (s)</h2><table><tr><th>phase</th><th>seconds</th>"
+            "</tr>" + phase_rows + "</table>" if phase_rows else ""
+        )
+        return 200, _html_response(
+            head + f"<p>scraping <code>{_html.escape(url)}/train.json</code>"
+            " (override with ?url=)</p>" + summary + losses + stream_table
+            + phase_table + "</body></html>"
+        )
+
     def serving(self, req: Request) -> Tuple[int, Any]:
         """Live serving view: pool-wide request totals + avg QPS since
         deploy and a per-stage latency table, from one scrape of the
@@ -558,12 +669,14 @@ def create_dashboard(
     host: str = "0.0.0.0", port: int = 9000,
     query_url: str = "http://127.0.0.1:8000",
     fleet_targets: Optional[str] = None,
+    train_url: Optional[str] = None,
 ) -> JsonHTTPServer:
     """Build (unstarted) dashboard — reference ``Dashboard.main``. When
     fleet targets are configured the embedded aggregator's scrape loop
     starts here (daemon thread; it dies with the process)."""
     service = DashboardService(
-        query_url=query_url, fleet_targets=fleet_targets
+        query_url=query_url, fleet_targets=fleet_targets,
+        train_url=train_url,
     )
     server = JsonHTTPServer(
         service.router, host, port, name="pio-tpu-dashboard"

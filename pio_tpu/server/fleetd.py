@@ -21,6 +21,14 @@ raw socket protocol and has no HTTP surface of its own, so the smoke
 fleet stage (and any real read-replica deployment) wraps it in this
 member-shaped sidecar — ``/metrics`` with per-partition mirrored-byte
 positions, ``/readyz``, and a ``role: follower`` ``/storage.json``.
+
+And :class:`TrainStatusService` (ISSUE 16): ``pio train`` is a
+daemonless driver process, so its live progress sidecar rides here —
+``/train.json`` (the trainwatch recorder's progress payload),
+``/metrics`` (the process-global registry: the run's
+``pio_tpu_train_*`` families), ``/logs.json`` (the slog ring, filterable
+by the run's trace id) and the health pair. A FleetAggregator scraping
+it shows a ``role: trainer`` member for the run's duration.
 """
 
 from __future__ import annotations
@@ -184,6 +192,85 @@ def create_follower_status_server(
     service = FollowerStatusService(follower)
     server = JsonHTTPServer(
         service.router, host, port, name="pio-tpu-follower-status"
+    )
+    server.service = service
+    return server
+
+
+# ---------------------------------------------------------------------------
+# trainer observability sidecar (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+class TrainStatusService:
+    """Member-shaped HTTP surface for one in-flight training run.
+
+    Reads the PROCESS-GLOBAL state (the active trainwatch recorder, the
+    global metrics registry, the slog ring) rather than holding its own:
+    training runs in the driver process and the sidecar thread must see
+    whatever run is live, including one that starts after the server.
+    """
+
+    def __init__(self):
+        from pio_tpu.obs import REGISTRY
+
+        self._registry = REGISTRY
+        self.health = HealthMonitor()
+        self.health.add_readiness("training_run", self._check_run)
+        self.router = Router()
+        self.router.add("GET", "/train\\.json", self.train_json)
+        self.router.add("GET", "/logs\\.json", self.logs_json)
+        self.router.add("GET", "/metrics", self.get_metrics)
+        self.router.add("GET", "/healthz", self.healthz)
+        self.router.add("GET", "/readyz", self.readyz)
+
+    def _check_run(self):
+        from pio_tpu.obs import trainwatch
+
+        rec = trainwatch.active_recorder()
+        if rec is None:
+            return False, "no active training run"
+        return True, f"run {rec.run_id}"
+
+    def train_json(self, req: Request) -> Tuple[int, Any]:
+        from pio_tpu.obs import trainwatch
+
+        rec = trainwatch.active_recorder()
+        if rec is None:
+            return 503, {"error": "no active training run"}
+        return 200, rec.payload()
+
+    def logs_json(self, req: Request) -> Tuple[int, Any]:
+        from pio_tpu.server.http import int_param
+
+        n = int_param(req.params, "n", 100, lo=0, hi=slog.ring().cap)
+        return 200, slog.logs_payload(
+            n=n,
+            level=req.params.get("level"),
+            trace_id=req.params.get("trace_id"),
+            logger=req.params.get("logger"),
+        )
+
+    def get_metrics(self, req: Request) -> Tuple[int, Any]:
+        return 200, metrics_response(self._registry.render())
+
+    def healthz(self, req: Request) -> Tuple[int, Any]:
+        ok, report = self.health.liveness()
+        return (200 if ok else 503), report
+
+    def readyz(self, req: Request) -> Tuple[int, Any]:
+        ok, report = self.health.readiness()
+        return (200 if ok else 503), report
+
+
+def create_train_status_server(
+    host: str = "127.0.0.1", port: int = 0,
+) -> JsonHTTPServer:
+    """Build (unstarted) trainer sidecar; ``pio train --status-port``
+    starts it for the run's duration (default loopback + ephemeral
+    port — the run prints the bound port once the server starts)."""
+    service = TrainStatusService()
+    server = JsonHTTPServer(
+        service.router, host, port, name="pio-tpu-train-status"
     )
     server.service = service
     return server

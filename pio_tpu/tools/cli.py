@@ -211,6 +211,13 @@ def cmd_train(args) -> int:
             "--checkpoint-dir has no effect without --checkpoint-every N "
             "(nothing would be snapshotted)"
         ))
+    faults = getattr(args, "faults", None) or None
+    if faults:
+        from pio_tpu import faults as _faults
+
+        _faults.parse_faults(faults)
+        os.environ["PIO_TPU_FAULTS"] = faults
+        _faults.install(faults)
     variant = _load_variant(args.engine_json)
     engine, ep = build_engine(variant)
     wp = WorkflowParams(
@@ -224,8 +231,80 @@ def cmd_train(args) -> int:
         checkpoint_dir=args.checkpoint_dir,
     )
     ctx = ComputeContext.create(seed=args.seed)
-    instance_id = run_train(engine, ep, variant, wp, ctx=ctx)
+    status_port = args.status_port
+    if status_port is None:
+        status_port = int(
+            os.environ.get("PIO_TPU_TRAIN_STATUS_PORT", "0") or 0
+        )
+    status_server = None
+    if status_port >= 0:
+        from pio_tpu.server.fleetd import create_train_status_server
+
+        status_server = create_train_status_server(port=status_port)
+        status_server.start()
+        _out(f"Training status sidecar on 127.0.0.1:{status_server.port} "
+             "(/train.json /metrics /logs.json)")
+    try:
+        instance_id = run_train(engine, ep, variant, wp, ctx=ctx)
+    finally:
+        if status_server is not None:
+            status_server.stop()
     _out(f"Training completed: engine instance {instance_id}")
+    return 0
+
+
+def cmd_runs(args) -> int:
+    """Inspect the run registry (ISSUE 16): ``$PIO_TPU_HOME/runs/
+    <engine-id>.jsonl``, one row per ``run_train``. List by default;
+    ``--diff`` compares the last two COMPLETED runs with the bench
+    ledger's direction-aware regression logic (exit 1 on regression)."""
+    from pio_tpu.obs import trainwatch
+
+    engine_id = args.engine_id
+    if not engine_id:
+        variant = _load_variant(args.engine_json)
+        engine_id = variant.engine_id
+    rows = trainwatch.read_runs(engine_id)
+    if not rows:
+        return _err(
+            f"no recorded runs for engine {engine_id!r} "
+            f"(ledger: {trainwatch.runs_path(engine_id)})"
+        )
+    if args.n and not args.diff:
+        rows = rows[-args.n:]
+    if args.json:
+        _out(json.dumps(rows, indent=2, sort_keys=True))
+        return 0
+    if args.diff:
+        done = [r for r in rows if r.get("status") == "COMPLETED"]
+        if len(done) < 2:
+            return _err(
+                f"--diff needs two COMPLETED runs for {engine_id!r} "
+                f"(have {len(done)})"
+            )
+        threshold = (
+            args.threshold if args.threshold is not None
+            else trainwatch.DEFAULT_RUN_THRESHOLD
+        )
+        lines, regressed = trainwatch.run_delta_table(
+            done[-2], done[-1], threshold=threshold,
+        )
+        for line in lines:
+            _out(line)
+        if regressed:
+            _err("run regression in: " + ", ".join(regressed))
+            return 1
+        return 0
+    _out(f"{'run_id':<36} {'timestamp':<26} {'status':<10} "
+         f"{'train_s':>9} {'algo':<12} {'loss':>10}")
+    for r in rows:
+        loss = r.get("final_loss")
+        _out(f"{str(r.get('run_id') or '?'):<36} "
+             f"{str(r.get('timestamp') or '?'):<26} "
+             f"{str(r.get('status') or '?'):<10} "
+             f"{r.get('train_seconds', 0):>9} "
+             f"{str((r.get('step_summary') or {}).get('algo') or '-'):<12} "
+             f"{loss if loss is not None else '-':>10}")
     return 0
 
 
@@ -313,7 +392,7 @@ def cmd_dashboard(args) -> int:
 
     server = create_dashboard(
         host=args.ip, port=args.port, query_url=args.query_url,
-        fleet_targets=args.fleet_targets,
+        fleet_targets=args.fleet_targets, train_url=args.train_url,
     )
     _out(f"Dashboard listening on {args.ip}:{server.port}")
     try:
@@ -856,7 +935,44 @@ def build_parser() -> argparse.ArgumentParser:
         help="explicit snapshot dir (default: per-engine-config under "
              "$PIO_TPU_HOME)",
     )
+    a.add_argument(
+        "--status-port", type=int, default=None, metavar="PORT",
+        help="loopback port for the live /train.json progress sidecar "
+             "(default: PIO_TPU_TRAIN_STATUS_PORT or 0 = ephemeral, "
+             "printed at start; negative disables)",
+    )
+    a.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="failpoint spec for fault drills, e.g. "
+             "'stream.put=latency:0.02'; namespaces in "
+             "`pio lint --dump-failpoints`",
+    )
     a.set_defaults(fn=cmd_train)
+
+    a = sub.add_parser(
+        "runs", help="list / diff the training run registry"
+    )
+    a.add_argument("--engine-json", default="engine.json")
+    a.add_argument(
+        "--engine-id", default=None,
+        help="ledger to read (default: the engine id of --engine-json)",
+    )
+    a.add_argument(
+        "-n", type=int, default=0, metavar="N",
+        help="show only the last N runs (0 = all)",
+    )
+    a.add_argument(
+        "--diff", action="store_true",
+        help="delta table for the last two COMPLETED runs; exits 1 when "
+             "a field regresses past --threshold",
+    )
+    a.add_argument(
+        "--threshold", type=float, default=None,
+        help="fractional regression threshold for --diff (default 0.05)",
+    )
+    a.add_argument("--json", action="store_true",
+                   help="raw ledger rows as JSON")
+    a.set_defaults(fn=cmd_runs)
 
     a = sub.add_parser("eval", help="run an evaluation sweep")
     a.add_argument("evaluation", help="module:attr returning an Evaluation")
@@ -977,6 +1093,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--fleet-targets", default=None, metavar="HOST:PORT,...",
         help="enable the embedded /fleet.html panel scraping these "
              "members (default: PIO_TPU_FLEET_TARGETS)",
+    )
+    a.add_argument(
+        "--train-url", default=None, metavar="URL",
+        help="trainer status sidecar whose /train.json the "
+             "/training.html view follows (default: "
+             "PIO_TPU_TRAIN_STATUS_URL)",
     )
     a.set_defaults(fn=cmd_dashboard)
 

@@ -43,7 +43,7 @@ from pio_tpu.storage import (
     RunStatus,
     Storage,
 )
-from pio_tpu.obs import slog
+from pio_tpu.obs import slog, trainwatch
 from pio_tpu.workflow import shard_store
 from pio_tpu.workflow.engine_json import EngineVariant
 from pio_tpu.workflow.params import WorkflowParams
@@ -174,10 +174,53 @@ def run_train(
             checkpoint_every=workflow_params.checkpoint_every,
         )
 
+    # telemetry plane (ISSUE 16): the recorder collects step-stream
+    # progress from the training loops, renders /train.json for the
+    # status sidecar, and lands in the run ledger on exit
+    recorder = trainwatch.StepRecorder(instance_id, variant.engine_id)
+    params_hash = hashlib.sha256(
+        "\n".join([
+            instance.data_source_params or "",
+            instance.preparator_params or "",
+            instance.algorithms_params or "",
+            instance.serving_params or "",
+        ]).encode()
+    ).hexdigest()[:16]
+
+    def _append_run_record(status: str, train_s: float,
+                           timings: dict, *,
+                           shard_manifest: Optional[str] = None,
+                           error: Optional[str] = None) -> None:
+        # ledger append is best-effort by design: a full disk or torn
+        # runs dir must never fail (or un-fail) the run itself
+        try:
+            rec = trainwatch.run_record(
+                run_id=instance_id,
+                engine_id=variant.engine_id,
+                status=status,
+                train_seconds=train_s,
+                phases={
+                    k.replace(":", "."): float(v)
+                    for k, v in recorder.phases.items()
+                } or {
+                    k.replace(":", "."): float(v)
+                    for k, v in timings.items()
+                },
+                params_hash=params_hash,
+                step_summary=recorder.summary(),
+                num_devices=ctx.num_devices,
+                shard_manifest=shard_manifest,
+                error=error,
+            )
+            path = trainwatch.append_run(rec)
+            log.info("run record appended to %s", path)
+        except Exception as exc:
+            log.warning("run-ledger append failed: %s", exc)
+
     t0 = monotonic_s()
     timings: dict = {}
     try:
-        with TRAIN_TRACER.trace(
+        with trainwatch.recording(recorder), TRAIN_TRACER.trace(
             "train", instanceId=instance_id, engineId=variant.engine_id
         ) as tr:
             with contextlib.ExitStack() as stack:
@@ -198,13 +241,13 @@ def run_train(
                     timings=timings,
                 )
             train_s = monotonic_s() - t0
-            # engine.train measured the phases; turn them into spans so
-            # the run shows up in the trace ring AND the per-stage
-            # training histograms (pio_tpu_train_stage_seconds). The log
-            # lines ride inside the trace, so each carries its trace id —
-            # /logs.json?trace_id= reassembles one run's full story.
+            # the phases already ran inside LIVE tr.span()s (engine.train
+            # opens one per phase since ISSUE 16), so the stage
+            # histograms (pio_tpu_train_stage_seconds) and the trace ring
+            # saw them as they happened and every in-phase log line
+            # carries (trace_id, span) — /logs.json?trace_id= reassembles
+            # one run's full story. Here we only log the summary.
             for phase, dur in timings.items():
-                tr.add_span(phase, float(dur))
                 log.info(
                     "train phase %s done in %.3fs (instance %s)",
                     phase, float(dur), instance_id,
@@ -219,6 +262,9 @@ def run_train(
 
             # Persist: PersistentModel handles itself; everything else goes
             # into the Models store as one pickled blob.
+            recorder.set_phase("persist")
+            t_persist = monotonic_s()
+            shard_manifest_id = None
             with tr.span("persist"):
                 persisted_externally = []
                 for (name, algo_params), model in zip(
@@ -252,6 +298,9 @@ def run_train(
                         n_shards=ctx.num_devices,
                         mesh_shape=mesh_shape,
                     )
+                    shard_manifest_id = (
+                        instance_id + shard_store.SHARD_MANIFEST_SUFFIX
+                    )
                 blob = serialize_models(blob_models)
                 models_store.insert(Model(id=instance_id, models=blob))
                 manifest = _json.dumps(
@@ -264,6 +313,10 @@ def run_train(
                 models_store.insert(
                     Model(id=instance_id + MANIFEST_SUFFIX, models=manifest)
                 )
+            recorder.set_phase_seconds(
+                "persist", monotonic_s() - t_persist
+            )
+            recorder.set_phase("done")
 
             done = dataclasses.replace(
                 instance.with_status(RunStatus.COMPLETED),
@@ -275,6 +328,10 @@ def run_train(
                 },
             )
             instances.update(done)
+            _append_run_record(
+                "COMPLETED", train_s, timings,
+                shard_manifest=shard_manifest_id,
+            )
             log.info(
                 "training finished: instance %s (%.2fs, %d model(s))",
                 instance_id, train_s, len(models),
@@ -286,6 +343,10 @@ def run_train(
             instance.with_status(RunStatus.FAILED), env={"error": err[-4000:]}
         )
         instances.update(failed)
+        # failed runs land in the ledger too — a crash IS trend data
+        _append_run_record(
+            "FAILED", monotonic_s() - t0, timings, error=err,
+        )
         log.error("training FAILED: instance %s\n%s", instance_id, err)
         raise
 

@@ -1665,4 +1665,209 @@ PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" python "$FLEET_STAGE" "$WORKDIR" \
     || fail "fleet federation stage (liveness/lag/federated-sum assertions)"
 echo "ok   fleet federation: 3 members, lag reported, follower death detected, sums retained"
 
+# ------------------------------------------------ bench history gate
+# ISSUE 16 satellite: the bench ledger's regression flags fail the
+# pipeline loudly. --check-history only reads BENCH_HISTORY.jsonl (no
+# benchmark run, no throwaway home) and exits nonzero when the last two
+# comparable rows regress past the threshold.
+python bench.py --check-history \
+    || fail "bench history regression (bench.py --check-history)"
+echo "ok   bench history: no unexplained regression in the ledger"
+
+# --------------------------------------------- training telemetry plane
+# ISSUE 16: live /train.json progress from REAL `pio train` CLI runs —
+# monotonically advancing step/epoch and a non-empty loss window while
+# the run is in flight; a fleetd that shows the trainer member up
+# during the run and down after its exit; and the run ledger, where a
+# second run slowed by an injected feed-latency failpoint must be
+# flagged by `pio runs --diff`.
+TRAIN_STAGE="$WORKDIR/train_stage.py"
+cat > "$TRAIN_STAGE" <<'PY'
+"""Smoke stage: training telemetry plane end to end."""
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+WORKDIR = sys.argv[1]
+
+# sqlite storage shared between the seeding parent and the CLI children
+os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "SQ"
+os.environ["PIO_STORAGE_SOURCES_SQ_TYPE"] = "sqlite"
+os.environ["PIO_STORAGE_SOURCES_SQ_PATH"] = os.path.join(
+    WORKDIR, "train_stage.db")
+os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "SQ"
+os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "SQ"
+# small stream chunks: many feed puts -> many failpoint hits, and the
+# step counter advances chunk by chunk while we poll
+os.environ["PIO_TPU_TRAIN_STREAM_MB"] = "0.02"
+
+import datetime as dt
+
+from pio_tpu.data import Event
+from pio_tpu.storage import App, Storage
+
+app_id = Storage.get_meta_data_apps().insert(App(0, "twsmoke"))
+le = Storage.get_levents()
+t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+for u in range(24):
+    for i in range(12):
+        if (u < 12) == (i < 6):
+            le.insert(Event("rate", "user", f"u{u}", "item", f"i{i}",
+                            properties={"rating": 5.0}, event_time=t0),
+                      app_id)
+
+engine_json = os.path.join(WORKDIR, "twsmoke-engine.json")
+with open(engine_json, "w") as f:
+    json.dump({
+        "id": "twsmoke",
+        "engineFactory": "templates.twotower",
+        "datasource": {"params": {"app_name": "twsmoke"}},
+        "algorithms": [{"name": "twotower", "params": {
+            "embed_dim": 8, "hidden": 16, "out_dim": 8,
+            "steps": 120, "batch_size": 256, "stream": "on"}}],
+    }, f)
+
+
+def run_train(faults, watch=False):
+    """One `pio train` CLI run; with watch, poll /train.json live and
+    track the trainer member through a fleetd."""
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "pio_tpu", "train",
+         "--engine-json", engine_json, "--status-port", "0",
+         "--faults", faults],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=dict(os.environ),
+    )
+    port = None
+    deadline = time.time() + 120
+    lines = []
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        m = re.search(r"status sidecar on 127\.0\.0\.1:(\d+)", line)
+        if m:
+            port = int(m.group(1))
+            break
+    assert port, f"sidecar port never printed: {''.join(lines)}"
+    # drain the rest of stdout so the child never blocks on the pipe
+    t = threading.Thread(
+        target=lambda: lines.extend(iter(proc.stdout.readline, "")),
+        daemon=True)
+    t.start()
+    samples = []
+    fleetd = None
+    try:
+        if watch:
+            from pio_tpu.server.fleetd import create_fleet_server
+
+            fleetd = create_fleet_server(
+                f"127.0.0.1:{port}", host="127.0.0.1", port=0,
+                interval_s=0.2)
+            fleetd.start()
+            fleetd.service.agg.start()
+        seen_up = False
+        while proc.poll() is None:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/train.json",
+                        timeout=5) as r:
+                    samples.append(json.loads(r.read().decode("utf-8")))
+            except (urllib.error.URLError, OSError):
+                pass  # before the run activates / after it ends
+            if (watch and not seen_up and samples
+                    and samples[-1].get("step", 0) > 0):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{fleetd.port}/fleet.json",
+                        timeout=5) as r:
+                    fp = json.loads(r.read().decode("utf-8"))
+                me = fp["members"][0]
+                if me["role"] == "trainer" and me["status"] == "up":
+                    assert me["training"]["runId"], me
+                    seen_up = True
+            time.sleep(0.02)
+        proc.wait(timeout=120)
+        assert proc.returncode == 0, (
+            f"pio train failed ({proc.returncode}): {''.join(lines)}")
+        if watch:
+            assert seen_up, "fleetd never saw the trainer member up"
+            # the sidecar died with its run: down within a few scrapes
+            agg = fleetd.service.agg
+            agg.stale_after_s = 0.2
+            agg.down_after_s = 0.4
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{fleetd.port}/fleet.json",
+                        timeout=5) as r:
+                    fp = json.loads(r.read().decode("utf-8"))
+                if fp["members"][0]["status"] == "down":
+                    break
+                time.sleep(0.1)
+            else:
+                raise SystemExit(
+                    f"trainer member never marked down: {fp['members']}")
+            assert fp["members"][0]["role"] == "trainer", fp["members"]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        if fleetd is not None:
+            fleetd.service.agg.stop()
+            fleetd.stop()
+    return samples
+
+
+samples = run_train("stream.put=latency:30ms", watch=True)
+steps = [s["step"] for s in samples]
+assert steps, "no /train.json samples during the run"
+assert steps == sorted(steps), f"step went backwards: {steps}"
+assert max(steps) > 0, f"step never advanced: {steps}"
+assert len(set(s for s in steps if s > 0)) >= 2, (
+    f"step did not advance chunk by chunk: {steps}")
+epochs = [s["epoch"] for s in samples if s["epoch"] is not None]
+assert epochs == sorted(epochs), f"epoch went backwards: {epochs}"
+with_loss = [s for s in samples if s["step"] > 0]
+assert with_loss and with_loss[-1]["lossWindow"], (
+    "loss window empty while steps advanced")
+assert any(s["stream"]["streamed"] for s in with_loss), "feed not streamed"
+
+# run 2: same engine, feed slowed 10x by the injected failpoint
+run_train("stream.put=latency:300ms")
+
+diff = subprocess.run(
+    [sys.executable, "-m", "pio_tpu", "runs",
+     "--engine-json", engine_json, "--diff"],
+    capture_output=True, text=True, env=dict(os.environ), timeout=120,
+)
+assert diff.returncode == 1, (
+    f"pio runs --diff did not flag the slowed run:\n{diff.stdout}\n"
+    f"{diff.stderr}")
+assert "REGRESSION" in diff.stdout, diff.stdout
+assert "train_seconds" in diff.stderr, diff.stderr
+
+listing = subprocess.run(
+    [sys.executable, "-m", "pio_tpu", "runs",
+     "--engine-json", engine_json],
+    capture_output=True, text=True, env=dict(os.environ), timeout=120,
+)
+assert listing.returncode == 0, listing.stderr
+assert listing.stdout.count("COMPLETED") == 2, listing.stdout
+
+n_steps = [s for s in steps if s > 0]
+print(f"train stage: {len(samples)} live polls, step walked "
+      f"{n_steps[0]} -> {n_steps[-1]}/120 monotonically, loss window "
+      f"{len(with_loss[-1]['lossWindow'])} entries, trainer member "
+      f"up->down in fleetd, `pio runs --diff` flagged the slowed run")
+PY
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" python "$TRAIN_STAGE" "$WORKDIR" \
+    || fail "training telemetry stage (progress/ledger/fleet assertions)"
+echo "ok   training telemetry: live /train.json progress, fleetd trainer tracking, runs-ledger regression flagged"
+
 echo "smoke OK"

@@ -380,6 +380,148 @@ class TestFollowerSidecar:
             follower.stop()
 
 
+def _train_metrics(steps):
+    return ("# TYPE pio_tpu_train_steps_total counter\n"
+            f'pio_tpu_train_steps_total{{algo="als"}} {steps}\n')
+
+
+def _train_json(step=10, total=40):
+    return json.dumps({
+        "runId": "r1", "engineId": "e1", "phase": "train.0_als",
+        "algo": "als", "step": step, "totalSteps": total,
+        "epoch": 0.5, "progress": step / total, "etaSeconds": 3.0,
+        "loss": 0.5, "examples": 320,
+    })
+
+
+class TestTrainerMember:
+    """ISSUE 16 satellite: a `pio train` status sidecar federates as a
+    role=trainer member beside the serving fleet."""
+
+    def test_role_and_training_row(self):
+        fake = _FakeFleet({
+            "t:1": {"/metrics": _train_metrics(10),
+                    "/train.json": _train_json()},
+            "q:2": {"/metrics": _metrics(5)},
+        })
+        agg = _agg(fake, targets="t:1,q:2")
+        assert agg.scrape_once() == 2
+        by = {e["member"]: e for e in agg.fleet_payload()["members"]}
+        assert by["t:1"]["role"] == "trainer"
+        assert by["t:1"]["status"] == "up"
+        tr = by["t:1"]["training"]
+        assert tr["runId"] == "r1"
+        assert tr["step"] == 10 and tr["totalSteps"] == 40
+        assert tr["loss"] == 0.5 and tr["progress"] == 0.25
+        assert by["q:2"]["training"] is None
+        assert by["q:2"]["role"] != "trainer"
+
+    def test_counters_federate_beside_serving(self):
+        """The trainer's step counter joins the federated exposition
+        with its member label; serving sums stay untouched."""
+        fake = _FakeFleet({
+            "t:1": {"/metrics": _train_metrics(12),
+                    "/train.json": _train_json(step=12)},
+            "a:1": {"/metrics": _metrics(5)},
+            "b:2": {"/metrics": _metrics(7)},
+        })
+        agg = _agg(fake, targets="t:1,a:1,b:2")
+        assert agg.scrape_once() == 3
+        pm = parse_prometheus_text("\n".join(agg.obs.render()))
+        assert pm.value("pio_tpu_train_steps_total", algo="als",
+                        pio_tpu_member="t:1") == 12
+        total = sum(pm.family("pio_tpu_q_total").values())
+        assert total == 12  # 5 + 7, trainer contributes none
+
+    def test_down_walk_when_run_exits(self):
+        """The sidecar dies with its run: up while training, down after
+        the exit (the last /train.json snapshot — and the trainer role —
+        are retained for the post-mortem view)."""
+        fake = _FakeFleet({
+            "t:1": {"/metrics": _train_metrics(40),
+                    "/train.json": _train_json(step=40)},
+        })
+        agg = _agg(fake, targets="t:1",
+                   stale_after_s=0.2, down_after_s=0.4)
+        assert agg.scrape_once() == 1
+        entry = agg.fleet_payload()["members"][0]
+        assert (entry["status"], entry["role"]) == ("up", "trainer")
+        fake.members["t:1"] = None  # run over, sidecar gone
+        time.sleep(0.5)
+        assert agg.scrape_once() == 0
+        entry = agg.fleet_payload()["members"][0]
+        assert entry["status"] == "down"
+        assert entry["role"] == "trainer"
+        assert entry["training"]["step"] == 40
+
+
+class TestTrainStatusSidecar:
+    def test_sidecar_surface_over_http(self):
+        from pio_tpu.obs import trainwatch
+        from pio_tpu.server.fleetd import create_train_status_server
+
+        server = create_train_status_server().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            # no run in flight: 503 on the progress surface and readiness
+            assert http("GET", base + "/train.json")[0] == 503
+            assert http("GET", base + "/readyz")[0] == 503
+            assert http("GET", base + "/healthz")[0] == 200
+            rec = trainwatch.StepRecorder("run-x", "eng-x")
+            with trainwatch.recording(rec):
+                trainwatch.begin_algo("als", total_steps=4)
+                trainwatch.record_steps(
+                    2, losses=[0.5, 0.4], examples=24, dur_s=0.01
+                )
+                st, body = http("GET", base + "/train.json")
+                assert st == 200
+                assert body["runId"] == "run-x"
+                assert body["step"] == 2 and body["totalSteps"] == 4
+                assert body["lossWindow"] == [0.5, 0.4]
+                assert http("GET", base + "/readyz")[0] == 200
+                st, text = http("GET", base + "/metrics")
+                assert st == 200
+                assert "pio_tpu_train_steps_total" in text
+                st, logs = http("GET", base + "/logs.json?n=5")
+                assert st == 200 and "logs" in logs
+            # run done, recorder deactivated: back to 503
+            assert http("GET", base + "/train.json")[0] == 503
+        finally:
+            server.stop()
+
+    def test_fleet_scrapes_live_sidecar(self):
+        """Real HTTP end to end: a FleetAggregator (default fetch) sees
+        the sidecar as an up trainer while a recorder is active, and
+        walks it down once the sidecar process is gone."""
+        from pio_tpu.obs import trainwatch
+        from pio_tpu.server.fleetd import create_train_status_server
+
+        server = create_train_status_server().start()
+        target = f"127.0.0.1:{server.port}"
+        agg = FleetAggregator(
+            parse_targets(target), registry=MetricsRegistry(),
+            interval_s=0.05, stale_after_s=0.2, down_after_s=0.4,
+        )
+        rec = trainwatch.StepRecorder("run-live", "eng-live")
+        try:
+            with trainwatch.recording(rec):
+                trainwatch.begin_algo("als", total_steps=8)
+                trainwatch.record_steps(3, losses=[1.0], examples=30)
+                assert agg.scrape_once() == 1
+                entry = agg.fleet_payload()["members"][0]
+                assert entry["status"] == "up"
+                assert entry["role"] == "trainer"
+                assert entry["training"]["runId"] == "run-live"
+                assert entry["training"]["step"] == 3
+        finally:
+            server.stop()
+        time.sleep(0.5)
+        assert agg.scrape_once() == 0
+        entry = agg.fleet_payload()["members"][0]
+        assert entry["status"] == "down"
+        assert entry["role"] == "trainer"  # snapshot retained
+
+
 class TestDashboardPanel:
     def test_unconfigured_dashboard_serves_pointer(self, monkeypatch):
         from pio_tpu.server.dashboard import DashboardService
