@@ -535,6 +535,10 @@ def _bench_resident_serving(n_queries: int) -> dict:
             labels = [svc._predict_one(q).label for q in queries]
             hits = sc.donation_hits - hit0
             misses = sc.donation_misses - miss0
+            # device digest (ISSUE 17): the window is steady state, so
+            # the watch's compile total must equal the warmup sweep's —
+            # a live dispatch that compiled would show up here
+            dp = svc.devwatch.payload()
             stats = {
                 "wire": sc.wire,
                 "h2d_bytes_per_request": round(
@@ -545,6 +549,20 @@ def _bench_resident_serving(n_queries: int) -> dict:
                 ),
                 "retraces": svc._buckets.retraces - r0,
                 "param_bytes": sc.placed_bytes,
+                "device": {
+                    "mode": dp.get("mode"),
+                    "peak_bytes": max(
+                        (d.get("peakBytes") or 0
+                         for d in dp.get("devices") or []),
+                        default=0,
+                    ),
+                    "compiles": (dp.get("compiles") or {}).get("total", 0),
+                    "compile_seconds": round(sum(
+                        float(r.get("seconds") or 0.0) for r in
+                        ((dp.get("compiles") or {}).get("sites") or {})
+                        .values()
+                    ), 4),
+                },
             }
             return labels, stats
 
@@ -557,6 +575,7 @@ def _bench_resident_serving(n_queries: int) -> dict:
             "queries": n_queries,
             "int8": i8,
             "float32": f32,
+            "device": i8.get("device"),
             "h2d_ratio_f32_over_i8": round(
                 f32["h2d_bytes_per_request"]
                 / max(1e-9, i8["h2d_bytes_per_request"]), 2
@@ -1071,7 +1090,37 @@ def _with_metrics_delta(port: int, stage_fn):
             got["server_metrics"] = _metrics_delta(m0, _scrape_metrics(port))
         except Exception as exc:
             print(f"# metrics delta scrape failed: {exc}", file=sys.stderr)
+    try:
+        got["device"] = _device_block(port)
+    except Exception as exc:
+        print(f"# device scrape failed: {exc}", file=sys.stderr)
     return got
+
+
+def _device_block(port: int) -> dict:
+    """Compact /device.json digest for a stage record (ISSUE 17): each
+    stage runs against a fresh server, so the watch's totals ARE the
+    stage's — peak bytes per device plus the compile-site attribution."""
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/device.json", timeout=5.0
+    ) as r:
+        data = json.loads(r.read().decode("utf-8"))
+    compiles = data.get("compiles") or {}
+    return {
+        "mode": data.get("mode"),
+        "peak_bytes": {
+            str(d.get("device")): d.get("peakBytes")
+            for d in data.get("devices") or []
+        },
+        "compiles": compiles.get("total", 0),
+        "compile_seconds": round(sum(
+            float(row.get("seconds") or 0.0)
+            for row in (compiles.get("sites") or {}).values()
+        ), 4),
+        "headroom_bytes": data.get("headroomBytes"),
+    }
 
 
 def _concurrent_stage(port: int, n_users: int, n_threads=16,
@@ -1727,7 +1776,15 @@ def _bench_train_streamed(ctx, scale: float) -> dict:
     )
     rate = steps * batch / times[len(times) // 2]
     st: dict = {}
-    train_two_tower(mesh, u, i, n_users, n_items, cfg, stats=st)
+    # device accounting for the profiled pass (ISSUE 17): stream-carry
+    # ledger + train_step compile attribution land in this watch
+    from pio_tpu.obs import devicewatch
+
+    dw = devicewatch.DeviceWatch()
+    with devicewatch.watching(dw, sample=False):
+        train_two_tower(mesh, u, i, n_users, n_items, cfg, stats=st)
+        dw.sample()
+    dw_payload = dw.payload()
 
     # single-chip anchor: same streamed program without collectives
     t_single, _ = _timed_runs(
@@ -1784,6 +1841,15 @@ def _bench_train_streamed(ctx, scale: float) -> dict:
         "probe_h2d_s": round(pst["h2d_s"], 4),
         "probe_device_s": round(pst["device_s"], 4),
         "probe_wall_s": round(wall, 4),
+        "device": {
+            "mode": dw_payload.get("mode"),
+            "peak_bytes": max(
+                (d.get("peakBytes") or 0
+                 for d in dw_payload.get("devices") or []),
+                default=0,
+            ),
+            "compiles": (dw_payload.get("compiles") or {}).get("total", 0),
+        },
         "phases": {
             k: round(v, 3) if isinstance(v, float) else v
             for k, v in st.items()
@@ -2394,6 +2460,14 @@ def build_summary(full: dict, full_path: str = "BENCH_FULL.json") -> dict:
         s["train_streamed_eps"] = ts.get("value")
         s["train_stream_overlap"] = ts.get("overlap_ratio")
         s["train_sharded_x"] = ts.get("sharded_scaling_x")
+        s["train_peak_bytes"] = (ts.get("device") or {}).get("peak_bytes")
+    # device accounting (ISSUE 17): serving-stage compile total — the
+    # steady-state flatness trajectory the history table watches
+    dev = get("serving", "resident", "device") or get(
+        "serving", "concurrent", "device"
+    )
+    if isinstance(dev, dict):
+        s["serving_compiles"] = dev.get("compiles")
     if isinstance(sec.get("textclassification"), dict):
         tc = sec["textclassification"]
         configs["textclass"] = {
@@ -2525,6 +2599,8 @@ HISTORY_FIELDS = (
     ("train_streamed_eps", "up"),    # streamed-feed examples/sec/chip
     ("train_stream_overlap", "up"),  # h2d hidden behind compute
     ("train_sharded_x", "up"),       # mesh vs single-chip train rate
+    ("serving_compiles", "down"),    # attributed serving compiles (flat)
+    ("train_peak_bytes", "down"),    # streamed-train HBM high-water
 )
 
 
@@ -2575,6 +2651,8 @@ def history_record(full: dict, summary: dict,
         "train_streamed_eps": summary.get("train_streamed_eps"),
         "train_stream_overlap": summary.get("train_stream_overlap"),
         "train_sharded_x": summary.get("train_sharded_x"),
+        "serving_compiles": summary.get("serving_compiles"),
+        "train_peak_bytes": summary.get("train_peak_bytes"),
         "shed_counts": {
             "offered": overload.get("offered"),
             "admitted": overload.get("admitted"),

@@ -124,6 +124,8 @@ FAILPOINT_NAMESPACES = (
     "stream.",
     # training telemetry plane (obs/trainwatch.py, ISSUE 16)
     "trainwatch.",
+    # device telemetry plane (obs/devicewatch.py, ISSUE 17)
+    "devicewatch.",
 )
 
 
@@ -361,7 +363,8 @@ class SpanNameRule(Rule):
 #: have a live registration (or collector emission) in the source set —
 #: a row surviving a family rename/removal would document a phantom
 _CATALOG_DRIFT_PREFIXES = ("pio_tpu_fleet_", "pio_tpu_repl_",
-                           "pio_tpu_train_")
+                           "pio_tpu_train_", "pio_tpu_device_",
+                           "pio_tpu_xla_")
 
 _CATALOG_ROW_RE = re.compile(r"^\|\s*`(pio_tpu_[a-z0-9_]+)`\s*\|")
 

@@ -616,7 +616,7 @@ def train_seqrec(
 
         return _scan_steps(state, n, batch_fn)
 
-    from pio_tpu.obs import trainwatch
+    from pio_tpu.obs import devicewatch, trainwatch
 
     trainwatch.begin_algo(
         "seqrec", total_steps=cfg.steps, n_batches=n_batches,
@@ -686,13 +686,21 @@ def train_seqrec(
     elif cfg.batch_size > 0:
         def chunk_fn(state, n):
             _drain()
-            state, losses = chunk_staged(state, n)
+            # compile attribution: n is static in the jitted chunk, so
+            # each distinct chunk length is its own trainer program
+            with devicewatch.compile_span(
+                "train_step", key=("seqrec", "staged", B, int(n))
+            ):
+                state, losses = chunk_staged(state, n)
             _note_chunk(n, losses, keep=1)
             return state
     else:
         def chunk_fn(state, n):
             _drain()
-            state, losses = chunk_full(state, n)
+            with devicewatch.compile_span(
+                "train_step", key=("seqrec", "full", int(n))
+            ):
+                state, losses = chunk_full(state, n)
             _note_chunk(n, losses, keep=1)
             return state
 

@@ -449,7 +449,7 @@ def train_two_tower(
         n_batches, batch, vu, vi,
     )
 
-    from pio_tpu.obs import monotonic_s, trainwatch
+    from pio_tpu.obs import devicewatch, monotonic_s, trainwatch
 
     trainwatch.begin_algo(
         "two_tower", total_steps=cfg.steps, n_batches=n_batches,
@@ -535,7 +535,12 @@ def train_two_tower(
     else:
         def chunk_fn(state, n):
             _drain()
-            state, losses = tt.chunk(state, uids_d, iids_d, n)
+            # compile attribution: n is static in the jitted chunk, so
+            # each distinct chunk length is its own trainer program
+            with devicewatch.compile_span(
+                "train_step", key=("two_tower", "chunk", batch, int(n))
+            ):
+                state, losses = tt.chunk(state, uids_d, iids_d, n)
             _note_chunk(n, losses, keep=1)
             return state
 

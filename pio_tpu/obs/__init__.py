@@ -33,7 +33,9 @@ Plus :mod:`pio_tpu.obs.profile` (the opt-in ``PIO_TPU_PROFILE=dir`` JAX
 profiler hook), :mod:`pio_tpu.obs.promparse` (a small text-format
 parser shared by tests, bench.py and the dashboard) and
 :mod:`pio_tpu.obs.trainwatch` (the training telemetry plane — step
-stream, ``/train.json`` progress, run ledger).
+stream, ``/train.json`` progress, run ledger) and
+:mod:`pio_tpu.obs.devicewatch` (the device telemetry plane — live HBM
+accounting, compile attribution, ``/device.json``).
 
 ``monotonic_s`` is THE process-wide monotonic clock for durations —
 serving paths used to mix ``time.monotonic()`` and
@@ -56,7 +58,7 @@ from pio_tpu.obs.metrics import (
     escape_label_value,
     monotonic_s,
 )
-from pio_tpu.obs import trainwatch
+from pio_tpu.obs import devicewatch, trainwatch
 from pio_tpu.obs.health import Heartbeat, HealthMonitor
 from pio_tpu.obs.hotpath import hotpath_payload
 from pio_tpu.obs.slo import SLOEngine, SLObjective, parse_duration_s, parse_slo
@@ -87,6 +89,7 @@ __all__ = [
     "Tracer",
     "active_trace",
     "add_active_span",
+    "devicewatch",
     "escape_help",
     "escape_label_value",
     "format_trace_header",

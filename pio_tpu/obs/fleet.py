@@ -103,6 +103,7 @@ class _Member:
         self.storage: Optional[dict] = None
         self.stats: Optional[dict] = None
         self.train: Optional[dict] = None
+        self.device: Optional[dict] = None
 
     def age_s(self) -> Optional[float]:
         if self.last_ok is None:
@@ -260,6 +261,7 @@ class FleetAggregator:
         storage = self._get_json(m, "/storage.json")
         stats = self._get_json(m, "/stats.json")
         train = self._get_json(m, "/train.json")
+        device = self._get_json(m, "/device.json")
         with self._lock:
             m.metrics = parsed
             m.last_ok = monotonic_s()
@@ -274,6 +276,8 @@ class FleetAggregator:
                 m.stats = stats
             if train is not None:
                 m.train = train
+            if device is not None:
+                m.device = device
         return True
 
     def _record_error(self, m: _Member, reason: str, msg: str) -> None:
@@ -371,6 +375,7 @@ class FleetAggregator:
             slo = self._slo_rollup()
             partlog = self._partlog_rollup()
             placement = self._placement()
+            devices = self._devices_rollup()
         counts = {"up": 0, "stale": 0, "down": 0, "unknown": 0}
         for e in members:
             counts[e["status"]] = counts.get(e["status"], 0) + 1
@@ -388,6 +393,7 @@ class FleetAggregator:
             "slo": slo,
             "partlog": partlog,
             "placement": placement,
+            "devices": devices,
         }
 
     def _member_entry(self, m: _Member) -> dict:
@@ -407,6 +413,27 @@ class FleetAggregator:
                 "etaSeconds": m.train.get("etaSeconds"),
                 "loss": m.train.get("loss"),
             }
+        devices = None
+        if m.device is not None:
+            # compact view of the member's /device.json (full payload on
+            # the member; the fleet row carries the memory-pressure facts
+            # a budget-driven eviction policy steers by)
+            rows = m.device.get("devices") or []
+            devices = {
+                "mode": m.device.get("mode"),
+                "count": len(rows),
+                "bytesInUse": sum(
+                    int(r.get("bytesInUse") or 0) for r in rows
+                ),
+                "peakBytes": max(
+                    (int(r.get("peakBytes") or 0) for r in rows),
+                    default=0,
+                ),
+                "budgetBytes": m.device.get("budgetBytes"),
+                "headroomBytes": m.device.get("headroomBytes"),
+                "generation": m.device.get("generation"),
+                "compiles": (m.device.get("compiles") or {}).get("total"),
+            }
         return {
             "member": m.name,
             "url": m.url,
@@ -418,7 +445,44 @@ class FleetAggregator:
             "scrapeErrors": m.errors,
             "lastError": m.last_error,
             "training": training,
+            "devices": devices,
         }
+
+    def _devices_rollup(self) -> dict:
+        """Fleet-wide device memory view (ISSUE 17): per-member bytes,
+        headroom and per-device rows — the eviction-policy input of
+        ROADMAP item 6 (shed the member with the least headroom)."""
+        per_member = {}
+        tightest = None
+        for m in self._members:
+            if m.device is None:
+                continue
+            rows = m.device.get("devices") or []
+            entry = {
+                "mode": m.device.get("mode"),
+                "bytesInUse": sum(
+                    int(r.get("bytesInUse") or 0) for r in rows
+                ),
+                "budgetBytes": m.device.get("budgetBytes"),
+                "headroomBytes": m.device.get("headroomBytes"),
+                "generation": m.device.get("generation"),
+                "devices": [
+                    {
+                        "device": r.get("device"),
+                        "bytesInUse": r.get("bytesInUse"),
+                        "peakBytes": r.get("peakBytes"),
+                        "limitBytes": r.get("limitBytes"),
+                    }
+                    for r in rows
+                ],
+            }
+            per_member[m.name] = entry
+            head = entry["headroomBytes"]
+            if head is not None and (
+                tightest is None or head < tightest["headroomBytes"]
+            ):
+                tightest = {"member": m.name, "headroomBytes": head}
+        return {"members": per_member, "tightest": tightest}
 
     def _slo_rollup(self) -> dict:
         """Worst burn rate per objective name across members: the router

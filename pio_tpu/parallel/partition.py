@@ -317,10 +317,18 @@ def shard_params(
         import jax.numpy as jnp
 
         return jax.tree_util.tree_map(jnp.asarray, params), specs
+    per_dev = per_device_nbytes(mesh, params, specs)
     if enforce_budget:
-        assert_device_budget(
-            per_device_nbytes(mesh, params, specs), 1, "shard_params"
-        )
+        assert_device_budget(per_dev, 1, "shard_params")
     shard_fns, _ = make_shard_and_gather_fns(mesh, specs)
     sharded = jax.tree_util.tree_map(lambda f, x: f(x), shard_fns, params)
+    # device ledger (ISSUE 17): latest sharded training placement's
+    # per-chip footprint, replaced on each call (the params it books
+    # are superseded wholesale by the next placement)
+    from pio_tpu.obs import devicewatch
+
+    devicewatch.ledger_place(
+        "shard", "shard_params", per_dev,
+        name="shard_params per-device",
+    )
     return sharded, specs

@@ -162,7 +162,7 @@ def stream_feed(
     import jax
 
     from pio_tpu.faults import failpoint
-    from pio_tpu.obs import monotonic_s, trainwatch
+    from pio_tpu.obs import devicewatch, monotonic_s, trainwatch
 
     if put is None:
         def put(host, _idx):
@@ -173,6 +173,7 @@ def stream_feed(
         return encode(chunks[i])
 
     shipped = [0]  # bytes shipped this call (overlap-probe bookkeeping)
+    chunk_bytes: dict = {}  # in-flight chunk footprint (device ledger)
 
     def _put(host, i):
         failpoint("stream.put")
@@ -180,13 +181,25 @@ def stream_feed(
         _H2D_BYTES.inc(nbytes)
         shipped[0] += nbytes
         trainwatch.record_h2d(nbytes)
+        chunk_bytes[i] = nbytes
+        devicewatch.stream_carry(nbytes)
         if stats is not None:
             stats["h2d_bytes"] = stats.get("h2d_bytes", 0) + nbytes
         return put(host, i)
 
     def _dispatch(carry, dev, i):
         failpoint("stream.dispatch")
-        return dispatch(carry, dev, i)
+        # compile attribution: a chunk whose leaf shapes are new to the
+        # feed's program cache (typically the first chunk and a ragged
+        # tail) pays the trace+compile inside this call
+        with devicewatch.compile_span(
+            "stream_dispatch", key=devicewatch.shape_key(dev)
+        ):
+            out = dispatch(carry, dev, i)
+        if not retain:
+            # chunk consumed, device buffers released with the refs
+            devicewatch.stream_carry(-chunk_bytes.pop(i, 0))
+        return out
 
     n = len(chunks)
     retain = finalize is not None
@@ -215,6 +228,9 @@ def stream_feed(
         stats["device_s"] = stats.get("device_s", 0.0) + (
             monotonic_s() - t0
         )
+        if chunk_bytes:  # retained chunks freed with finalize's result
+            devicewatch.stream_carry(-sum(chunk_bytes.values()))
+            chunk_bytes.clear()
         return result
 
     # overlapped: puts drain on the transfer stream while earlier
@@ -284,8 +300,12 @@ def stream_feed(
                 h2d_s0 * scale, device_s0 * scale, wall_rest
             )
             rec.set_overlap(ratio)
-    return finalize(carry, tuple(devs[i] for i in range(n))) if retain \
+    result = finalize(carry, tuple(devs[i] for i in range(n))) if retain \
         else carry
+    if chunk_bytes:  # retained chunks freed with finalize's result
+        devicewatch.stream_carry(-sum(chunk_bytes.values()))
+        chunk_bytes.clear()
+    return result
 
 
 def record_overlap_ratio(h2d_s: float, device_s: float,

@@ -116,6 +116,7 @@ class DashboardService:
         self.router.add("GET", "/fleet\\.html", self.fleet_html)
         self.router.add("GET", "/fleet\\.json", self.fleet_json)
         self.router.add("GET", "/training\\.html", self.training_html)
+        self.router.add("GET", "/devices\\.html", self.devices_html)
         self.router.add("GET", "/metrics", self.get_metrics)
         self.router.add("GET", "/logs\\.json", self.get_logs)
         self.router.add("GET", "/healthz", self.healthz)
@@ -146,7 +147,8 @@ class DashboardService:
             "<h1>Evaluation Dashboard</h1>"
             "<p><a href='/serving.html'>serving metrics</a> &middot; "
             "<a href='/fleet.html'>fleet</a> &middot; "
-            "<a href='/training.html'>training</a></p>"
+            "<a href='/training.html'>training</a> &middot; "
+            "<a href='/devices.html'>devices</a></p>"
             "<table><tr><th>Instance</th><th>Evaluation</th><th>Start</th>"
             "<th>End</th><th>Result</th></tr>"
             + "".join(rows)
@@ -584,6 +586,107 @@ class DashboardService:
             head + f"<p>scraping <code>{_html.escape(url)}/train.json</code>"
             " (override with ?url=)</p>" + summary + losses + stream_table
             + phase_table + "</body></html>"
+        )
+
+    # -- device telemetry (ISSUE 17) -----------------------------------------
+    def devices_html(self, req: Request) -> Tuple[int, Any]:
+        """Live device view: one scrape of a /device.json surface (query
+        server by default, trainer sidecar via ?url=) — per-device HBM
+        table, compile-site attribution, and the placement ledger."""
+        self._pageviews.inc(page="devices")
+        url = (req.params.get("url") or self.query_url or self.train_url)
+        url = url.rstrip("/") if url else ""
+        head = (
+            "<!doctype html><html><head><title>pio-tpu devices</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse;margin-bottom:1em}"
+            "td,th{border:1px solid #ccc;padding:.4em .8em;"
+            "text-align:right}th,td:first-child{text-align:left}"
+            "</style></head><body><h1>Devices</h1>"
+        )
+        if not url:
+            return 200, _html_response(
+                head + "<p>no /device.json source configured — pass "
+                "<code>--query-url</code> / <code>--train-url</code> or "
+                "use <code>?url=http://127.0.0.1:PORT</code></p>"
+                "</body></html>"
+            )
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                url + "/device.json", timeout=3.0
+            ) as r:
+                data = json.loads(r.read().decode("utf-8"))
+        except Exception as e:
+            return 200, _html_response(
+                head + f"<p>scraping <code>{_html.escape(url)}"
+                "/device.json</code> (override with ?url=)</p>"
+                f"<p>scrape failed: {_html.escape(f'{type(e).__name__}: {e}')}"
+                "</p></body></html>"
+            )
+        mb = lambda v: (
+            f"{v / 1048576.0:,.1f}" if isinstance(v, (int, float)) else "n/a"
+        )
+        budget = data.get("budgetBytes") or 0
+        headroom = data.get("headroomBytes")
+        summary = (
+            f"<p>mode <b>{_html.escape(str(data.get('mode') or '?'))}</b>"
+            f" &middot; generation {data.get('generation', 0)}"
+            f" &middot; samples {data.get('samples', 0)}"
+            f" &middot; budget {mb(budget) if budget else 'unset'} MiB"
+            + (f" &middot; headroom <b>{mb(headroom)}</b> MiB"
+               if headroom is not None else "")
+            + "</p>"
+        )
+        dev_rows = "".join(
+            f"<tr><td>{d.get('device')}</td>"
+            f"<td>{mb(d.get('bytesInUse'))}</td>"
+            f"<td>{mb(d.get('peakBytes'))}</td>"
+            f"<td>{mb(d.get('limitBytes'))}</td>"
+            f"<td>{mb(d.get('ledgerBytes'))}</td>"
+            f"<td>{mb(d.get('driftBytes'))}</td>"
+            f"<td>{_html.escape(str(d.get('source') or '-'))}</td></tr>"
+            for d in data.get("devices") or []
+        )
+        devices = (
+            "<h2>HBM (MiB)</h2><table><tr><th>device</th><th>in use</th>"
+            "<th>peak</th><th>limit</th><th>ledger</th><th>drift</th>"
+            "<th>source</th></tr>" + dev_rows + "</table>"
+            if dev_rows else "<p>no device samples yet</p>"
+        )
+        compiles = data.get("compiles") or {}
+        site_rows = "".join(
+            f"<tr><td>{_html.escape(site)}</td><td>{row.get('count', 0)}</td>"
+            f"<td>{row.get('seconds', 0.0):.3f}</td>"
+            f"<td>{_html.escape(str(row.get('lastTraceId') or '-'))}</td>"
+            "</tr>"
+            for site, row in sorted((compiles.get("sites") or {}).items())
+        )
+        compile_table = (
+            f"<h2>Compiles (total {compiles.get('total', 0)})</h2>"
+            "<table><tr><th>site</th><th>count</th><th>seconds</th>"
+            "<th>last trace</th></tr>" + site_rows + "</table>"
+            if site_rows else "<p>no compiles attributed yet</p>"
+        )
+        ledger = data.get("ledger") or {}
+        place_rows = "".join(
+            f"<tr><td>{_html.escape(str(p.get('name') or p.get('key')))}</td>"
+            f"<td>{_html.escape(str(p.get('category')))}</td>"
+            f"<td>{p.get('generation') if p.get('generation') is not None else '-'}</td>"
+            f"<td>{mb(p.get('bytes'))}</td></tr>"
+            for p in data.get("placements") or []
+        )
+        placements = (
+            f"<h2>Placements (ledger {mb(ledger.get('totalBytes'))} MiB)</h2>"
+            "<table><tr><th>name</th><th>category</th><th>gen</th>"
+            "<th>MiB</th></tr>" + place_rows + "</table>"
+            if place_rows else ""
+        )
+        return 200, _html_response(
+            head + f"<p>scraping <code>{_html.escape(url)}/device.json</code>"
+            " (override with ?url=)</p>" + summary + devices + compile_table
+            + placements + "</body></html>"
         )
 
     def serving(self, req: Request) -> Tuple[int, Any]:

@@ -25,10 +25,12 @@ positions, ``/readyz``, and a ``role: follower`` ``/storage.json``.
 And :class:`TrainStatusService` (ISSUE 16): ``pio train`` is a
 daemonless driver process, so its live progress sidecar rides here —
 ``/train.json`` (the trainwatch recorder's progress payload),
-``/metrics`` (the process-global registry: the run's
-``pio_tpu_train_*`` families), ``/logs.json`` (the slog ring, filterable
-by the run's trace id) and the health pair. A FleetAggregator scraping
-it shows a ``role: trainer`` member for the run's duration.
+``/device.json`` (the active devicewatch's HBM + compile table,
+ISSUE 17), ``/metrics`` (the process-global registry: the run's
+``pio_tpu_train_*`` and ``pio_tpu_device_*``/``pio_tpu_xla_*``
+families), ``/logs.json`` (the slog ring, filterable by the run's
+trace id) and the health pair. A FleetAggregator scraping it shows a
+``role: trainer`` member for the run's duration.
 """
 
 from __future__ import annotations
@@ -218,6 +220,7 @@ class TrainStatusService:
         self.health.add_readiness("training_run", self._check_run)
         self.router = Router()
         self.router.add("GET", "/train\\.json", self.train_json)
+        self.router.add("GET", "/device\\.json", self.device_json)
         self.router.add("GET", "/logs\\.json", self.logs_json)
         self.router.add("GET", "/metrics", self.get_metrics)
         self.router.add("GET", "/healthz", self.healthz)
@@ -238,6 +241,17 @@ class TrainStatusService:
         if rec is None:
             return 503, {"error": "no active training run"}
         return 200, rec.payload()
+
+    def device_json(self, req: Request) -> Tuple[int, Any]:
+        """The run's device telemetry (ISSUE 17): the driver thread
+        activates a DeviceWatch for the run; like /train.json, the
+        sidecar reads whatever watch is live in the process."""
+        from pio_tpu.obs import devicewatch
+
+        watch = devicewatch.active_watch()
+        if watch is None:
+            return 503, {"error": "no active device watch"}
+        return 200, watch.payload()
 
     def logs_json(self, req: Request) -> Tuple[int, Any]:
         from pio_tpu.server.http import int_param

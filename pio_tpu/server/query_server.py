@@ -38,7 +38,7 @@ from pio_tpu.obs import (
     Tracer, add_active_span, hotpath_payload, monotonic_s,
     parse_trace_header,
 )
-from pio_tpu.obs import slog
+from pio_tpu.obs import devicewatch, slog
 from pio_tpu.obs.profile import DeviceProfileHook
 from pio_tpu.obs.slo import engine_for_specs
 from pio_tpu.parallel.context import ComputeContext
@@ -759,6 +759,17 @@ class QueryServerService:
         )
         self._shard_bytes_placed_total.labels(eng)
         self._shard_gather_fallback_total.labels(eng)
+        # -- device telemetry plane (ISSUE 17): per-instance watch on
+        # this registry (DeviceWatch pre-creates its compile site cells,
+        # so the families exist before any pool bind like the counters
+        # above). Module activation routes the residency/stream/shard
+        # ledger hooks here; the sampler thread keeps memory_stats
+        # reads OFF the dispatch path (PIO_TPU_DEVICEWATCH=0 keeps the
+        # thread off — /device.json then samples on demand).
+        self.devwatch = devicewatch.DeviceWatch(registry=self.obs)
+        devicewatch.activate(self.devwatch)
+        if os.environ.get(devicewatch.SAMPLER_ENV, "1") != "0":
+            self.devwatch.start()
         self.profile_hook = DeviceProfileHook.from_env()
         self._swap_lock = make_lock("query.model_swap")
         self._deployed = True
@@ -789,6 +800,7 @@ class QueryServerService:
         r.add("GET", "/", self.status)
         r.add("POST", "/queries\\.json", self.query)
         r.add("GET", "/stats\\.json", self.get_stats)
+        r.add("GET", "/device\\.json", self.get_device)
         r.add("GET", "/metrics", self.get_metrics)
         r.add("GET", "/traces\\.json", self.get_traces)
         r.add("GET", "/logs\\.json", self.get_logs)
@@ -860,6 +872,9 @@ class QueryServerService:
             sum(sc.placed_bytes for sc in incoming), engine_id=eng
         )
         self._resident_models.set(len(incoming), engine_id=eng)
+        # stamp the generation the new placements went live under — the
+        # /device.json placement table keys eviction decisions by it
+        self.devwatch.set_generation(gen)
         log.info(
             "serving engine instance %s (generation %d, %d resident)",
             instance_id, gen, len(incoming),
@@ -889,6 +904,9 @@ class QueryServerService:
         deploy, not inside the first live query. A model whose placement
         fails (budget, shapes) serves single-device instead — counted by
         ``pio_tpu_shard_gather_fallback_total``."""
+        # the incoming generation's sharded footprint replaces the old
+        # one wholesale (placements rebuild below)
+        self.devwatch.ledger_clear("shard")
         mesh = self._serving_mesh()
         if mesh is None:
             return None
@@ -930,6 +948,13 @@ class QueryServerService:
             info = dict(info)
             info["model"] = type(m).__name__
             placed.append(info)
+            # ledger: each chip holds bytesPerDevice of this model
+            # (symmetric placement — device 0 stands for the set)
+            self.devwatch.ledger_place(
+                "shard", type(m).__name__,
+                int(info["bytesPerDevice"]),
+                name=f"sharded {type(m).__name__}",
+            )
             self._shard_bytes_placed_total.inc(
                 int(info["totalBytes"]), engine_id=eng
             )
@@ -1036,7 +1061,11 @@ class QueryServerService:
         warmed = []
         for b in self._buckets.buckets:
             try:
-                self._run_batch(pairs, serving, [wq] * b)
+                # compile attribution: each bucket's first sweep is the
+                # trace+compile; a hot-swap re-warm over an unchanged
+                # ladder hits the jit cache and is NOT recounted
+                with self.devwatch.span("bucket_warmup", key=("bucket", b)):
+                    self._run_batch(pairs, serving, [wq] * b)
                 warmed.append(b)
             except Exception:
                 log.exception("bucket %d warmup dispatch failed", b)
@@ -1969,6 +1998,10 @@ class QueryServerService:
             self._bucket_occ_cell.observe(n / bucket)
             if fresh:
                 self._bucket_retrace_total.inc(engine_id=eng)
+                # a live retrace IS a compile the warmup should have
+                # absorbed — attribute it (count only; the dispatch
+                # isn't individually timed here)
+                self.devwatch.record_compile("bucket_dispatch")
 
         return dispatch_bucketed(
             self._buckets, queries,
@@ -1989,9 +2022,16 @@ class QueryServerService:
             out["microbatch"] = self._batcher.to_dict()
         out["buckets"] = self._buckets.to_dict()
         resident = self._resident
+        # measuredBytes: backend memory_stats total beside the estimated
+        # paramBytes (None on ledger-only backends — the drift gauge
+        # covers the live case); device memory can't be split between
+        # the residency and sharding placements, so both blocks carry
+        # the same device-level measurement
+        measured = self.devwatch.measured_bytes()
         out["residency"] = {
             "enabled": bool(resident),
             "paramBytes": sum(sc.placed_bytes for sc in resident),
+            "measuredBytes": measured,
             "scorers": [sc.to_dict() for sc in resident],
         }
         with self._swap_lock:
@@ -1999,6 +2039,8 @@ class QueryServerService:
         out["sharding"] = (
             dict(sharding) if sharding else {"enabled": False}
         )
+        if sharding:
+            out["sharding"]["measuredBytes"] = measured
         if self._lane_drainer is not None:
             out["batchLane"] = {
                 "role": "drainer",
@@ -2028,6 +2070,13 @@ class QueryServerService:
                     ),
                 }
         return 200, out
+
+    def get_device(self, req: Request):
+        """Device telemetry snapshot (ISSUE 17): per-device bytes
+        (measured or ledger-kept), budget headroom, the compile
+        attribution table, and placements by serving generation —
+        schema in docs/observability.md."""
+        return 200, self.devwatch.payload()
 
     def stage_summary(self) -> dict:
         """Per-stage latency summary from the stage histograms: count,
@@ -2198,6 +2247,8 @@ class QueryServerService:
     def undeploy(self, req: Request):
         self._check_admin(req)
         self._deployed = False
+        self.devwatch.stop()
+        devicewatch.deactivate(self.devwatch)
         if self._batcher is not None:
             self._batcher.stop()
         if self._lane_drainer is not None:

@@ -54,6 +54,7 @@ from typing import Callable, Dict, Optional, Tuple
 import numpy as np
 
 from pio_tpu.faults import failpoint
+from pio_tpu.obs import devicewatch
 
 log = logging.getLogger("pio_tpu.residency")
 
@@ -279,6 +280,13 @@ class ResidentLinearScorer:
         self.backend_reclaims = 0
         self._on_h2d: Optional[Callable[[int], None]] = None
         self._on_donation: Optional[Callable[[str], None]] = None
+        # device ledger (ISSUE 17): book the placement with the active
+        # watch; retire() releases it. Per-scorer compile attribution
+        # keys off the bucket sizes this instance has dispatched.
+        self._dw_key = f"{name}#{id(self):x}"
+        devicewatch.ledger_place(
+            "resident", self._dw_key, self.placed_bytes, name=name
+        )
 
     # -- service wiring ----------------------------------------------------
     def bind(self, on_h2d=None, on_donation=None) -> "ResidentLinearScorer":
@@ -305,6 +313,13 @@ class ResidentLinearScorer:
                         if self._x_sharding is not None
                         else jax.device_put(z)
                     )
+            donated = sum(
+                int(b) * self.n_classes * 4 for b in self._out_bufs
+            )
+        devicewatch.ledger_place(
+            "donated", self._dw_key, donated,
+            name=f"{self.name} logits buffers",
+        )
 
     def retire(self) -> None:
         """Hot-swap eviction: drop the device params and refuse further
@@ -314,6 +329,8 @@ class ResidentLinearScorer:
             self._w_dev = None
             self._b_dev = None
             self._out_bufs.clear()
+        devicewatch.ledger_release("resident", self._dw_key)
+        devicewatch.ledger_release("donated", self._dw_key)
 
     # -- wire encode -------------------------------------------------------
     def quantize(self, X: np.ndarray) -> np.ndarray:
@@ -395,7 +412,19 @@ class ResidentLinearScorer:
                 else jax.device_put(z)
             )
         raw = guard.take()
-        new_logits, codes = _scorer_fn()(raw, x_dev, self._w_dev, self._b_dev)
+        # compile attribution: the first dispatch at this program shape
+        # (batch n × this model's dims) is the trace+compile entry.
+        # Keyed on the WATCH, not the scorer instance: _scorer_fn's jit
+        # cache is process-global, so a hot-swapped replacement scorer
+        # re-dispatching a warmed shape compiles nothing and must not
+        # be recounted. Steady buckets add one set-membership test to
+        # the hot path, nothing more.
+        with devicewatch.compile_span(
+            "resident_scorer", key=(n, self.in_dim, self.n_classes)
+        ):
+            new_logits, codes = _scorer_fn()(
+                raw, x_dev, self._w_dev, self._b_dev
+            )
         # the old buffer object is dead either way; count the backends
         # that actually reclaimed its memory (CPU ignores donation)
         try:

@@ -637,6 +637,80 @@ def cmd_status(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_top(args) -> int:
+    """Live device telemetry table (ISSUE 17): poll a ``/device.json``
+    surface — the query server or a trainer status sidecar — and render
+    per-device HBM plus the compile-site attribution, ``top``-style.
+    ``--once`` prints a single snapshot and exits (scripting/tests)."""
+    import time
+
+    url = args.url.rstrip("/")
+    mb = lambda v: (
+        f"{v / 1048576.0:,.1f}" if isinstance(v, (int, float)) else "n/a"
+    )
+
+    def snapshot() -> Optional[str]:
+        with urllib.request.urlopen(url + "/device.json", timeout=3.0) as r:
+            data = json.loads(r.read().decode("utf-8"))
+        budget = data.get("budgetBytes") or 0
+        headroom = data.get("headroomBytes")
+        lines = [
+            f"pio-tpu devices  {url}/device.json",
+            f"mode {data.get('mode', '?')}  gen {data.get('generation', 0)}"
+            f"  samples {data.get('samples', 0)}"
+            + (f"  budget {mb(budget)} MiB" if budget else "")
+            + (f"  headroom {mb(headroom)} MiB"
+               if headroom is not None else ""),
+            "",
+            f"{'dev':<5}{'in-use MiB':>12}{'peak MiB':>12}"
+            f"{'limit MiB':>12}{'ledger MiB':>12}{'drift MiB':>12}  source",
+        ]
+        for d in data.get("devices") or []:
+            lines.append(
+                f"{d.get('device', '?'):<5}{mb(d.get('bytesInUse')):>12}"
+                f"{mb(d.get('peakBytes')):>12}{mb(d.get('limitBytes')):>12}"
+                f"{mb(d.get('ledgerBytes')):>12}{mb(d.get('driftBytes')):>12}"
+                f"  {d.get('source', '-')}"
+            )
+        compiles = data.get("compiles") or {}
+        lines += ["", f"compiles total {compiles.get('total', 0)}"]
+        sites = compiles.get("sites") or {}
+        if sites:
+            lines.append(f"{'site':<18}{'count':>8}{'seconds':>10}")
+            for site, row in sorted(sites.items()):
+                lines.append(
+                    f"{site:<18}{row.get('count', 0):>8}"
+                    f"{row.get('seconds', 0.0):>10.3f}"
+                )
+        ledger = data.get("ledger") or {}
+        placements = data.get("placements") or []
+        lines += [
+            "",
+            f"placements {len(placements)}"
+            f"  ledger {mb(ledger.get('totalBytes'))} MiB",
+        ]
+        return "\n".join(lines)
+
+    remaining = 1 if args.once else args.iterations
+    clear = not args.once and sys.stdout.isatty()
+    try:
+        while True:
+            try:
+                text = snapshot()
+            except Exception as e:
+                if args.once:
+                    return _err(f"{url}/device.json unreachable: {e}")
+                text = f"pio-tpu devices  {url}/device.json\nscrape failed: {e}"
+            _out(("\x1b[2J\x1b[H" if clear else "") + text)
+            if remaining:
+                remaining -= 1
+                if remaining == 0:
+                    return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_version(args) -> int:
     _out(pio_tpu.__version__)
     return 0
@@ -1144,6 +1218,26 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("status", help="storage/device health check").set_defaults(
         fn=cmd_status
     )
+    a = sub.add_parser(
+        "top", help="live per-device HBM + compile table from /device.json"
+    )
+    a.add_argument(
+        "--url", default="http://127.0.0.1:8000", metavar="URL",
+        help="query server or trainer status sidecar base URL",
+    )
+    a.add_argument(
+        "--interval", type=float, default=2.0, metavar="S",
+        help="poll interval in seconds (default 2.0)",
+    )
+    a.add_argument(
+        "--once", action="store_true",
+        help="print one snapshot and exit (no screen clearing)",
+    )
+    a.add_argument(
+        "-n", "--iterations", type=int, default=0, metavar="N",
+        help="stop after N refreshes (0 = run until interrupted)",
+    )
+    a.set_defaults(fn=cmd_top)
     sub.add_parser("version").set_defaults(fn=cmd_version)
     sub.add_parser(
         "shell", help="interactive Python shell with stores preloaded"

@@ -43,7 +43,7 @@ from pio_tpu.storage import (
     RunStatus,
     Storage,
 )
-from pio_tpu.obs import slog, trainwatch
+from pio_tpu.obs import devicewatch, slog, trainwatch
 from pio_tpu.workflow import shard_store
 from pio_tpu.workflow.engine_json import EngineVariant
 from pio_tpu.workflow.params import WorkflowParams
@@ -220,7 +220,12 @@ def run_train(
     t0 = monotonic_s()
     timings: dict = {}
     try:
-        with trainwatch.recording(recorder), TRAIN_TRACER.trace(
+        # the device watch samples memory + attributes trainer compiles
+        # for the run's duration; the status sidecar serves its payload
+        # as /device.json while steps stream
+        with trainwatch.recording(recorder), devicewatch.watching(
+            devicewatch.DeviceWatch()
+        ), TRAIN_TRACER.trace(
             "train", instanceId=instance_id, engineId=variant.engine_id
         ) as tr:
             with contextlib.ExitStack() as stack:

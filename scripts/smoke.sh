@@ -894,6 +894,157 @@ finally:
 PY
 echo "ok   device-resident serving: int8 wire thin, retraces flat, donations hit"
 
+# ------------------------------------------------ device telemetry plane
+# ISSUE 17: the devicewatch failpoints must be dump-visible, then a
+# resident server's /device.json must book real ledger bytes against
+# the budget, hold the compile-attribution counters FLAT over a steady
+# window AND across a hot swap (while the generation bumps), release
+# bytes on scorer retirement (peak survives), and a dashboard pointed
+# at the server must render /devices.html from one scrape.
+python -m pio_tpu.tools.cli lint --dump-failpoints pio_tpu | python -c '
+import json, sys
+inv = {f["point"] for f in json.load(sys.stdin)["failpoints"]}
+need = {"devicewatch.sample", "devicewatch.payload"}
+missing = need - inv
+assert not missing, f"devicewatch failpoints missing from inventory: {missing}"
+' || fail "devicewatch failpoints missing from --dump-failpoints"
+echo "ok   devicewatch failpoints in lint inventory"
+
+python - <<'PY' || fail "device telemetry stage (bytes/compile/generation assertions)"
+"""Smoke stage: the device telemetry plane over a deploy -> steady ->
+hot-swap -> retire walk, asserted from the OUTSIDE view (/device.json,
+/metrics, /devices.html)."""
+import datetime as dt
+import json
+import os
+import urllib.request
+
+os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "MEM"
+os.environ["PIO_STORAGE_SOURCES_MEM_TYPE"] = "memory"
+os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "MEM"
+os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "MEM"
+os.environ["PIO_TPU_DEVICE_RESIDENT"] = "1"
+os.environ["PIO_TPU_BUCKET_WARMUP"] = "1"
+os.environ["PIO_TPU_BATCH_BUCKETS"] = "1,2,4"
+os.environ["PIO_TPU_DEVICE_BUDGET_BYTES"] = str(64 * 1024 * 1024)
+os.environ["PIO_TPU_DEVICEWATCH_INTERVAL_S"] = "0.2"
+
+import pio_tpu.templates  # noqa: F401  (registers the factory)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.server import create_query_server
+from pio_tpu.server.dashboard import create_dashboard
+from pio_tpu.storage import App, Storage
+from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+app_id = Storage.get_meta_data_apps().insert(App(0, "smoke-dev"))
+le = Storage.get_levents()
+t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+PLANS = ("basic", "premium", "pro")
+n = 0
+for hot, plan in enumerate(PLANS):
+    for _ in range(8):
+        props = {f"attr{j}": (7 if j == hot else 1) for j in range(3)}
+        props["plan"] = plan
+        le.insert(
+            Event("$set", "user", f"u{n}", properties=props,
+                  event_time=t0 + dt.timedelta(minutes=n)),
+            app_id,
+        )
+        n += 1
+variant = variant_from_dict({
+    "id": "smoke-devwatch",
+    "engineFactory": "templates.classification",
+    "datasource": {"params": {"app_name": "smoke-dev"}},
+    "algorithms": [{"name": "logreg", "params": {}}],
+})
+engine, ep = build_engine(variant)
+ctx = ComputeContext.local()
+run_train(engine, ep, variant, ctx=ctx)
+server, service = create_query_server(
+    variant, host="127.0.0.1", port=0, ctx=ctx
+)
+server.start()
+dash = None
+try:
+    base = f"http://127.0.0.1:{server.port}"
+
+    def post(body):
+        req = urllib.request.Request(
+            base + "/queries.json",
+            data=json.dumps(body).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def get(path, b=None):
+        with urllib.request.urlopen((b or base) + path, timeout=10) as r:
+            return r.read().decode("utf-8")
+
+    d0 = json.loads(get("/device.json"))
+    assert d0["generation"] == 1, d0["generation"]
+    assert d0["ledger"]["totalBytes"] > 0, "deploy booked no ledger bytes"
+    assert d0["ledger"]["byCategory"]["resident"] > 0, d0["ledger"]
+    assert d0["budgetBytes"] == 64 * 1024 * 1024, d0["budgetBytes"]
+    assert 0 < d0["headroomBytes"] < d0["budgetBytes"], d0["headroomBytes"]
+    sites = d0["compiles"]["sites"]
+    assert sites["bucket_warmup"]["count"] == 3, sites
+    c0 = d0["compiles"]["total"]
+
+    # steady window: compile counters must not move
+    for q in range(30):
+        hot = q % 3
+        got = post({"attrs": [9.0 if j == hot else 1.0 for j in range(3)]})
+        assert got.get("label") == PLANS[hot], (q, got)
+    d1 = json.loads(get("/device.json"))
+    assert d1["compiles"]["total"] == c0, (
+        f"compiles moved {c0} -> {d1['compiles']['total']} in steady state")
+
+    # hot swap: generation bumps, the re-warm over the unchanged bucket
+    # ladder hits the global jit cache and must NOT be recounted
+    service._load(None)
+    d2 = json.loads(get("/device.json"))
+    assert d2["generation"] == 2, d2["generation"]
+    assert d2["compiles"]["total"] == c0, (
+        f"hot-swap re-warm recounted compiles: {c0} -> "
+        f"{d2['compiles']['total']}")
+    assert d2["ledger"]["byCategory"]["resident"] > 0, d2["ledger"]
+    live_bytes = d2["ledger"]["totalBytes"]
+
+    # retire: resident + donated bytes fall to zero, the peak survives
+    for sc in list(service._resident):
+        sc.retire()
+    d3 = json.loads(get("/device.json"))
+    cats = d3["ledger"]["byCategory"]
+    assert cats.get("resident", 0) == 0, cats
+    assert cats.get("donated", 0) == 0, cats
+    assert d3["ledger"]["totalBytes"] < live_bytes
+    peak = d3["devices"][0]["peakBytes"]
+    assert peak >= live_bytes, (peak, live_bytes)
+
+    m = get("/metrics")
+    for fam in ("pio_tpu_device_bytes_in_use", "pio_tpu_device_peak_bytes",
+                "pio_tpu_device_budget_headroom_bytes",
+                "pio_tpu_xla_compile_total"):
+        assert fam in m, f"{fam} missing from /metrics"
+
+    # dashboard renders the plane from one scrape
+    dash = create_dashboard(host="127.0.0.1", port=0, query_url=base)
+    dash.start()
+    page = get("/devices.html", b=f"http://127.0.0.1:{dash.port}")
+    assert "scrape failed" not in page, page[:400]
+    assert "bucket_warmup" in page and "HBM (MiB)" in page, page[:400]
+    print(f"device stage: ledger {live_bytes}B live -> "
+          f"{d3['ledger']['totalBytes']}B retired, peak {peak}B, "
+          f"compiles {c0} flat across steady+swap, gen 1->2")
+finally:
+    if dash is not None:
+        dash.stop()
+    server.stop()
+PY
+echo "ok   device telemetry: bytes rise/fall, compiles flat, /devices.html renders"
+
 # ------------------------------------------------ evloop HTTP front
 # ISSUE 13: the selector-based front must hold the threaded baseline
 # on pooled keep-alive load (bench.py serving.evfront records the

@@ -348,12 +348,16 @@ class TestServiceBuckets:
         svc = bucket_service
         from pio_tpu.templates.recommendation import Query
 
+        compiles_before = svc.devwatch.compile_counts()
         for i in range(100):
             n = (i % 5) + 1                   # includes bucket+1 and >max
             qs = [Query(user=f"u{j % 6}", num=2) for j in range(n)]
             results, fresh = svc._predict_batch_bucketed(qs)
             assert len(results) == n and not fresh
         assert svc._buckets.retraces == 0
+        # the ISSUE-17 monitored form of the same invariant: the compile
+        # attribution counters must not move across a steady-state window
+        assert svc.devwatch.compile_counts() == compiles_before
 
     def test_batch_matches_solo_results(self, bucket_service):
         svc = bucket_service

@@ -1,0 +1,493 @@
+"""Device telemetry plane (ISSUE 17): sampler ledger/live modes,
+compile-site attribution, headroom math, the ``/device.json`` surface
+on both daemons, fleet federation, and the ``pio top`` one-shot.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pio_tpu.templates  # noqa: F401
+from pio_tpu.obs import devicewatch
+from pio_tpu.obs.devicewatch import DeviceWatch
+from pio_tpu.obs.metrics import MetricsRegistry
+
+
+def _watch(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return DeviceWatch(**kw)
+
+
+# ----------------------------------------------------------------- ledger
+
+
+class TestLedger:
+    def test_place_release_and_peak_retention(self):
+        w = _watch()
+        w.ledger_place("resident", "m1", 1000, name="model one")
+        w.ledger_place("donated", "m1", 200)
+        rows = w.sample()
+        assert w.ledger_bytes() == 1200
+        assert rows[0]["source"] == "ledger"
+        assert rows[0]["bytesInUse"] == 1200
+        assert rows[0]["peakBytes"] == 1200
+        w.ledger_release("resident", "m1")
+        rows = w.sample()
+        # bytes fall with the release; the high-water mark survives it
+        assert rows[0]["bytesInUse"] == 200
+        assert rows[0]["peakBytes"] == 1200
+
+    def test_replace_same_key_is_resize(self):
+        w = _watch()
+        w.ledger_place("shard", "shard_params", 500)
+        w.ledger_place("shard", "shard_params", 900)
+        assert w.ledger_bytes() == 900
+
+    def test_stream_carry_floors_at_zero(self):
+        w = _watch()
+        w.stream_carry(300)
+        w.stream_carry(200)
+        assert w.ledger_bytes() == 500
+        w.stream_carry(-10_000)
+        assert w.ledger_bytes() == 0
+
+    def test_generation_restamps_unknown_rows(self):
+        w = _watch()
+        w.ledger_place("resident", "pre", 10)
+        w.set_generation(3)
+        w.ledger_place("resident", "post", 20)
+        gens = {
+            p["key"]: p["generation"] for p in w.payload()["placements"]
+        }
+        assert gens == {"pre": 3, "post": 3}
+        w.set_generation(4)
+        w.ledger_place("resident", "later", 30)
+        gens = {
+            p["key"]: p["generation"] for p in w.payload()["placements"]
+        }
+        # only never-stamped rows are restamped — history is kept
+        assert gens == {"pre": 3, "post": 3, "later": 4}
+
+
+# -------------------------------------------------------- live stats mode
+
+
+def _live_stats(in_use, peak=None, limit=2**20, label="tpu:0"):
+    return [(
+        label,
+        {"bytes_in_use": in_use,
+         "peak_bytes_in_use": peak if peak is not None else in_use,
+         "bytes_limit": limit},
+        0,
+    )]
+
+
+class TestLiveMode:
+    def test_memory_stats_rows_and_drift(self):
+        w = _watch(stats_fn=lambda: _live_stats(5000, peak=8000))
+        w.ledger_place("resident", "m", 4000)
+        rows = w.sample()
+        assert rows[0]["source"] == "memory_stats"
+        assert rows[0]["bytesInUse"] == 5000
+        assert rows[0]["limitBytes"] == 2**20
+        # drift = measured - booked: the estimate-honesty gauge input
+        assert rows[0]["driftBytes"] == 1000
+        assert w.measured_bytes() == 5000
+
+    def test_no_drift_without_ledger(self):
+        w = _watch(stats_fn=lambda: _live_stats(5000))
+        assert w.sample()[0]["driftBytes"] is None
+
+    def test_ledger_mode_measures_nothing(self):
+        w = _watch()
+        w.ledger_place("resident", "m", 4000)
+        w.sample()
+        assert w.measured_bytes() is None
+
+    def test_headroom_against_budget(self):
+        w = _watch(
+            stats_fn=lambda: _live_stats(600) + [
+                ("tpu:1", {"bytes_in_use": 900,
+                           "peak_bytes_in_use": 900,
+                           "bytes_limit": None}, 1),
+            ],
+            budget_bytes=1000,
+        )
+        w.sample()
+        p = w.payload()
+        # budget minus the BUSIEST device, not the sum
+        assert p["headroomBytes"] == 100
+        assert p["budgetBytes"] == 1000
+
+    def test_no_budget_no_headroom(self):
+        w = _watch(stats_fn=lambda: _live_stats(600))
+        p = w.payload()
+        assert p["budgetBytes"] is None and p["headroomBytes"] is None
+
+
+# -------------------------------------------------- compile attribution
+
+
+class TestCompileAttribution:
+    def test_span_dedups_by_site_key(self):
+        w = _watch()
+        with w.span("resident_scorer", key=("b", 4)) as fresh:
+            assert fresh
+        with w.span("resident_scorer", key=("b", 4)) as fresh:
+            assert not fresh
+        with w.span("resident_scorer", key=("b", 8)) as fresh:
+            assert fresh
+        # same key under a DIFFERENT site is its own program cache
+        with w.span("train_step", key=("b", 4)) as fresh:
+            assert fresh
+        assert w.compile_counts() == {
+            "resident_scorer": 2, "train_step": 1,
+        }
+
+    def test_none_key_always_fresh(self):
+        w = _watch()
+        for _ in range(3):
+            with w.span("bucket_warmup") as fresh:
+                assert fresh
+        assert w.compile_counts() == {"bucket_warmup": 3}
+
+    def test_record_carries_seconds_and_histogram(self):
+        w = _watch()
+        w.record_compile("train_step", 0.25, trace_id="t-1")
+        w.record_compile("train_step", 0.05)
+        sites = w.payload()["compiles"]["sites"]
+        row = sites["train_step"]
+        assert row["count"] == 2
+        assert row["seconds"] == pytest.approx(0.30)
+        assert row["lastS"] == pytest.approx(0.05)
+        assert row["lastTraceId"] == "t-1"
+        text = "\n".join(w.registry.render())
+        assert 'pio_tpu_xla_compile_total{site="train_step"} 2' in text
+        assert 'pio_tpu_xla_compile_seconds_count{site="train_step"} 2' \
+            in text
+
+    def test_module_hooks_route_to_active_watch(self):
+        w = _watch()
+        # a service fixture elsewhere in the suite may have left its
+        # watch active — clear it so the no-op path is actually no-op
+        devicewatch.deactivate()
+        # inactive: the hooks are no-ops
+        devicewatch.record_compile("stream_dispatch")
+        with devicewatch.compile_span("stream_dispatch", key=1) as fresh:
+            assert not fresh
+        with devicewatch.watching(w, sample=False):
+            devicewatch.record_compile("stream_dispatch")
+            with devicewatch.compile_span(
+                "stream_dispatch", key=devicewatch.shape_key([1, 2])
+            ) as fresh:
+                assert fresh
+            devicewatch.ledger_place("shard", "k", 64)
+            devicewatch.stream_carry(32)
+        assert w.compile_counts()["stream_dispatch"] == 2
+        assert w.ledger_bytes() == 96
+        # deactivated again: nothing lands
+        devicewatch.record_compile("stream_dispatch")
+        assert w.compile_counts()["stream_dispatch"] == 2
+        assert devicewatch.last_watch() is w
+
+    def test_shape_key_distinguishes_leaf_shapes(self):
+        import numpy as np
+
+        a = devicewatch.shape_key([np.zeros((2, 3)), np.zeros(4)])
+        b = devicewatch.shape_key([np.zeros((2, 3)), np.zeros(5)])
+        assert a != b and a == devicewatch.shape_key(
+            [np.ones((2, 3)), np.ones(4)]
+        )
+
+
+# ------------------------------------------------- service integration
+# Same fixture shape as tests/test_batch_buckets.py: memory storage,
+# a tiny trained classification instance with residency forced on, then
+# the service's /device.json driven directly (handlers take Request|None).
+
+import datetime as dt  # noqa: E402
+
+from pio_tpu.controller import ComputeContext  # noqa: E402
+from pio_tpu.data import Event  # noqa: E402
+from pio_tpu.server.query_server import QueryServerService  # noqa: E402
+from pio_tpu.storage import App, Storage  # noqa: E402
+from pio_tpu.workflow import (  # noqa: E402
+    build_engine,
+    run_train,
+    variant_from_dict,
+)
+
+VARIANT = {
+    "id": "cls-devwatch",
+    "engineFactory": "templates.classification",
+    "datasource": {"params": {"app_name": "devwatch-test"}},
+    "algorithms": [{"name": "logreg", "params": {}}],
+}
+
+
+@pytest.fixture()
+def mem_storage(tmp_home, monkeypatch):
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "MEM")
+    Storage.reset()
+    yield
+    Storage.reset()
+
+
+def _train_classification():
+    import numpy as np
+
+    app_id = Storage.get_meta_data_apps().insert(App(0, "devwatch-test"))
+    le = Storage.get_levents()
+    t0 = dt.datetime(2026, 5, 1, tzinfo=dt.timezone.utc)
+    rng = np.random.default_rng(7)
+    n = 0
+    for plan, hot in (("basic", 0), ("premium", 1), ("pro", 2)):
+        for _ in range(8):
+            attrs = rng.integers(0, 3, size=3)
+            attrs[hot] += 6
+            props = {f"attr{j}": int(attrs[j]) for j in range(3)}
+            props["plan"] = plan
+            le.insert(
+                Event("$set", "user", f"u{n}", properties=props,
+                      event_time=t0 + dt.timedelta(minutes=n)),
+                app_id,
+            )
+            n += 1
+    variant = variant_from_dict(VARIANT)
+    engine, ep = build_engine(variant)
+    ctx = ComputeContext.local()
+    run_train(engine, ep, variant, ctx=ctx)
+    return variant, ctx
+
+
+@pytest.fixture()
+def resident_service(mem_storage, monkeypatch):
+    monkeypatch.setenv("PIO_TPU_DEVICE_RESIDENT", "1")
+    monkeypatch.setenv("PIO_TPU_BATCH_BUCKETS", "1,2,4")
+    monkeypatch.setenv("PIO_TPU_BUCKET_WARMUP", "1")
+    monkeypatch.setenv(devicewatch.SAMPLER_ENV, "0")  # sample on demand
+    variant, ctx = _train_classification()
+    svc = QueryServerService(variant, ctx=ctx)
+    yield svc
+    svc.devwatch.stop()
+    devicewatch.deactivate(svc.devwatch)
+
+
+class TestServiceDeviceJson:
+    def test_payload_books_residency_and_warmup(self, resident_service):
+        svc = resident_service
+        assert svc._resident  # residency placed, or the test is vacuous
+        status, body = svc.get_device(None)
+        assert status == 200
+        assert body["mode"] == "ledger"  # CPU: no memory_stats
+        cats = body["ledger"]["byCategory"]
+        assert cats.get("resident", 0) > 0    # scorer params booked
+        assert cats.get("donated", 0) > 0     # prealloc'd logits buffers
+        assert body["generation"] == 1
+        assert body["devices"][0]["bytesInUse"] == body["ledger"][
+            "totalBytes"
+        ]
+        # the deploy-time warmup sweep is the only compile activity
+        sites = body["compiles"]["sites"]
+        assert sites["bucket_warmup"]["count"] == 3
+        assert "bucket_dispatch" not in sites
+
+    def test_queries_attribute_scorer_compiles_once(self, resident_service):
+        svc = resident_service
+        from pio_tpu.templates.classification import Query
+
+        before = svc.devwatch.compile_counts()
+        for _ in range(4):
+            svc._predict_one(Query(attrs=(9.0, 1.0, 1.0)))
+        after = svc.devwatch.compile_counts()
+        # the warmup sweep already owns every program for warmed shapes:
+        # a steady query window must not move any site counter
+        assert after == before
+
+    def test_hot_swap_bumps_generation_compiles_flat(self, resident_service):
+        svc = resident_service
+        before = svc.devwatch.compile_counts()
+        status, body = svc.get_device(None)
+        assert body["generation"] == 1
+        svc._load(None)                       # the /reload path
+        status, body = svc.get_device(None)
+        assert body["generation"] == 2
+        # re-warm over the unchanged bucket ladder hits the global jit
+        # cache — the attribution must NOT recount it
+        assert svc.devwatch.compile_counts() == before
+
+    def test_retire_releases_ledger_bytes(self, resident_service):
+        svc = resident_service
+        in_use = svc.get_device(None)[1]["ledger"]["totalBytes"]
+        assert in_use > 0
+        for sc in list(svc._resident):
+            sc.retire()
+        after = svc.get_device(None)[1]["ledger"]["byCategory"]
+        assert after.get("resident", 0) == 0
+        assert after.get("donated", 0) == 0
+        # the peak survives the retirement (high-water semantics)
+        peak = svc.get_device(None)[1]["devices"][0]["peakBytes"]
+        assert peak >= in_use
+
+    def test_stats_json_measured_beside_estimated(self, resident_service):
+        from pio_tpu.server.http import Request
+
+        svc = resident_service
+        status, stats = svc.get_stats(
+            Request("GET", "/stats.json", {}, None)
+        )
+        assert status == 200
+        res = stats["residency"]
+        assert "measuredBytes" in res and "paramBytes" in res
+        assert res["measuredBytes"] is None   # ledger mode on CPU
+        # the disabled sharding block stays minimal — measuredBytes only
+        # rides an enabled mesh placement
+        assert "measuredBytes" not in stats["sharding"]
+
+
+# ------------------------------------------------- trainer sidecar + top
+
+
+def _http(url):
+    try:
+        with urllib.request.urlopen(url, timeout=15) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+class TestTrainSidecar:
+    def test_device_json_503_without_watch_then_200(self):
+        from pio_tpu.server.fleetd import create_train_status_server
+
+        devicewatch.deactivate()
+        server = create_train_status_server().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            assert _http(base + "/device.json")[0] == 503
+            w = _watch(budget_bytes=1000)
+            w.ledger_place("stream", "chunk", 400)
+            with devicewatch.watching(w, sample=False):
+                status, body = _http(base + "/device.json")
+                assert status == 200
+                assert body["ledger"]["totalBytes"] == 400
+                assert body["headroomBytes"] == 600
+            assert _http(base + "/device.json")[0] == 503
+        finally:
+            server.stop()
+
+    def test_pio_top_once_renders_snapshot(self, capsys):
+        from pio_tpu.server.fleetd import create_train_status_server
+        from pio_tpu.tools import cli
+
+        server = create_train_status_server().start()
+        base = f"http://127.0.0.1:{server.port}"
+        w = _watch()
+        w.ledger_place("resident", "m", 2 * 1048576)
+        w.record_compile("train_step", 0.1)
+        try:
+            with devicewatch.watching(w, sample=False):
+                rc = cli.main(["top", "--once", "--url", base])
+            out = capsys.readouterr().out
+        finally:
+            server.stop()
+        assert rc == 0
+        assert "\x1b[" not in out             # --once never clears
+        assert "mode ledger" in out
+        assert "2.0" in out                   # MiB rendering
+        assert "compiles total 1" in out
+        assert "train_step" in out
+
+    def test_pio_top_once_unreachable_exits_nonzero(self, capsys):
+        from pio_tpu.tools import cli
+
+        rc = cli.main(
+            ["top", "--once", "--url", "http://127.0.0.1:1"]
+        )
+        assert rc == 1
+
+
+# ------------------------------------------------------ fleet federation
+
+from pio_tpu.obs.fleet import FleetAggregator, parse_targets  # noqa: E402
+
+
+class _FakeFleet:
+    def __init__(self, members):
+        self.members = dict(members)
+
+    def fetch(self, url, timeout):
+        name = url.split("://", 1)[1].split("/", 1)[0]
+        path = "/" + url.split("://", 1)[1].split("/", 1)[1]
+        endpoints = self.members.get(name)
+        if endpoints is None:
+            raise OSError(f"connection refused: {name}")
+        if path not in endpoints:
+            raise urllib.error.HTTPError(url, 404, "nope", {}, None)
+        body = endpoints[path]
+        return body.encode() if isinstance(body, str) else body
+
+
+def _member_device_json(in_use, budget=None, generation=1):
+    return json.dumps({
+        "mode": "ledger",
+        "budgetBytes": budget,
+        "headroomBytes": budget - in_use if budget else None,
+        "generation": generation,
+        "devices": [{"device": "cpu:0", "bytesInUse": in_use,
+                     "peakBytes": in_use, "limitBytes": None}],
+        "compiles": {"total": 4, "sites": {}},
+    })
+
+
+METRICS = "# TYPE pio_tpu_q_total counter\npio_tpu_q_total 1\n"
+
+
+class TestFleetDevices:
+    def test_member_rows_and_tightest_rollup(self):
+        fake = _FakeFleet({
+            "a:1": {"/metrics": METRICS,
+                    "/device.json": _member_device_json(
+                        100, budget=1000, generation=2)},
+            "b:2": {"/metrics": METRICS,
+                    "/device.json": _member_device_json(
+                        900, budget=1000)},
+            "c:3": {"/metrics": METRICS},     # no device surface
+        })
+        agg = FleetAggregator(
+            parse_targets("a:1,b:2,c:3"), registry=MetricsRegistry(),
+            fetch=fake.fetch, interval_s=0.05,
+        )
+        assert agg.scrape_once() == 3
+        payload = agg.fleet_payload()
+        by = {e["member"]: e for e in payload["members"]}
+        assert by["a:1"]["devices"]["bytesInUse"] == 100
+        assert by["a:1"]["devices"]["generation"] == 2
+        assert by["a:1"]["devices"]["compiles"] == 4
+        assert by["c:3"]["devices"] is None
+        roll = payload["devices"]
+        assert set(roll["members"]) == {"a:1", "b:2"}
+        # b is the memory-tightest member — the eviction-policy signal
+        assert roll["tightest"] == {
+            "member": "b:2", "headroomBytes": 100,
+        }
+
+    def test_snapshot_retained_across_member_death(self):
+        fake = _FakeFleet({
+            "a:1": {"/metrics": METRICS,
+                    "/device.json": _member_device_json(100, budget=500)},
+        })
+        agg = FleetAggregator(
+            parse_targets("a:1"), registry=MetricsRegistry(),
+            fetch=fake.fetch, interval_s=0.05,
+        )
+        assert agg.scrape_once() == 1
+        fake.members["a:1"] = None            # member dies
+        agg.scrape_once()
+        entry = agg.fleet_payload()["members"][0]
+        assert entry["devices"]["bytesInUse"] == 100  # last-seen kept
