@@ -1303,6 +1303,35 @@ def _bench_pool_serving(factors, n_users: int, n_items: int) -> dict:
         got["workers"] = n_workers
         got["host_cores"] = cores
         got["time_to_ready_s"] = time_to_ready_s
+        # routed pass (ISSUE 18): the SAME live pool fronted by the
+        # serving router, so routed_qps vs the direct number above
+        # isolates the fabric's relay cost on this host; the overhead
+        # metric is the concurrent p50 delta through the extra hop.
+        try:
+            from pio_tpu.server.routerd import create_router_server
+
+            rs = create_router_server(
+                [("pool", f"http://127.0.0.1:{pool.port}")],
+                host="127.0.0.1", port=0, interval_s=1.0,
+            ).start()
+            rs.service.start()
+            try:
+                _wait_readyz(rs.port)
+                rg = _concurrent_stage(rs.port, n_users)
+                got["routed_qps"] = rg["qps"]
+                got["routed_p50_ms"] = rg.get("p50_ms")
+                got["routed_p95_ms"] = rg.get("p95_ms")
+                if rg.get("p50_ms") is not None and \
+                        got.get("p50_ms") is not None:
+                    got["router_overhead_ms"] = round(
+                        rg["p50_ms"] - got["p50_ms"], 3
+                    )
+            finally:
+                rs.service.stop()
+                rs.stop()
+        except Exception as exc:
+            print(f"# routed serving stage failed: {exc}",
+                  file=sys.stderr)
     finally:
         pool.stop()
 
@@ -2396,6 +2425,8 @@ def build_summary(full: dict, full_path: str = "BENCH_FULL.json") -> dict:
         "serving_mb_mode": get("serving", "concurrent_microbatch", "mode"),
         "pool_qps": get("serving", "pool", "qps"),
         "pool_laned_qps": get("serving", "pool", "laned_qps"),
+        "routed_qps": get("serving", "pool", "routed_qps"),
+        "router_overhead_ms": get("serving", "pool", "router_overhead_ms"),
         "pool_workers": get("serving", "pool", "workers"),
         "host_cores": get("serving", "pool", "host_cores"),
         "sharded_qps": get("serving", "sharded", "qps"),
@@ -2589,6 +2620,8 @@ HISTORY_FIELDS = (
     ("value", "up"),                 # headline examples/sec/chip
     ("serving_qps", "up"),
     ("pool_qps", "up"),
+    ("routed_qps", "up"),            # through the serving-fabric router
+    ("router_overhead_ms", "down"),  # router hop p50 cost vs direct
     ("evfront_qps", "up"),
     ("evfront_p50_ms", "down"),
     ("p50_predict_ms", "down"),
@@ -2641,6 +2674,8 @@ def history_record(full: dict, summary: dict,
         "vs_baseline": summary.get("vs_baseline"),
         "serving_qps": summary.get("serving_qps"),
         "pool_qps": summary.get("pool_qps"),
+        "routed_qps": summary.get("routed_qps"),
+        "router_overhead_ms": summary.get("router_overhead_ms"),
         "evfront_qps": summary.get("evfront_qps"),
         "evfront_p50_ms": summary.get("evfront_p50_ms"),
         "p50_predict_ms": summary.get("p50_predict_ms"),

@@ -126,6 +126,8 @@ FAILPOINT_NAMESPACES = (
     "trainwatch.",
     # device telemetry plane (obs/devicewatch.py, ISSUE 17)
     "devicewatch.",
+    # serving fabric front tier (pio_tpu/router/, ISSUE 18)
+    "router.",
 )
 
 
@@ -364,7 +366,7 @@ class SpanNameRule(Rule):
 #: a row surviving a family rename/removal would document a phantom
 _CATALOG_DRIFT_PREFIXES = ("pio_tpu_fleet_", "pio_tpu_repl_",
                            "pio_tpu_train_", "pio_tpu_device_",
-                           "pio_tpu_xla_")
+                           "pio_tpu_xla_", "pio_tpu_router_")
 
 _CATALOG_ROW_RE = re.compile(r"^\|\s*`(pio_tpu_[a-z0-9_]+)`\s*\|")
 

@@ -104,6 +104,7 @@ class _Member:
         self.stats: Optional[dict] = None
         self.train: Optional[dict] = None
         self.device: Optional[dict] = None
+        self.routerd: Optional[dict] = None
 
     def age_s(self) -> Optional[float]:
         if self.last_ok is None:
@@ -121,6 +122,8 @@ class _Member:
         return "down"
 
     def role(self) -> str:
+        if self.routerd is not None:
+            return "router"
         if self.storage is not None and "role" in self.storage:
             return str(self.storage["role"])
         if self.train is not None:
@@ -262,6 +265,7 @@ class FleetAggregator:
         stats = self._get_json(m, "/stats.json")
         train = self._get_json(m, "/train.json")
         device = self._get_json(m, "/device.json")
+        routerd = self._get_json(m, "/router.json")
         with self._lock:
             m.metrics = parsed
             m.last_ok = monotonic_s()
@@ -278,6 +282,8 @@ class FleetAggregator:
                 m.train = train
             if device is not None:
                 m.device = device
+            if routerd is not None:
+                m.routerd = routerd
         return True
 
     def _record_error(self, m: _Member, reason: str, msg: str) -> None:
@@ -434,6 +440,28 @@ class FleetAggregator:
                 "generation": m.device.get("generation"),
                 "compiles": (m.device.get("compiles") or {}).get("total"),
             }
+        slo = None
+        if m.slo is not None:
+            # per-member worst burn across its objectives: the serving
+            # router's spreading weight (the fleet-level rollup only
+            # names the single worst member per objective)
+            top = None
+            for s in m.slo.get("slos", []):
+                for burn in (s.get("burnRates") or {}).values():
+                    if burn is not None and (top is None or burn > top):
+                        top = burn
+            slo = {"worstBurn": top}
+        fabric = None
+        if m.routerd is not None:
+            # compact front-tier row (full payload on the member's own
+            # /router.json): ring occupancy is what the dashboard needs
+            ring = m.routerd.get("ring") or {}
+            fabric = {
+                "members": ring.get("members"),
+                "routable": ring.get("routable"),
+                "size": ring.get("size"),
+                "partitions": ring.get("partitions"),
+            }
         return {
             "member": m.name,
             "url": m.url,
@@ -444,8 +472,10 @@ class FleetAggregator:
             "scrapes": m.attempts,
             "scrapeErrors": m.errors,
             "lastError": m.last_error,
+            "slo": slo,
             "training": training,
             "devices": devices,
+            "router": fabric,
         }
 
     def _devices_rollup(self) -> dict:

@@ -1,0 +1,52 @@
+"""Serving fabric front tier: entity-affine routing across N members.
+
+The pieces, in request order:
+
+- :mod:`ring` — partition-affine slots (mirroring partlog's
+  ``crc32(entity_id) % N``) composed with rendezvous hashing, so a
+  user's events and serving replica co-locate and membership churn
+  remaps only the failed member's keyspace;
+- :mod:`core` — :class:`~pio_tpu.router.core.ServingRouter`: health
+  gating (scrape status + passive forced-down), SLO-aware spreading
+  (worst-burn demotion, priority-floor shedding with the QoS
+  vocabulary), keep-alive forwarding with a single ring-order retry,
+  and the ``pio_tpu_router_*`` metric families;
+- :mod:`deploy` — manifest-verified instance distribution: members
+  sha256-verify every shard from their own store before the router
+  flips their generation into rotation.
+
+The daemon wiring (HTTP front, embedded fleet scraper, ``/router.json``)
+lives in :mod:`pio_tpu.server.routerd`; ``pio route`` is the CLI verb.
+"""
+
+from pio_tpu.router.core import (
+    BURN_LIMIT_ENV,
+    DEFAULT_BURN_LIMIT,
+    MemberState,
+    ServingRouter,
+    Shed,
+)
+from pio_tpu.router.deploy import (
+    DeployVerifyError,
+    load_manifest,
+    manifest_digests,
+    push_deploy,
+    verify_instance,
+)
+from pio_tpu.router.ring import Ring, hrw_score, slot_of
+
+__all__ = [
+    "BURN_LIMIT_ENV",
+    "DEFAULT_BURN_LIMIT",
+    "DeployVerifyError",
+    "MemberState",
+    "Ring",
+    "ServingRouter",
+    "Shed",
+    "hrw_score",
+    "load_manifest",
+    "manifest_digests",
+    "push_deploy",
+    "slot_of",
+    "verify_instance",
+]

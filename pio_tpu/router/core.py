@@ -1,0 +1,459 @@
+"""Serving-router core: health-gated affine pick, forward with one
+retry, SLO-aware spreading, QoS-vocabulary shedding.
+
+The router is the front tier of the serving fabric: it owns no model
+bytes, only a :class:`~pio_tpu.router.ring.Ring` over the configured
+members plus a continuously refreshed health/load view (ingested from
+the embedded fleet aggregator's ``fleet_payload()``).  Request flow:
+
+1. **pick** — ``router.pick`` failpoint, then rank replicas for the
+   entity id (affinity + rendezvous), restricted to routable members
+   (not scrape-``down``, not passively forced down, see below).  Keyless
+   requests (the packed int8 wire carries no entity id) spread by load
+   score with a rotation tiebreak instead.
+2. **spread** — replicas whose worst SLO burn is at or past the burn
+   limit are demoted behind calm ones; when *every* replica burns,
+   classes with a non-zero priority floor (``batchpredict``/``shadow``)
+   are shed with the standard QoS vocabulary (503 + ``Retry-After``)
+   while ``interactive`` rides the least-burning replica.
+3. **forward** — ``router.forward`` failpoint per attempt, then relay
+   over a keep-alive upstream connection.  A transport error marks the
+   member passively down for ``forced_down_s`` (so the very next pick
+   skips it — scrape confirmation follows within two intervals) and the
+   request is retried ONCE on the next replica in ring order.  Upstream
+   status codes, including 5xx, are relayed as-is: a delivered response
+   is the member's answer, not the router's to rewrite.
+
+Shedding raises :class:`Shed`; the daemon maps it onto 429/503 with
+``Retry-After`` via the qos helpers so clients see one overload grammar
+whether a member or the router said no.
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from urllib.parse import urlsplit
+
+from pio_tpu.faults import failpoint
+from pio_tpu.obs import monotonic_s
+from pio_tpu.obs.metrics import MetricsRegistry
+from pio_tpu.qos.policy import priority_floor
+from pio_tpu.router.ring import Ring
+from pio_tpu.utils.envutil import env_float
+
+log = logging.getLogger("pio_tpu.router")
+
+__all__ = [
+    "BURN_LIMIT_ENV",
+    "DEFAULT_BURN_LIMIT",
+    "DEFAULT_LAG_SOFT_BYTES",
+    "LAG_SOFT_ENV",
+    "MemberState",
+    "ServingRouter",
+    "Shed",
+    "UpstreamReply",
+]
+
+#: worst-burn at/over which a replica is demoted (and non-interactive
+#: classes shed when every replica is there). 2.0 = burning the error
+#: budget at twice the sustainable rate, the classic page threshold.
+BURN_LIMIT_ENV = "PIO_TPU_ROUTER_BURN_LIMIT"
+DEFAULT_BURN_LIMIT = 2.0
+
+#: replication lag that adds +1.0 to a member's load score — soft
+#: pressure away from laggy followers, never a hard gate.
+LAG_SOFT_ENV = "PIO_TPU_ROUTER_LAG_SOFT_BYTES"
+DEFAULT_LAG_SOFT_BYTES = 64 * 1024 * 1024
+
+#: headers relayed member-ward: the QoS/trace vocabulary must survive
+#: the hop (priority floors honored end-to-end) but hop-by-hop framing
+#: must not.
+_FORWARD_HEADER_PREFIX = "x-pio-"
+_FORWARD_HEADERS = ("content-type", "authorization")
+_DROP_REPLY_HEADERS = frozenset(
+    ("connection", "keep-alive", "transfer-encoding", "content-length")
+)
+
+
+class Shed(Exception):
+    """The router itself refused the request (no routable member, or
+    SLO pressure + priority floor). Carries the QoS vocabulary."""
+
+    def __init__(self, status: int, reason: str, retry_after_s: float):
+        super().__init__(f"shed: {reason}")
+        self.status = status
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+#: (status, reply headers, body bytes, member name)
+UpstreamReply = Tuple[int, Dict[str, str], bytes, str]
+
+
+@dataclass
+class MemberState:
+    """Router-side view of one serving member."""
+
+    name: str
+    base_url: str
+    host: str
+    port: int
+    status: str = "unknown"        # scrape view: up|stale|down|unknown
+    burn: float = 0.0              # worst SLO burn across objectives
+    lag_bytes: int = 0             # worst follower replication lag
+    generation: Optional[str] = None   # last verified-deployed instance
+    forced_down_until: float = 0.0     # passive-failure gate (monotonic)
+
+
+class _UpstreamPool:
+    """Keep-alive ``http.client`` connections to one member; handler
+    threads check one out per request and return it after a clean,
+    fully-read response (anything else closes it)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self._idle: List[http.client.HTTPConnection] = []
+        self._lock = threading.Lock()
+
+    def _checkout(self) -> http.client.HTTPConnection:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+
+    def _checkin(self, c: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if len(self._idle) < 8:
+                self._idle.append(c)
+                return
+        c.close()
+
+    def request(self, method, path, body, headers):  # pio: hotpath=zerocopy
+        """One relayed exchange; the request body bytes are handed to
+        the kernel as-is (no re-encode, no staging copy)."""
+        c = self._checkout()
+        try:
+            c.request(method, path, body=body, headers=headers)
+            r = c.getresponse()
+            out = r.read()
+            reply = {}
+            for k, v in r.getheaders():
+                if k.lower() not in _DROP_REPLY_HEADERS:
+                    reply[k] = v
+            status = r.status
+            reuse = not r.will_close
+        except Exception:
+            try:
+                c.close()
+            except Exception:
+                pass
+            raise
+        if reuse:
+            self._checkin(c)
+        else:
+            c.close()
+        return status, reply, out
+
+    def close(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for c in idle:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+def forward_headers(headers: Dict[str, str]) -> Dict[str, str]:
+    """The member-ward header set: ``X-Pio-*`` (priority, deadline,
+    trace) plus content framing; hop-by-hop headers stay behind."""
+    out = {}
+    for k, v in headers.items():
+        lk = k.lower()
+        if lk.startswith(_FORWARD_HEADER_PREFIX) or lk in _FORWARD_HEADERS:
+            out[k] = v
+    return out
+
+
+class ServingRouter:
+    """Pick/forward engine shared by the daemon and tests.
+
+    ``targets`` is the configured fleet as ``(name, base_url)`` pairs
+    (the :func:`pio_tpu.obs.fleet.parse_targets` shape).
+    """
+
+    def __init__(
+        self,
+        targets: Sequence[Tuple[str, str]],
+        registry: MetricsRegistry,
+        partitions: Optional[int] = None,
+        burn_limit: Optional[float] = None,
+        lag_soft_bytes: Optional[float] = None,
+        timeout_s: float = 5.0,
+        forced_down_s: float = 10.0,
+    ):
+        if not targets:
+            raise ValueError("router needs at least one member target")
+        self.burn_limit = (
+            burn_limit if burn_limit is not None
+            else env_float(BURN_LIMIT_ENV, DEFAULT_BURN_LIMIT, positive=True)
+        )
+        self.lag_soft_bytes = (
+            lag_soft_bytes if lag_soft_bytes is not None
+            else env_float(
+                LAG_SOFT_ENV, float(DEFAULT_LAG_SOFT_BYTES), positive=True
+            )
+        )
+        self.timeout_s = timeout_s
+        self.forced_down_s = forced_down_s
+        self._members: Dict[str, MemberState] = {}
+        self._pools: Dict[str, _UpstreamPool] = {}
+        for name, base_url in targets:
+            parts = urlsplit(base_url)
+            host = parts.hostname or "127.0.0.1"
+            port = parts.port or (443 if parts.scheme == "https" else 80)
+            self._members[name] = MemberState(
+                name=name, base_url=base_url, host=host, port=port
+            )
+            self._pools[name] = _UpstreamPool(host, port, timeout_s)
+        self.ring = Ring(self._members.keys(), partitions)
+        self._lock = threading.Lock()
+        self._rr = 0
+        self.obs = registry
+        self._forwarded = registry.counter(
+            "pio_tpu_router_forwarded_total",
+            "Requests relayed to a member (retries counted there too)",
+            ("member",),
+        )
+        self._retried = registry.counter(
+            "pio_tpu_router_retried_total",
+            "Relays that were the one-shot retry after a transport "
+            "error, labeled by the member that absorbed the retry",
+            ("member",),
+        )
+        self._shed = registry.counter(
+            "pio_tpu_router_shed_total",
+            "Requests the router itself refused, by reason",
+            ("reason",),
+        )
+        self._forward_errors = registry.counter(
+            "pio_tpu_router_forward_errors_total",
+            "Transport failures talking to a member",
+            ("member",),
+        )
+        self._deploys = registry.counter(
+            "pio_tpu_router_deploys_total",
+            "Deploy pushes by member and outcome "
+            "(verified / rejected / error)",
+            ("member", "outcome"),
+        )
+        self._pick_seconds = registry.histogram(
+            "pio_tpu_router_pick_seconds",
+            "Replica ranking latency (health gate + ring rank + spread)",
+        )
+        self._ring_size = registry.gauge(
+            "pio_tpu_router_ring_size",
+            "Members currently routable (scrape-live, not forced down)",
+        )
+        self._member_routable = registry.gauge(
+            "pio_tpu_router_member_routable",
+            "1 while the member is in the ring, else 0",
+            ("member",),
+        )
+        for name in self._members:
+            self._forwarded.labels(name)
+            self._retried.labels(name)
+            self._forward_errors.labels(name)
+            self._member_routable.set(0.0, member=name)
+        self._ring_size.set(0.0)
+
+    # -- health/load ingestion --------------------------------------------
+    def ingest_fleet(self, payload: dict) -> None:
+        """Fold a ``fleet_payload()`` snapshot into the member table:
+        scrape status, per-member worst burn, worst follower lag."""
+        lag_by_follower: Dict[str, int] = {}
+        for leader in (payload.get("partlog") or {}).get("leaders", []):
+            for part in leader.get("partitionDetail", []):
+                for f in part.get("followers", []):
+                    name, lag = f.get("follower"), f.get("lagBytes")
+                    if name is None or lag is None:
+                        continue
+                    lag_by_follower[name] = max(
+                        lag_by_follower.get(name, 0), int(lag)
+                    )
+        with self._lock:
+            for entry in payload.get("members", []):
+                ms = self._members.get(entry.get("member"))
+                if ms is None:
+                    continue
+                ms.status = entry.get("status") or "unknown"
+                slo = entry.get("slo") or {}
+                burn = slo.get("worstBurn")
+                ms.burn = float(burn) if burn is not None else 0.0
+                ms.lag_bytes = lag_by_follower.get(ms.name, 0)
+        self._refresh_gauges()
+
+    def _refresh_gauges(self) -> None:
+        now = monotonic_s()
+        n = 0
+        for ms in self._members.values():
+            ok = self._routable(ms, now)
+            n += 1 if ok else 0
+            self._member_routable.set(1.0 if ok else 0.0, member=ms.name)
+        self._ring_size.set(float(n))
+
+    @staticmethod
+    def _routable(ms: MemberState, now: float) -> bool:
+        # "unknown" rides: before the first scrape pass the router must
+        # not blackhole the fleet — a truly dead member fails its first
+        # forward and is forced down right there.
+        if ms.forced_down_until > now:
+            return False
+        return ms.status in ("up", "stale", "unknown")
+
+    def note_failure(self, member: str) -> None:
+        """Passive health: a transport error takes the member out of
+        the ring immediately, without waiting for the scrape loop's
+        stale->down progression."""
+        ms = self._members.get(member)
+        if ms is None:
+            return
+        self._forward_errors.inc(member=member)
+        ms.forced_down_until = monotonic_s() + self.forced_down_s
+        self._refresh_gauges()
+        log.warning(
+            "member %s forced down for %.1fs after transport error",
+            member, self.forced_down_s,
+        )
+
+    def note_deploy(self, member: str, instance_id: str,
+                    outcome: str) -> None:
+        self._deploys.inc(member=member, outcome=outcome)
+        if outcome == "verified":
+            ms = self._members.get(member)
+            if ms is not None:
+                ms.generation = instance_id
+
+    # -- pick --------------------------------------------------------------
+    def _load_score(self, ms: MemberState) -> float:
+        return ms.burn + ms.lag_bytes / self.lag_soft_bytes
+
+    def _spread_order(self, routable: List[str]) -> List[str]:
+        with self._lock:
+            self._rr += 1
+            rot = self._rr % len(routable)
+        rotated = routable[rot:] + routable[:rot]
+        # stable sort: equal load scores keep the rotation, so an idle
+        # fleet round-robins instead of hammering the first member
+        return sorted(
+            rotated, key=lambda m: self._load_score(self._members[m])
+        )
+
+    def pick(self, entity_id: Optional[str],
+             priority: str = "") -> List[MemberState]:
+        """Ordered forward plan for one request; raises :class:`Shed`
+        when the router must answer the overload itself."""
+        t0 = monotonic_s()
+        failpoint("router.pick")
+        routable = [
+            name for name, ms in self._members.items()
+            if self._routable(ms, t0)
+        ]
+        if not routable:
+            self._shed.inc(reason="no_members")
+            raise Shed(503, "no_members", self.forced_down_s)
+        if entity_id:
+            order = self.ring.rank(entity_id, routable)
+        else:
+            order = self._spread_order(routable)
+        calm = [
+            m for m in order if self._members[m].burn < self.burn_limit
+        ]
+        if calm:
+            if len(calm) != len(order):
+                # demote burning replicas behind calm ones, both halves
+                # keeping ring order (affinity still wins among calm)
+                order = calm + [m for m in order if m not in calm]
+        else:
+            if priority_floor(priority) > 0.0:
+                # every replica is burning: non-interactive classes are
+                # the error budget's relief valve, exactly as on-member
+                self._shed.inc(reason="slo_burn")
+                raise Shed(503, "slo_burn", self.forced_down_s)
+            order = sorted(order, key=lambda m: self._members[m].burn)
+        self._pick_seconds.observe(monotonic_s() - t0)
+        return [self._members[m] for m in order]
+
+    # -- forward -----------------------------------------------------------
+    def forward(self, method, path, body, headers,
+                entity_id=None, priority=""):  # pio: hotpath=zerocopy
+        """Relay one request, retrying once on the next replica after a
+        transport error.  ``body`` goes through untouched — on the
+        packed int8 wire that is the zero-copy contract end to end."""
+        plan = self.pick(entity_id, priority)
+        hdrs = forward_headers(headers)
+        last_exc = None
+        for attempt, ms in enumerate(plan[:2]):
+            failpoint("router.forward")
+            try:
+                status, reply, out = self._pools[ms.name].request(
+                    method, path, body, hdrs
+                )
+            except Exception as e:
+                self.note_failure(ms.name)
+                last_exc = e
+                continue
+            self._forwarded.inc(member=ms.name)
+            if attempt:
+                self._retried.inc(member=ms.name)
+            return status, reply, out, ms.name
+        self._shed.inc(reason="upstream_unreachable")
+        raise Shed(503, "upstream_unreachable", self.forced_down_s) \
+            from last_exc
+
+    # -- introspection -----------------------------------------------------
+    def snapshot(self) -> dict:
+        """The ``/router.json`` member/ring view (schema documented in
+        docs/observability.md)."""
+        now = monotonic_s()
+        members = []
+        for ms in self._members.values():
+            members.append({
+                "member": ms.name,
+                "url": ms.base_url,
+                "status": ms.status,
+                "routable": self._routable(ms, now),
+                "worstBurn": round(ms.burn, 4),
+                "lagBytes": ms.lag_bytes,
+                "generation": ms.generation,
+                "forwarded": int(self._forwarded.value(ms.name)),
+                "retried": int(self._retried.value(ms.name)),
+                "errors": int(self._forward_errors.value(ms.name)),
+            })
+        routable = [m["member"] for m in members if m["routable"]]
+        return {
+            "ring": {
+                "members": list(self.ring.members),
+                "partitions": self.ring.partitions,
+                "routable": routable,
+                "size": len(routable),
+            },
+            "policy": {
+                "burnLimit": self.burn_limit,
+                "lagSoftBytes": self.lag_soft_bytes,
+                "forcedDownSeconds": self.forced_down_s,
+            },
+            "members": members,
+        }
+
+    def close(self) -> None:
+        for pool in self._pools.values():
+            pool.close()

@@ -813,6 +813,7 @@ class QueryServerService:
         r.add("GET", "/healthz", self.healthz)
         r.add("GET", "/readyz", self.readyz)
         r.add("POST", "/reload", self.reload)
+        r.add("POST", "/deploy\\.json", self.deploy_verified)
         r.add("POST", "/undeploy", self.undeploy)
         r.add("GET", "/plugins\\.json", self.list_plugins)
 
@@ -2243,6 +2244,41 @@ class QueryServerService:
                 self._pool_gen.value += 1
                 self._seen_gen = self._pool_gen.value
         return 200, {"engineInstanceId": self.instance_id}
+
+    def deploy_verified(self, req: Request):
+        """Manifest-verified generation swap (the router deploy path).
+
+        The router pushes ``{engineInstanceId, manifest}``; every shard
+        record named by the manifest is re-hashed from THIS member's
+        store (sha256 + size) before the swap — a mismatch answers 409
+        and the current generation keeps serving. Only after
+        verification does the instance hot-swap in, exactly like
+        /reload (pool siblings follow via the shared generation
+        counter, which re-resolves to the latest COMPLETED instance —
+        the rollout target in the fabric flow)."""
+        from pio_tpu.router.deploy import DeployVerifyError, verify_instance
+
+        self._check_admin(req)
+        body = req.body if isinstance(req.body, dict) else {}
+        instance_id = body.get("engineInstanceId")
+        if not instance_id:
+            raise HTTPError(400, "engineInstanceId is required")
+        try:
+            report = verify_instance(
+                Storage.get_model_data_models(),
+                instance_id,
+                expected=body.get("manifest"),
+            )
+        except DeployVerifyError as e:
+            raise HTTPError(409, f"deploy verification failed: {e}") from e
+        self._load(instance_id)
+        if self._pool_gen is not None:
+            with self._pool_gen.get_lock():
+                self._pool_gen.value += 1
+                self._seen_gen = self._pool_gen.value
+        report["engineInstanceId"] = self.instance_id
+        report["verified"] = True
+        return 200, report
 
     def undeploy(self, req: Request):
         self._check_admin(req)

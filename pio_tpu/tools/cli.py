@@ -434,6 +434,77 @@ def cmd_fleet(args) -> int:
     return 0
 
 
+def cmd_route(args) -> int:
+    """Run the serving-router daemon (ISSUE 18), or — with ``--deploy``
+    — push a manifest-verified rollout through a running one."""
+    import os
+
+    from pio_tpu.obs.fleet import TARGETS_ENV
+
+    if args.deploy:
+        import json as _json
+        import urllib.request
+
+        body = _json.dumps({"engineInstanceId": args.deploy}).encode()
+        headers = {"Content-Type": "application/json; charset=utf-8"}
+        if args.admin_key:
+            headers["Authorization"] = f"Bearer {args.admin_key}"
+        req = urllib.request.Request(
+            args.url.rstrip("/") + "/deploy",
+            data=body, headers=headers, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+                report = _json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            _err(f"deploy failed: HTTP {e.code}: "
+                 f"{e.read().decode('utf-8', 'replace')[:500]}")
+            return 1
+        except Exception as e:
+            _err(f"deploy failed: cannot reach router at {args.url}: {e}")
+            return 1
+        for row in report.get("members", []):
+            _out(f"  {row['member']}: {row['outcome']}")
+        ok = report.get("verified") == len(report.get("members", []))
+        _out(
+            f"instance {report.get('engineInstanceId')}: "
+            f"{report.get('verified')}/{len(report.get('members', []))} "
+            f"member(s) verified"
+        )
+        return 0 if ok else 1
+
+    from pio_tpu.obs.fleet import parse_targets
+    from pio_tpu.server.routerd import create_router_server
+
+    targets = args.targets or os.environ.get(TARGETS_ENV, "")
+    if not targets.strip():
+        _err(
+            "no serving members: pass --targets host:port,... or set "
+            f"{TARGETS_ENV}"
+        )
+        return 1
+    server = create_router_server(
+        parse_targets(targets),
+        host=args.ip,
+        port=args.port,
+        partitions=args.partitions,
+        interval_s=args.interval,
+        admin_key=args.admin_key,
+        timeout_s=args.timeout,
+    )
+    server.service.start()
+    members = ", ".join(m.name for m in server.service.agg.members())
+    _out(f"Serving router listening on {args.ip}:{server.port} "
+         f"(members: {members})")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        _out("shutting down")
+    finally:
+        server.service.stop()
+    return 0
+
+
 def cmd_adminserver(args) -> int:
     from pio_tpu.server import create_admin_server
 
@@ -1192,6 +1263,46 @@ def build_parser() -> argparse.ArgumentParser:
              "PIO_TPU_FLEET_INTERVAL_S)",
     )
     a.set_defaults(fn=cmd_fleet)
+
+    a = sub.add_parser(
+        "route", help="run the serving router (multi-host front tier)"
+    )
+    a.add_argument("--ip", default="0.0.0.0")
+    a.add_argument("--port", type=int, default=8500)
+    a.add_argument(
+        "--targets", default=None, metavar="HOST:PORT,...",
+        help="comma list of serving members to route across (falls back "
+             "to PIO_TPU_FLEET_TARGETS)",
+    )
+    a.add_argument(
+        "--partitions", type=int, default=None, metavar="N",
+        help="partlog partition count for entity co-location (affinity "
+             "engages when it matches the member count)",
+    )
+    a.add_argument(
+        "--interval", type=float, default=None, metavar="SECONDS",
+        help="member scrape interval (default 5s, jittered; also "
+             "PIO_TPU_FLEET_INTERVAL_S)",
+    )
+    a.add_argument(
+        "--timeout", type=float, default=5.0, metavar="SECONDS",
+        help="upstream forward timeout per attempt (default 5s)",
+    )
+    a.add_argument(
+        "--admin-key", default=None,
+        help="bearer key for /deploy (loopback-only without one); also "
+             "sent member-ward on deploy pushes",
+    )
+    a.add_argument(
+        "--deploy", default=None, metavar="INSTANCE_ID",
+        help="client mode: push a manifest-verified rollout of this "
+             "engine instance through the router at --url, then exit",
+    )
+    a.add_argument(
+        "--url", default="http://127.0.0.1:8500", metavar="URL",
+        help="router base URL for --deploy (default localhost:8500)",
+    )
+    a.set_defaults(fn=cmd_route)
 
     a = sub.add_parser("adminserver", help="run the admin REST API")
     a.add_argument("--ip", default="0.0.0.0")

@@ -65,7 +65,9 @@ def _full_result() -> dict:
             "pool": {"qps": 1306.2, "p50_ms": 10.3, "p95_ms": 23.4,
                      "workers": 2, "host_cores": 1,
                      "laned_qps": 1188.4, "laned_p50_ms": 11.2,
-                     "laned_p95_ms": 24.8},
+                     "laned_p95_ms": 24.8,
+                     "routed_qps": 1240.7, "routed_p50_ms": 11.1,
+                     "routed_p95_ms": 24.2, "router_overhead_ms": 0.8},
             "resident": {
                 "queries": 200,
                 "int8": {"wire": "int8", "h2d_bytes_per_request": 3.0,
@@ -163,6 +165,8 @@ def test_summary_survives_tail_truncation(bench):
     assert parsed["serving_qps"] == 1431.0
     assert parsed["pool_qps"] == 1306.2
     assert parsed["pool_laned_qps"] == 1188.4
+    assert parsed["routed_qps"] == 1240.7
+    assert parsed["router_overhead_ms"] == 0.8
     # per-bucket mode map compacts to {bucket: mode} in the summary
     assert parsed["serving_mb_mode"] == {"1": "on", "2": "on", "8": "off"}
     assert parsed["serving_h2d_x"] == 4.0
@@ -280,6 +284,8 @@ def test_history_record_pulls_trajectory_fields(bench):
     assert rec["git_sha"] == "deadbee"
     assert rec["value"] == summary["value"]
     assert rec["p95_predict_ms"] == full["serving"]["concurrent"]["p95_ms"]
+    assert rec["routed_qps"] == 1240.7
+    assert rec["router_overhead_ms"] == 0.8
     ov = full["serving"].get("overload") or {}
     assert rec["shed_rate"] == ov.get("shed_rate")
     assert rec["smoke"] in (True, False)

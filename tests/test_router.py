@@ -1,0 +1,572 @@
+"""Serving fabric tests (ISSUE 18): rendezvous ring math (partlog
+co-location agreement, churn remaps only the affected keyspace),
+router core pick/forward/retry/shed against live fake members,
+manifest-verified deploys, and the routerd HTTP surface including the
+packed int8 passthrough."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pio_tpu.obs.metrics import MetricsRegistry
+from pio_tpu.router.core import ServingRouter, Shed, forward_headers
+from pio_tpu.router.deploy import (
+    DeployVerifyError,
+    manifest_digests,
+    verify_instance,
+)
+from pio_tpu.router.ring import Ring, hrw_score, slot_of
+from pio_tpu.server.http import (
+    PACKED_QUERY_CONTENT_TYPE,
+    JsonHTTPServer,
+    RawResponse,
+    Router,
+    metrics_response,
+)
+from pio_tpu.server.routerd import RouterService, entity_of
+
+KEYS = [f"user{i}" for i in range(400)]
+
+
+def http(method, url, body=None, headers=None, raw=None):
+    data = raw if raw is not None else (
+        json.dumps(body).encode() if body is not None else None
+    )
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+# ---------------------------------------------------------------------------
+# ring math
+
+
+class TestRing:
+    def test_slot_matches_partlog_crc32(self):
+        """Co-location: the ring's partition slot is byte-for-byte the
+        partlog event partition."""
+        from pio_tpu.storage.partlog.partitioned import partition_of
+
+        members = ["h3:8000", "h1:8000", "h2:8000"]
+        ring = Ring(members, partitions=3)
+        ordered = sorted(members)
+        for k in KEYS:
+            assert slot_of(k, 3) == partition_of(k, 3)
+            assert ring.slot_owner(k) == ordered[partition_of(k, 3)]
+            assert ring.rank(k)[0] == ordered[partition_of(k, 3)]
+
+    def test_affinity_off_when_counts_differ(self):
+        ring = Ring(["a", "b", "c"], partitions=4)
+        assert ring.slot_owner("user1") is None
+
+    def test_rank_is_a_permutation_and_deterministic(self):
+        members = [f"m{i}" for i in range(5)]
+        ring = Ring(members)
+        for k in KEYS[:50]:
+            order = ring.rank(k)
+            assert sorted(order) == sorted(members)
+            assert order == Ring(members).rank(k)
+
+    def test_hrw_score_is_process_stable(self):
+        # blake2b, not hash(): same score in every process
+        assert hrw_score("m1", "user7") == hrw_score("m1", "user7")
+        assert hrw_score("m1", "user7") != hrw_score("m2", "user7")
+
+    def test_removal_remaps_only_failed_keyspace(self):
+        """The HRW property: keys whose primary survives keep it."""
+        members = [f"m{i}" for i in range(5)]
+        ring = Ring(members)
+        before = ring.keyspace(KEYS)
+        for dead in members:
+            live = [m for m in members if m != dead]
+            after = ring.keyspace(KEYS, routable=live)
+            for k in KEYS:
+                if before[k] != dead:
+                    assert after[k] == before[k]
+                else:
+                    assert after[k] != dead
+
+    def test_affine_removal_remaps_only_failed_slot(self):
+        """With partition affinity engaged, killing one member moves
+        only its slot's keys; reviving it moves them straight back."""
+        members = [f"m{i}" for i in range(4)]
+        ring = Ring(members, partitions=4)
+        before = ring.keyspace(KEYS)
+        dead = "m2"
+        live = [m for m in members if m != dead]
+        after = ring.keyspace(KEYS, routable=live)
+        moved = [k for k in KEYS if before[k] != after[k]]
+        assert moved, "some keys must have lived on the dead member"
+        for k in moved:
+            assert before[k] == dead
+        # recovery: the full ring reproduces the original placement
+        assert ring.keyspace(KEYS) == before
+
+    def test_addition_steals_only_own_keyspace(self):
+        members = [f"m{i}" for i in range(4)]
+        grown = members + ["m9"]
+        before = Ring(members).keyspace(KEYS)
+        after = Ring(grown).keyspace(KEYS)
+        for k in KEYS:
+            if after[k] != before[k]:
+                assert after[k] == "m9"
+
+    def test_spread_is_roughly_uniform(self):
+        counts = {}
+        ring = Ring([f"m{i}" for i in range(4)])
+        for k, m in ring.keyspace(KEYS).items():
+            counts[m] = counts.get(m, 0) + 1
+        assert min(counts.values()) > len(KEYS) / 4 / 3
+
+
+# ---------------------------------------------------------------------------
+# fake serving members
+
+
+class _FakeMember:
+    """Minimal member: /queries.json echoes which member answered (and
+    the wire it saw), /metrics is a real registry render."""
+
+    def __init__(self, name):
+        self.name = name
+        self.obs = MetricsRegistry()
+        router = Router()
+        router.add("POST", "/queries\\.json", self.query)
+        router.add("GET", "/metrics", self.metrics)
+        router.add("POST", "/deploy\\.json", self.deploy)
+        self.deploy_outcome = (200, {"verified": True})
+        self.server = JsonHTTPServer(
+            router, "127.0.0.1", 0, name=f"fake-{name}"
+        ).start()
+        self.port = self.server.port
+
+    def query(self, req):
+        if req.packed is not None:
+            return 200, RawResponse(
+                bytes(req.packed),
+                content_type=PACKED_QUERY_CONTENT_TYPE,
+                headers={"X-Fake-Member": self.name},
+            )
+        return 200, {
+            "member": self.name,
+            "echo": req.body,
+            "priority": req.header("X-Pio-Priority"),
+        }
+
+    def deploy(self, req):
+        status, body = self.deploy_outcome
+        return status, dict(body, member=self.name)
+
+    def metrics(self, req):
+        return 200, metrics_response(self.obs.render())
+
+    def stop(self):
+        self.server.stop()
+
+
+@pytest.fixture()
+def two_members():
+    members = [_FakeMember("a"), _FakeMember("b")]
+    try:
+        yield members
+    finally:
+        for m in members:
+            m.stop()
+
+
+def _targets(members):
+    return [
+        (m.name, f"http://127.0.0.1:{m.port}") for m in members
+    ]
+
+
+# ---------------------------------------------------------------------------
+# router core
+
+
+class TestServingRouter:
+    def test_forward_reaches_a_member_and_counts(self, two_members):
+        sr = ServingRouter(_targets(two_members), MetricsRegistry())
+        try:
+            status, reply, body, member = sr.forward(
+                "POST", "/queries.json", json.dumps({"user": "u1"}).encode(),
+                {"content-type": "application/json"}, entity_id="u1",
+            )
+            assert status == 200
+            assert json.loads(body)["member"] == member
+            assert sr._forwarded.value(member) == 1.0
+            # affinity: the same entity lands on the same member
+            for _ in range(3):
+                assert sr.forward(
+                    "POST", "/queries.json", b"{}", {}, entity_id="u1"
+                )[3] == member
+        finally:
+            sr.close()
+
+    def test_dead_member_retries_once_and_leaves_ring(self, two_members):
+        sr = ServingRouter(
+            _targets(two_members), MetricsRegistry(), forced_down_s=60.0
+        )
+        try:
+            # find an entity whose primary is member "a", then kill "a"
+            entity = next(
+                k for k in KEYS if sr.ring.rank(k)[0] == "a"
+            )
+            two_members[0].stop()
+            status, _, body, member = sr.forward(
+                "POST", "/queries.json", b"{}", {}, entity_id=entity,
+            )
+            assert status == 200 and member == "b"
+            assert sr._retried.value("b") == 1.0
+            assert sr._forward_errors.value("a") == 1.0
+            # passive health: "a" is out of the ring for every next pick
+            assert [m.name for m in sr.pick(entity)] == ["b"]
+            snap = sr.snapshot()
+            assert snap["ring"]["routable"] == ["b"]
+        finally:
+            sr.close()
+
+    def test_all_members_dead_sheds_503(self, two_members):
+        sr = ServingRouter(
+            _targets(two_members), MetricsRegistry(), forced_down_s=60.0
+        )
+        try:
+            for m in two_members:
+                m.stop()
+            with pytest.raises(Shed) as ei:
+                sr.forward("POST", "/queries.json", b"{}", {})
+            assert ei.value.status == 503
+            with pytest.raises(Shed) as ei:
+                sr.pick("u1")
+            assert ei.value.reason == "no_members"
+        finally:
+            sr.close()
+
+    def test_burning_replica_demoted(self, two_members):
+        sr = ServingRouter(_targets(two_members), MetricsRegistry())
+        try:
+            entity = next(k for k in KEYS if sr.ring.rank(k)[0] == "a")
+            sr.ingest_fleet({"members": [
+                {"member": "a", "status": "up",
+                 "slo": {"worstBurn": 9.0}},
+                {"member": "b", "status": "up",
+                 "slo": {"worstBurn": 0.1}},
+            ]})
+            # affinity says "a", the burn demotion says "b"
+            assert [m.name for m in sr.pick(entity)] == ["b", "a"]
+        finally:
+            sr.close()
+
+    def test_all_burning_sheds_by_priority_floor(self, two_members):
+        sr = ServingRouter(_targets(two_members), MetricsRegistry())
+        try:
+            sr.ingest_fleet({"members": [
+                {"member": "a", "status": "up",
+                 "slo": {"worstBurn": 5.0}},
+                {"member": "b", "status": "up",
+                 "slo": {"worstBurn": 3.0}},
+            ]})
+            with pytest.raises(Shed) as ei:
+                sr.pick("u1", priority="batchpredict")
+            assert ei.value.reason == "slo_burn"
+            with pytest.raises(Shed):
+                sr.pick("u1", priority="shadow")
+            # interactive still rides, least-burning first
+            assert [m.name for m in sr.pick("u1", "interactive")] == \
+                ["b", "a"]
+        finally:
+            sr.close()
+
+    def test_scrape_down_member_leaves_ring(self, two_members):
+        sr = ServingRouter(_targets(two_members), MetricsRegistry())
+        try:
+            sr.ingest_fleet({"members": [
+                {"member": "a", "status": "down"},
+                {"member": "b", "status": "up"},
+            ]})
+            assert [m.name for m in sr.pick("u1")] == ["b"]
+            assert sr.obs.gauge(
+                "pio_tpu_router_ring_size", ""
+            ).value() == 1.0
+        finally:
+            sr.close()
+
+    def test_forward_headers_allowlist(self):
+        out = forward_headers({
+            "x-pio-priority": "shadow",
+            "x-pio-deadline-ms": "50",
+            "content-type": "application/json",
+            "connection": "keep-alive",
+            "host": "router:8500",
+            "content-length": "17",
+        })
+        assert set(out) == {
+            "x-pio-priority", "x-pio-deadline-ms", "content-type"
+        }
+
+
+# ---------------------------------------------------------------------------
+# manifest-verified deploys
+
+
+class _Rec:
+    def __init__(self, models):
+        self.models = models
+
+
+class _Store(dict):
+    def get(self, k, default=None):  # models-store duck type
+        return dict.get(self, k, default)
+
+
+def _sharded_store(instance_id="inst1"):
+    import hashlib
+
+    from pio_tpu.workflow.shard_store import SHARD_MANIFEST_SUFFIX
+
+    shard_a = b"\x01" * 64
+    shard_b = b"\x02" * 96
+    manifest = {
+        "version": 1,
+        "n_shards": 2,
+        "mesh_shape": [2],
+        "algos": [{
+            "template": "als",
+            "arrays": [{
+                "name": "emb", "shape": [4, 40], "dtype": "int8",
+                "spec": [["rows"]],
+                "shards": [
+                    {"id": f"{instance_id}.shard0",
+                     "sha256": hashlib.sha256(shard_a).hexdigest(),
+                     "size": len(shard_a), "rows": [0, 2]},
+                    {"id": f"{instance_id}.shard1",
+                     "sha256": hashlib.sha256(shard_b).hexdigest(),
+                     "size": len(shard_b), "rows": [2, 4]},
+                ],
+            }],
+        }],
+    }
+    store = _Store()
+    store[instance_id + SHARD_MANIFEST_SUFFIX] = _Rec(
+        json.dumps(manifest).encode()
+    )
+    store[f"{instance_id}.shard0"] = _Rec(shard_a)
+    store[f"{instance_id}.shard1"] = _Rec(shard_b)
+    return store, manifest
+
+
+class TestDeployVerify:
+    def test_verifies_clean_store(self):
+        store, manifest = _sharded_store()
+        report = verify_instance(store, "inst1", expected=manifest)
+        assert report["sharded"] and report["shards"] == 2
+        assert report["bytes"] == 160
+
+    def test_corrupt_shard_rejected(self):
+        store, manifest = _sharded_store()
+        store["inst1.shard1"] = _Rec(b"\x02" * 95 + b"\xff")
+        with pytest.raises(DeployVerifyError, match="checksum"):
+            verify_instance(store, "inst1", expected=manifest)
+
+    def test_missing_shard_rejected(self):
+        store, manifest = _sharded_store()
+        del store["inst1.shard0"]
+        with pytest.raises(DeployVerifyError, match="missing shard"):
+            verify_instance(store, "inst1")
+
+    def test_manifest_divergence_rejected(self):
+        store, manifest = _sharded_store()
+        pushed = json.loads(json.dumps(manifest))
+        pushed["algos"][0]["arrays"][0]["shards"][0]["sha256"] = "0" * 64
+        with pytest.raises(DeployVerifyError, match="disagrees"):
+            verify_instance(store, "inst1", expected=pushed)
+
+    def test_unsharded_blob_needs_record(self):
+        store = _Store()
+        with pytest.raises(DeployVerifyError, match="absent"):
+            verify_instance(store, "plain")
+        store["plain"] = _Rec(b"blob")
+        report = verify_instance(store, "plain")
+        assert report == {
+            "instanceId": "plain", "sharded": False,
+            "shards": 0, "bytes": 4,
+        }
+
+    def test_pushed_manifest_but_local_store_empty(self):
+        store, manifest = _sharded_store()
+        empty = _Store()
+        empty["inst1"] = _Rec(b"blob")
+        with pytest.raises(DeployVerifyError, match="store has none"):
+            verify_instance(empty, "inst1", expected=manifest)
+
+    def test_manifest_digests_walks_all_arrays(self):
+        _, manifest = _sharded_store()
+        digs = manifest_digests(manifest)
+        assert set(digs) == {"inst1.shard0", "inst1.shard1"}
+
+
+# ---------------------------------------------------------------------------
+# routerd HTTP surface
+
+
+class TestRouterd:
+    def _service(self, members, **kw):
+        svc = RouterService(
+            _targets(members), interval_s=5.0, **kw
+        )
+        server = JsonHTTPServer(
+            svc.router, "127.0.0.1", 0, name="test-routerd"
+        ).start()
+        return svc, server
+
+    def test_entity_of(self):
+        assert entity_of({"user": "u1"}) == "u1"
+        assert entity_of({"entityId": 7}) == "7"
+        assert entity_of({"items": [1]}) is None
+        assert entity_of("not a dict") is None
+
+    def test_readyz_gates_on_first_scrape(self, two_members):
+        svc, server = self._service(two_members)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            assert http("GET", f"{base}/readyz")[0] == 503
+            svc.agg.scrape_once()
+            assert http("GET", f"{base}/readyz")[0] == 200
+        finally:
+            server.stop()
+            svc.stop()
+
+    def test_relay_json_and_router_header(self, two_members):
+        svc, server = self._service(two_members)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, body, headers = http(
+                "POST", f"{base}/queries.json", {"user": "u1"},
+                headers={"X-Pio-Priority": "interactive"},
+            )
+            assert status == 200
+            out = json.loads(body)
+            assert out["echo"] == {"user": "u1"}
+            assert headers["X-Pio-Router-Member"] == out["member"]
+            assert out["priority"] == "interactive"
+        finally:
+            server.stop()
+            svc.stop()
+
+    def test_packed_passthrough_bytes_identical(self, two_members):
+        from pio_tpu.server.batchlane import pack_query_i8
+
+        svc, server = self._service(two_members)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            frame = pack_query_i8([1, -2, 3, 127])
+            req = urllib.request.Request(
+                f"{base}/queries.json", data=frame, method="POST"
+            )
+            req.add_header("Content-Type", PACKED_QUERY_CONTENT_TYPE)
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                echoed = resp.read()
+                member = resp.headers["X-Pio-Router-Member"]
+            assert echoed == frame
+            assert member in ("a", "b")
+        finally:
+            server.stop()
+            svc.stop()
+
+    def test_router_json_shape(self, two_members):
+        svc, server = self._service(two_members)
+        try:
+            svc.agg.scrape_once()
+            svc.core.ingest_fleet(svc.agg.fleet_payload())
+            base = f"http://127.0.0.1:{server.port}"
+            status, body, _ = http("GET", f"{base}/router.json")
+            assert status == 200
+            snap = json.loads(body)
+            assert snap["ring"]["size"] == 2
+            assert snap["scrape"]["passes"] == 1
+            assert {m["member"] for m in snap["members"]} == {"a", "b"}
+            assert all(m["routable"] for m in snap["members"])
+        finally:
+            server.stop()
+            svc.stop()
+
+    def test_chaos_kill_under_relay(self, two_members):
+        """SIGKILL-shaped: stop member 'a' mid-traffic; the router must
+        answer every request (one transparent retry), force 'a' out of
+        the ring, and keep zero non-inflight 5xx."""
+        svc, server = self._service(two_members)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            for i in range(4):
+                assert http(
+                    "POST", f"{base}/queries.json", {"user": f"u{i}"}
+                )[0] == 200
+            two_members[0].stop()
+            # an in-process stop closes the listener but not already-
+            # established keep-alives; sever the router's pooled conns
+            # like the real SIGKILL would (smoke.sh covers that end)
+            svc.core._pools["a"].close()
+            statuses = [
+                http("POST", f"{base}/queries.json", {"user": k})[0]
+                for k in KEYS[:20]
+            ]
+            assert statuses == [200] * 20
+            snap = json.loads(http("GET", f"{base}/router.json")[1])
+            assert snap["ring"]["routable"] == ["b"]
+            status, body, _ = http("GET", f"{base}/metrics")
+            text = body.decode()
+            assert 'pio_tpu_router_retried_total{member="b"}' in text
+            assert "pio_tpu_router_ring_size 1" in text
+        finally:
+            server.stop()
+            svc.stop()
+
+    def test_deploy_flips_generation_only_when_verified(
+        self, two_members, monkeypatch
+    ):
+        from pio_tpu.storage import Storage
+
+        store, manifest = _sharded_store()
+        monkeypatch.setattr(
+            Storage, "get_model_data_models", staticmethod(lambda: store)
+        )
+        two_members[0].deploy_outcome = (200, {"verified": True})
+        two_members[1].deploy_outcome = (
+            409, {"message": "deploy verification failed: checksum"}
+        )
+        svc, server = self._service(two_members)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, body, _ = http(
+                "POST", f"{base}/deploy", {"engineInstanceId": "inst1"}
+            )
+            assert status == 502  # one member failed verification
+            report = json.loads(body)
+            by_member = {r["member"]: r for r in report["members"]}
+            assert by_member["a"]["outcome"] == "verified"
+            assert by_member["b"]["outcome"] == "rejected"
+            snap = svc.core.snapshot()
+            gens = {m["member"]: m["generation"] for m in snap["members"]}
+            assert gens == {"a": "inst1", "b": None}
+            assert svc.core._deploys.value("a", "verified") == 1.0
+            assert svc.core._deploys.value("b", "rejected") == 1.0
+        finally:
+            server.stop()
+            svc.stop()
+
+    def test_deploy_requires_instance_id(self, two_members):
+        svc, server = self._service(two_members)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            assert http("POST", f"{base}/deploy", {})[0] == 400
+        finally:
+            server.stop()
+            svc.stop()
