@@ -1326,6 +1326,68 @@ def _bench_pool_serving(factors, n_users: int, n_items: int) -> dict:
                     got["router_overhead_ms"] = round(
                         rg["p50_ms"] - got["p50_ms"], 3
                     )
+                # shadow-mirroring pass (ISSUE 19): the same routed hop
+                # with a live rollout parked in shadow, mirroring 100%
+                # of queries back at the pool. The p50 delta vs the
+                # plain routed pass is the mirror's relay-path cost —
+                # the contract is fire-and-forget off the hot path, so
+                # the delta prices the member's doubled load, not a
+                # synchronous mirror hop.
+                try:
+                    import urllib.request as _ur
+
+                    with _ur.urlopen(
+                        f"http://127.0.0.1:{pool.port}/deploy.json",
+                        timeout=5,
+                    ) as r:
+                        iid = json.loads(
+                            r.read().decode("utf-8")
+                        )["engineInstanceId"]
+                    body = json.dumps({
+                        "engineInstanceId": iid,
+                        "targets": f"127.0.0.1:{pool.port}",
+                        "by": "bench", "auto": False,
+                        "shadowRate": 1.0, "shadowMinSamples": 1,
+                        "shadowHoldSeconds": 3600.0,
+                        "judgeIntervalSeconds": 1.0,
+                    }).encode("utf-8")
+                    req = _ur.Request(
+                        f"http://127.0.0.1:{rs.port}/rollout",
+                        data=body,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with _ur.urlopen(req, timeout=30):
+                        pass
+                    deadline = time.time() + 30
+                    while time.time() < deadline:
+                        with _ur.urlopen(
+                            f"http://127.0.0.1:{rs.port}/rollout.json",
+                            timeout=5,
+                        ) as r:
+                            stage = json.loads(
+                                r.read().decode("utf-8")
+                            )["stage"]
+                        if stage == "shadow":
+                            break
+                        time.sleep(0.1)
+                    sg = _concurrent_stage(rs.port, n_users)
+                    got["shadow_qps"] = sg["qps"]
+                    got["shadow_p50_ms"] = sg.get("p50_ms")
+                    if sg.get("p50_ms") is not None and \
+                            rg.get("p50_ms") is not None:
+                        got["shadow_overhead_ms"] = round(
+                            sg["p50_ms"] - rg["p50_ms"], 3
+                        )
+                    abort = _ur.Request(
+                        f"http://127.0.0.1:{rs.port}/rollout/abort",
+                        data=b"{}",
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with _ur.urlopen(abort, timeout=30):
+                        pass
+                except Exception as exc:
+                    print(f"# shadow mirroring stage failed: {exc}",
+                          file=sys.stderr)
             finally:
                 rs.service.stop()
                 rs.stop()
@@ -2427,6 +2489,7 @@ def build_summary(full: dict, full_path: str = "BENCH_FULL.json") -> dict:
         "pool_laned_qps": get("serving", "pool", "laned_qps"),
         "routed_qps": get("serving", "pool", "routed_qps"),
         "router_overhead_ms": get("serving", "pool", "router_overhead_ms"),
+        "shadow_overhead_ms": get("serving", "pool", "shadow_overhead_ms"),
         "pool_workers": get("serving", "pool", "workers"),
         "host_cores": get("serving", "pool", "host_cores"),
         "sharded_qps": get("serving", "sharded", "qps"),
@@ -2622,6 +2685,7 @@ HISTORY_FIELDS = (
     ("pool_qps", "up"),
     ("routed_qps", "up"),            # through the serving-fabric router
     ("router_overhead_ms", "down"),  # router hop p50 cost vs direct
+    ("shadow_overhead_ms", "down"),  # shadow-mirroring p50 cost vs routed
     ("evfront_qps", "up"),
     ("evfront_p50_ms", "down"),
     ("p50_predict_ms", "down"),
@@ -2676,6 +2740,7 @@ def history_record(full: dict, summary: dict,
         "pool_qps": summary.get("pool_qps"),
         "routed_qps": summary.get("routed_qps"),
         "router_overhead_ms": summary.get("router_overhead_ms"),
+        "shadow_overhead_ms": summary.get("shadow_overhead_ms"),
         "evfront_qps": summary.get("evfront_qps"),
         "evfront_p50_ms": summary.get("evfront_p50_ms"),
         "p50_predict_ms": summary.get("p50_predict_ms"),

@@ -128,6 +128,9 @@ FAILPOINT_NAMESPACES = (
     "devicewatch.",
     # serving fabric front tier (pio_tpu/router/, ISSUE 18)
     "router.",
+    # progressive-delivery rollout controller (router/rollout.py,
+    # ISSUE 19)
+    "rollout.",
 )
 
 
@@ -366,7 +369,8 @@ class SpanNameRule(Rule):
 #: a row surviving a family rename/removal would document a phantom
 _CATALOG_DRIFT_PREFIXES = ("pio_tpu_fleet_", "pio_tpu_repl_",
                            "pio_tpu_train_", "pio_tpu_device_",
-                           "pio_tpu_xla_", "pio_tpu_router_")
+                           "pio_tpu_xla_", "pio_tpu_router_",
+                           "pio_tpu_rollout_")
 
 _CATALOG_ROW_RE = re.compile(r"^\|\s*`(pio_tpu_[a-z0-9_]+)`\s*\|")
 

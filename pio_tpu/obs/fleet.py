@@ -105,6 +105,7 @@ class _Member:
         self.train: Optional[dict] = None
         self.device: Optional[dict] = None
         self.routerd: Optional[dict] = None
+        self.rollout: Optional[dict] = None
 
     def age_s(self) -> Optional[float]:
         if self.last_ok is None:
@@ -266,6 +267,10 @@ class FleetAggregator:
         train = self._get_json(m, "/train.json")
         device = self._get_json(m, "/device.json")
         routerd = self._get_json(m, "/router.json")
+        rollout = (
+            self._get_json(m, "/rollout.json")
+            if routerd is not None else None
+        )
         with self._lock:
             m.metrics = parsed
             m.last_ok = monotonic_s()
@@ -284,6 +289,8 @@ class FleetAggregator:
                 m.device = device
             if routerd is not None:
                 m.routerd = routerd
+            if rollout is not None:
+                m.rollout = rollout
         return True
 
     def _record_error(self, m: _Member, reason: str, msg: str) -> None:
@@ -462,6 +469,27 @@ class FleetAggregator:
                 "size": ring.get("size"),
                 "partitions": ring.get("partitions"),
             }
+        rollout = None
+        if m.rollout is not None and m.rollout.get("stage") != "idle":
+            # compact progressive-delivery row (full decision trail on
+            # the router's own /rollout.json): stage + judge verdict is
+            # what the fleet dashboard steers by
+            judge = m.rollout.get("judge") or {}
+            shadow = m.rollout.get("shadow") or {}
+            trail = m.rollout.get("trail") or []
+            rollout = {
+                "stage": m.rollout.get("stage"),
+                "generation": m.rollout.get("generation"),
+                "candidateInstance": m.rollout.get("candidateInstance"),
+                "incumbentInstance": m.rollout.get("incumbentInstance"),
+                "lastVerdict": judge.get("lastVerdict"),
+                "shadowSamples": shadow.get("samples"),
+                "mismatchRate": shadow.get("mismatchRate"),
+                "canaryRequests": (
+                    (m.rollout.get("canary") or {}).get("requests")
+                ),
+                "lastTransition": trail[-1] if trail else None,
+            }
         return {
             "member": m.name,
             "url": m.url,
@@ -476,6 +504,7 @@ class FleetAggregator:
             "training": training,
             "devices": devices,
             "router": fabric,
+            "rollout": rollout,
         }
 
     def _devices_rollup(self) -> dict:

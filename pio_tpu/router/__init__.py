@@ -8,20 +8,26 @@ The pieces, in request order:
   remaps only the failed member's keyspace;
 - :mod:`core` — :class:`~pio_tpu.router.core.ServingRouter`: health
   gating (scrape status + passive forced-down), SLO-aware spreading
-  (worst-burn demotion, priority-floor shedding with the QoS
-  vocabulary), keep-alive forwarding with a single ring-order retry,
-  and the ``pio_tpu_router_*`` metric families;
+  (worst-burn + device-headroom demotion, priority-floor shedding with
+  the QoS vocabulary), keep-alive forwarding with a single ring-order
+  retry (optionally hedged for interactive tails), and the
+  ``pio_tpu_router_*`` metric families;
 - :mod:`deploy` — manifest-verified instance distribution: members
   sha256-verify every shard from their own store before the router
-  flips their generation into rotation.
+  flips their generation into rotation;
+- :mod:`rollout` — progressive delivery: shadow mirroring, canary
+  keyspace diversion, SLO-burn judging, auto-promote/rollback with a
+  durable decision trail on ``/rollout.json``.
 
 The daemon wiring (HTTP front, embedded fleet scraper, ``/router.json``)
-lives in :mod:`pio_tpu.server.routerd`; ``pio route`` is the CLI verb.
+lives in :mod:`pio_tpu.server.routerd`; ``pio route`` / ``pio rollout``
+are the CLI verbs.
 """
 
 from pio_tpu.router.core import (
     BURN_LIMIT_ENV,
     DEFAULT_BURN_LIMIT,
+    HEDGE_ENV,
     MemberState,
     ServingRouter,
     Shed,
@@ -34,15 +40,28 @@ from pio_tpu.router.deploy import (
     verify_instance,
 )
 from pio_tpu.router.ring import Ring, hrw_score, slot_of
+from pio_tpu.router.rollout import (
+    STAGES,
+    RolloutConfig,
+    RolloutController,
+    RolloutMetrics,
+    diff_answers,
+)
 
 __all__ = [
     "BURN_LIMIT_ENV",
     "DEFAULT_BURN_LIMIT",
     "DeployVerifyError",
+    "HEDGE_ENV",
     "MemberState",
     "Ring",
+    "RolloutConfig",
+    "RolloutController",
+    "RolloutMetrics",
+    "STAGES",
     "ServingRouter",
     "Shed",
+    "diff_answers",
     "hrw_score",
     "load_manifest",
     "manifest_digests",

@@ -51,6 +51,7 @@ __all__ = [
     "BURN_LIMIT_ENV",
     "DEFAULT_BURN_LIMIT",
     "DEFAULT_LAG_SOFT_BYTES",
+    "HEDGE_ENV",
     "LAG_SOFT_ENV",
     "MemberState",
     "ServingRouter",
@@ -68,6 +69,12 @@ DEFAULT_BURN_LIMIT = 2.0
 #: pressure away from laggy followers, never a hard gate.
 LAG_SOFT_ENV = "PIO_TPU_ROUTER_LAG_SOFT_BYTES"
 DEFAULT_LAG_SOFT_BYTES = 64 * 1024 * 1024
+
+#: per-request hedge budget in milliseconds: after this long without a
+#: primary answer, the same query is fired at the next ring replica and
+#: the first answer wins. 0 / unset = hedging off (the default — tail
+#: hedging doubles worst-case member load, an operator opt-in).
+HEDGE_ENV = "PIO_TPU_ROUTER_HEDGE_MS"
 
 #: headers relayed member-ward: the QoS/trace vocabulary must survive
 #: the hop (priority floors honored end-to-end) but hop-by-hop framing
@@ -107,6 +114,12 @@ class MemberState:
     lag_bytes: int = 0             # worst follower replication lag
     generation: Optional[str] = None   # last verified-deployed instance
     forced_down_until: float = 0.0     # passive-failure gate (monotonic)
+    #: device-budget headroom from the member's fleet row; None until
+    #: scraped. <= 0 demotes the member before it burns SLO budget.
+    headroom_bytes: Optional[float] = None
+    #: aux members (rollout candidates) hold a pooled upstream but never
+    #: join the incumbent ring or take undiverted traffic
+    aux: bool = False
 
 
 class _UpstreamPool:
@@ -199,6 +212,7 @@ class ServingRouter:
         lag_soft_bytes: Optional[float] = None,
         timeout_s: float = 5.0,
         forced_down_s: float = 10.0,
+        hedge_ms: Optional[float] = None,
     ):
         if not targets:
             raise ValueError("router needs at least one member target")
@@ -212,8 +226,19 @@ class ServingRouter:
                 LAG_SOFT_ENV, float(DEFAULT_LAG_SOFT_BYTES), positive=True
             )
         )
+        if hedge_ms is None:
+            hedge_ms = env_float(HEDGE_ENV, 0.0)
+        self.hedge_s = max(float(hedge_ms), 0.0) / 1e3
         self.timeout_s = timeout_s
         self.forced_down_s = forced_down_s
+        #: opaque rollout hooks (see router/rollout.py). ``_observer``
+        #: sees every completed relay off the return path; ``_divert``
+        #: may put a canary member in front of the ring plan. Stored
+        #: untyped and called through locals so the relay keeps its
+        #: zero-copy/blocking contract regardless of what a controller
+        #: plugs in.
+        self._observer = None
+        self._divert = None
         self._members: Dict[str, MemberState] = {}
         self._pools: Dict[str, _UpstreamPool] = {}
         for name, base_url in targets:
@@ -255,6 +280,12 @@ class ServingRouter:
             "(verified / rejected / error)",
             ("member", "outcome"),
         )
+        self._hedged = registry.counter(
+            "pio_tpu_router_hedged_total",
+            "Relays that fired a hedge at the next replica, by outcome "
+            "(primary_won / hedge_won / error)",
+            ("outcome",),
+        )
         self._pick_seconds = registry.histogram(
             "pio_tpu_router_pick_seconds",
             "Replica ranking latency (health gate + ring rank + spread)",
@@ -274,6 +305,84 @@ class ServingRouter:
             self._forward_errors.labels(name)
             self._member_routable.set(0.0, member=name)
         self._ring_size.set(0.0)
+
+    # -- membership / rollout hooks ----------------------------------------
+    def set_observer(self, observer) -> None:
+        """Install (or clear, with None) the completed-relay hook:
+        ``observer(method, path, body, headers, entity_id, priority,
+        member, status, body_out, elapsed_s)``. Must never raise."""
+        self._observer = observer
+
+    def set_divert(self, divert) -> None:
+        """Install (or clear, with None) the canary divert hook:
+        ``divert(entity_id, priority) -> member_name | None`` consulted
+        at pick time; a returned routable member fronts the plan with
+        the normal ring order behind it (retry covers it dying)."""
+        self._divert = divert
+
+    def add_member(self, name: str, base_url: str,
+                   aux: bool = False) -> MemberState:
+        """Register a member at runtime. ``aux`` members (rollout
+        candidates) get a pooled upstream and metric cells but stay out
+        of the ring and take no traffic unless diverted."""
+        with self._lock:
+            existing = self._members.get(name)
+            if existing is not None:
+                return existing
+            parts = urlsplit(base_url)
+            host = parts.hostname or "127.0.0.1"
+            port = parts.port or (443 if parts.scheme == "https" else 80)
+            ms = MemberState(
+                name=name, base_url=base_url, host=host, port=port, aux=aux
+            )
+            self._members[name] = ms
+            self._pools[name] = _UpstreamPool(host, port, self.timeout_s)
+        self._forwarded.labels(name)
+        self._retried.labels(name)
+        self._forward_errors.labels(name)
+        if not aux:
+            self.ring = Ring(
+                [n for n, m in self._members.items() if not m.aux],
+                self.ring.partitions,
+            )
+        self._refresh_gauges()
+        return ms
+
+    def remove_member(self, name: str) -> None:
+        """Drop a member and close its keep-alive upstream sockets
+        immediately — a removed member must leave no open FDs behind."""
+        with self._lock:
+            ms = self._members.pop(name, None)
+            pool = self._pools.pop(name, None)
+        if pool is not None:
+            pool.close()
+        if ms is None:
+            return
+        self._member_routable.set(0.0, member=name)
+        if not ms.aux:
+            self.ring = Ring(
+                [n for n, m in self._members.items() if not m.aux],
+                self.ring.partitions,
+            )
+        self._refresh_gauges()
+
+    def has_member(self, name: str) -> bool:
+        return name in self._members
+
+    def member(self, name: str) -> Optional[MemberState]:
+        return self._members.get(name)
+
+    def ring_members(self) -> List[MemberState]:
+        """The non-aux members (the incumbent ring's population)."""
+        return [ms for ms in self._members.values() if not ms.aux]
+
+    def upstream_request(self, member: str, method, path, body, headers):
+        """One exchange over ``member``'s keep-alive pool (the rollout
+        mirror path; the relay itself goes through :meth:`forward`)."""
+        pool = self._pools.get(member)
+        if pool is None:
+            raise KeyError(f"unknown member {member!r}")
+        return pool.request(method, path, body, headers)
 
     # -- health/load ingestion --------------------------------------------
     def ingest_fleet(self, payload: dict) -> None:
@@ -298,15 +407,20 @@ class ServingRouter:
                 slo = entry.get("slo") or {}
                 burn = slo.get("worstBurn")
                 ms.burn = float(burn) if burn is not None else 0.0
+                dev = entry.get("devices") or {}
+                headroom = dev.get("headroomBytes")
+                ms.headroom_bytes = (
+                    float(headroom) if headroom is not None else None
+                )
                 ms.lag_bytes = lag_by_follower.get(ms.name, 0)
         self._refresh_gauges()
 
     def _refresh_gauges(self) -> None:
         now = monotonic_s()
         n = 0
-        for ms in self._members.values():
+        for ms in list(self._members.values()):
             ok = self._routable(ms, now)
-            n += 1 if ok else 0
+            n += 1 if (ok and not ms.aux) else 0
             self._member_routable.set(1.0 if ok else 0.0, member=ms.name)
         self._ring_size.set(float(n))
 
@@ -328,6 +442,11 @@ class ServingRouter:
             return
         self._forward_errors.inc(member=member)
         ms.forced_down_until = monotonic_s() + self.forced_down_s
+        # a dead member's keep-alive sockets go NOW, not when they idle
+        # out: every parked connection is an FD pointing at a corpse
+        pool = self._pools.get(member)
+        if pool is not None:
+            pool.close()
         self._refresh_gauges()
         log.warning(
             "member %s forced down for %.1fs after transport error",
@@ -344,7 +463,20 @@ class ServingRouter:
 
     # -- pick --------------------------------------------------------------
     def _load_score(self, ms: MemberState) -> float:
-        return ms.burn + ms.lag_bytes / self.lag_soft_bytes
+        score = ms.burn + ms.lag_bytes / self.lag_soft_bytes
+        if ms.headroom_bytes is not None and ms.headroom_bytes <= 0.0:
+            # exhausted HBM weighs like a full burn-limit of SLO burn:
+            # the member demotes before it starts failing for real
+            score += self.burn_limit
+        return score
+
+    def _pressured(self, ms: MemberState) -> bool:
+        """Demotion gate: SLO burn at/over the limit, or device budget
+        headroom exhausted (the member would start thrashing/rejecting
+        before the burn shows up in its scrape)."""
+        if ms.burn >= self.burn_limit:
+            return True
+        return ms.headroom_bytes is not None and ms.headroom_bytes <= 0.0
 
     def _spread_order(self, routable: List[str]) -> List[str]:
         with self._lock:
@@ -365,7 +497,7 @@ class ServingRouter:
         failpoint("router.pick")
         routable = [
             name for name, ms in self._members.items()
-            if self._routable(ms, t0)
+            if not ms.aux and self._routable(ms, t0)
         ]
         if not routable:
             self._shed.inc(reason="no_members")
@@ -375,20 +507,32 @@ class ServingRouter:
         else:
             order = self._spread_order(routable)
         calm = [
-            m for m in order if self._members[m].burn < self.burn_limit
+            m for m in order if not self._pressured(self._members[m])
         ]
         if calm:
             if len(calm) != len(order):
-                # demote burning replicas behind calm ones, both halves
-                # keeping ring order (affinity still wins among calm)
+                # demote pressured replicas (burning, or out of device
+                # headroom) behind calm ones, both halves keeping ring
+                # order (affinity still wins among calm)
                 order = calm + [m for m in order if m not in calm]
         else:
             if priority_floor(priority) > 0.0:
-                # every replica is burning: non-interactive classes are
-                # the error budget's relief valve, exactly as on-member
+                # every replica is pressured: non-interactive classes
+                # are the error budget's relief valve, exactly as
+                # on-member
                 self._shed.inc(reason="slo_burn")
                 raise Shed(503, "slo_burn", self.forced_down_s)
-            order = sorted(order, key=lambda m: self._members[m].burn)
+            order = sorted(order, key=lambda m: self._load_score(
+                self._members[m]))
+        divert = self._divert
+        if divert is not None:
+            cand = divert(entity_id, priority)
+            if cand is not None and cand not in order:
+                cms = self._members.get(cand)
+                if cms is not None and self._routable(cms, t0):
+                    # canary front: the candidate takes the request,
+                    # the incumbent plan stays behind it as the retry
+                    order = [cand] + order
         self._pick_seconds.observe(monotonic_s() - t0)
         return [self._members[m] for m in order]
 
@@ -397,12 +541,20 @@ class ServingRouter:
                 entity_id=None, priority=""):  # pio: hotpath=zerocopy
         """Relay one request, retrying once on the next replica after a
         transport error.  ``body`` goes through untouched — on the
-        packed int8 wire that is the zero-copy contract end to end."""
+        packed int8 wire that is the zero-copy contract end to end.
+        With ``PIO_TPU_ROUTER_HEDGE_MS`` set, interactive requests that
+        outlive the hedge budget race the next replica instead."""
         plan = self.pick(entity_id, priority)
         hdrs = forward_headers(headers)
+        if (self.hedge_s > 0.0 and len(plan) >= 2
+                and priority_floor(priority) == 0.0):
+            return self._forward_hedged(
+                method, path, body, hdrs, plan, entity_id, priority
+            )
         last_exc = None
         for attempt, ms in enumerate(plan[:2]):
             failpoint("router.forward")
+            t0 = monotonic_s()
             try:
                 status, reply, out = self._pools[ms.name].request(
                     method, path, body, hdrs
@@ -414,10 +566,94 @@ class ServingRouter:
             self._forwarded.inc(member=ms.name)
             if attempt:
                 self._retried.inc(member=ms.name)
+            self._observe_relay(method, path, body, hdrs, entity_id,
+                                priority, ms.name, status, out,
+                                monotonic_s() - t0)
             return status, reply, out, ms.name
         self._shed.inc(reason="upstream_unreachable")
         raise Shed(503, "upstream_unreachable", self.forced_down_s) \
             from last_exc
+
+    def _forward_hedged(self, method, path, body, hdrs, plan,
+                        entity_id, priority):
+        """Tail-latency hedge: the primary gets ``hedge_s`` to answer;
+        then (or immediately on a primary transport error) the same
+        request fires at the next replica and the first answer wins —
+        the loser finishes in the background against its own pool."""
+        cond = threading.Condition()
+        results: List[Tuple[MemberState, Tuple]] = []
+        errors: List[MemberState] = []
+
+        def attempt(ms):
+            try:
+                got = self._pools[ms.name].request(method, path, body, hdrs)
+            except Exception:
+                self.note_failure(ms.name)
+                with cond:
+                    errors.append(ms)
+                    cond.notify_all()
+                return
+            with cond:
+                results.append((ms, got))
+                cond.notify_all()
+
+        primary, backup = plan[0], plan[1]
+        t0 = monotonic_s()
+        threading.Thread(
+            target=attempt, args=(primary,), daemon=True
+        ).start()
+        with cond:
+            # the hedge budget itself — an intentional bounded wait,
+            # the whole point of the opt-in knob
+            # pio: disable=hotpath-blocking
+            cond.wait_for(lambda: results or errors,
+                          timeout=self.hedge_s)
+            need_hedge = not results
+        if not need_hedge:
+            ms, (status, reply, out) = results[0]
+            self._forwarded.inc(member=ms.name)
+            self._observe_relay(method, path, body, hdrs, entity_id,
+                                priority, ms.name, status, out,
+                                monotonic_s() - t0)
+            return status, reply, out, ms.name
+        failpoint("router.forward.hedge")
+        threading.Thread(
+            target=attempt, args=(backup,), daemon=True
+        ).start()
+        deadline = monotonic_s() + self.timeout_s + 1.0
+        with cond:
+            while not results and len(errors) < 2:
+                remaining = deadline - monotonic_s()
+                if remaining <= 0.0:
+                    break
+                # racing two in-flight upstreams; bounded by the pool
+                # timeout either way
+                cond.wait(remaining)  # pio: disable=hotpath-blocking
+            got = list(results)
+        if not got:
+            self._hedged.inc(outcome="error")
+            self._shed.inc(reason="upstream_unreachable")
+            raise Shed(503, "upstream_unreachable", self.forced_down_s)
+        ms, (status, reply, out) = got[0]
+        won = "primary_won" if ms.name == primary.name else "hedge_won"
+        self._hedged.inc(outcome=won)
+        self._forwarded.inc(member=ms.name)
+        if won == "hedge_won":
+            self._retried.inc(member=ms.name)
+        self._observe_relay(method, path, body, hdrs, entity_id, priority,
+                            ms.name, status, out, monotonic_s() - t0)
+        return status, reply, out, ms.name
+
+    def _observe_relay(self, method, path, body, hdrs, entity_id,
+                       priority, member, status, out, elapsed_s) -> None:
+        observer = self._observer
+        if observer is None:
+            return
+        try:
+            observer(method, path, body, hdrs, entity_id, priority,
+                     member, status, out, elapsed_s)
+        except Exception:
+            pass
 
     # -- introspection -----------------------------------------------------
     def snapshot(self) -> dict:
@@ -425,20 +661,24 @@ class ServingRouter:
         docs/observability.md)."""
         now = monotonic_s()
         members = []
-        for ms in self._members.values():
+        for ms in list(self._members.values()):
             members.append({
                 "member": ms.name,
                 "url": ms.base_url,
                 "status": ms.status,
                 "routable": self._routable(ms, now),
+                "aux": ms.aux,
                 "worstBurn": round(ms.burn, 4),
                 "lagBytes": ms.lag_bytes,
+                "headroomBytes": ms.headroom_bytes,
                 "generation": ms.generation,
                 "forwarded": int(self._forwarded.value(ms.name)),
                 "retried": int(self._retried.value(ms.name)),
                 "errors": int(self._forward_errors.value(ms.name)),
             })
-        routable = [m["member"] for m in members if m["routable"]]
+        routable = [
+            m["member"] for m in members if m["routable"] and not m["aux"]
+        ]
         return {
             "ring": {
                 "members": list(self.ring.members),
@@ -450,6 +690,7 @@ class ServingRouter:
                 "burnLimit": self.burn_limit,
                 "lagSoftBytes": self.lag_soft_bytes,
                 "forcedDownSeconds": self.forced_down_s,
+                "hedgeMs": round(self.hedge_s * 1e3, 3),
             },
             "members": members,
         }

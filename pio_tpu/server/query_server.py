@@ -781,6 +781,10 @@ class QueryServerService:
         self._pool_shutdown = None
         self._sidecar_ports = None
         self._seen_gen = 0
+        #: monotone hot-swap counter, bumped on every successful _load
+        #: (deploy/reload/undeploy-reload) — the rollout controller's
+        #: GET /deploy.json witness that a generation actually flipped
+        self._swap_generation = 0
         #: set via attach_server(); when present, /undeploy also stops the
         #: HTTP server shortly after responding (reference parity: `pio
         #: undeploy` terminates the server process, not just the flag)
@@ -814,6 +818,7 @@ class QueryServerService:
         r.add("GET", "/readyz", self.readyz)
         r.add("POST", "/reload", self.reload)
         r.add("POST", "/deploy\\.json", self.deploy_verified)
+        r.add("GET", "/deploy\\.json", self.deploy_report)
         r.add("POST", "/undeploy", self.undeploy)
         r.add("GET", "/plugins\\.json", self.list_plugins)
 
@@ -856,6 +861,7 @@ class QueryServerService:
             self._sharding_info = sharding_info
             self.engine, self.engine_params = engine, engine_params
             self.instance_id = instance_id
+            self._swap_generation += 1
             self.pairs, self.serving = pairs, serving
             self.query_class = query_class
             if self._buckets.warmed:
@@ -2279,6 +2285,33 @@ class QueryServerService:
         report["engineInstanceId"] = self.instance_id
         report["verified"] = True
         return 200, report
+
+    def deploy_report(self, req: Request):
+        """Generation report (GET /deploy.json): the instance this
+        member currently serves, its manifest sha256 set, and the
+        monotone swap generation — the rollout controller's incumbent
+        discovery and byte-identity witness (a rollback must leave the
+        sha set exactly where a rollout found it)."""
+        from pio_tpu.router.deploy import load_manifest, manifest_digests
+
+        shas = []
+        try:
+            manifest = load_manifest(
+                Storage.get_model_data_models(), self.instance_id
+            )
+            if manifest is not None:
+                shas = sorted(
+                    sha for sha, _size
+                    in manifest_digests(manifest).values()
+                )
+        except Exception:
+            pass  # unsharded blob / store hiccup: report without shas
+        return 200, {
+            "engineInstanceId": self.instance_id,
+            "engineId": self.variant.engine_id,
+            "manifestSha256": shas,
+            "generation": self._swap_generation,
+        }
 
     def undeploy(self, req: Request):
         self._check_admin(req)

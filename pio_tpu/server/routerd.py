@@ -23,6 +23,10 @@ Routes:
 - ``POST /deploy`` — admin (bearer key or loopback): manifest-verified
   rollout of one instance to every member (see
   :mod:`pio_tpu.router.deploy`);
+- ``POST /rollout`` / ``POST /rollout/abort`` / ``GET /rollout.json`` —
+  progressive delivery (see :mod:`pio_tpu.router.rollout`): start a
+  shadow->canary->promote rollout of a candidate instance, abort it,
+  or read the live stage + decision trail;
 - ``GET /fleet.json`` — the embedded aggregator's federated payload;
 - ``GET /metrics`` / ``/healthz`` / ``/readyz`` — ready once one full
   scrape pass has completed (never steer by an empty snapshot).
@@ -40,6 +44,11 @@ from pio_tpu.qos.gate import retry_after_header
 from pio_tpu.qos.policy import PRIORITY_HEADER
 from pio_tpu.router.core import ServingRouter, Shed
 from pio_tpu.router.deploy import load_manifest, push_deploy
+from pio_tpu.router.rollout import (
+    RolloutConfig,
+    RolloutController,
+    RolloutMetrics,
+)
 from pio_tpu.server.http import (
     HTTPError,
     JsonHTTPServer,
@@ -117,6 +126,9 @@ class RouterService:
         self._stop = threading.Event()
         self._ingest_thread: Optional[threading.Thread] = None
         self._seen_passes = 0
+        self.rollout_metrics = RolloutMetrics(self.obs)
+        self.rollout: Optional[RolloutController] = None
+        self._rollout_count = 0
         self.health = HealthMonitor()
         self.health.add_readiness("first_scrape", self._check_first_scrape)
         self.router = Router()
@@ -125,6 +137,10 @@ class RouterService:
         self.router.add("GET", "/router\\.json", self.router_json)
         self.router.add("GET", "/fleet\\.json", self.fleet_json)
         self.router.add("POST", "/deploy", self.deploy)
+        self.router.add("POST", "/rollout", self.start_rollout)
+        self.router.add("POST", "/rollout/abort", self.abort_rollout)
+        self.router.add("POST", "/rollout/approve", self.approve_rollout)
+        self.router.add("GET", "/rollout\\.json", self.rollout_json)
         self.router.add("GET", "/metrics", self.get_metrics)
         self.router.add("GET", "/healthz", self.healthz)
         self.router.add("GET", "/readyz", self.readyz)
@@ -147,6 +163,9 @@ class RouterService:
         t, self._ingest_thread = self._ingest_thread, None
         if t is not None:
             t.join(timeout=2.0)
+        ro = self.rollout
+        if ro is not None:
+            ro.stop()
         self.agg.stop()
         self.core.close()
 
@@ -229,16 +248,16 @@ class RouterService:
             ) from e
         results = []
         verified = 0
-        for ms in self.core.snapshot()["members"]:
+        for ms in self.core.ring_members():
             outcome, detail = push_deploy(
-                ms["url"], instance_id, manifest,
+                ms.base_url, instance_id, manifest,
                 timeout_s=max(self.core.timeout_s, 60.0),
                 admin_key=self.admin_key,
             )
-            self.core.note_deploy(ms["member"], instance_id, outcome)
+            self.core.note_deploy(ms.name, instance_id, outcome)
             verified += 1 if outcome == "verified" else 0
             results.append({
-                "member": ms["member"],
+                "member": ms.name,
                 "outcome": outcome,
                 "detail": detail,
             })
@@ -250,13 +269,106 @@ class RouterService:
             "members": results,
         }
 
+    def start_rollout(self, req: Request) -> Tuple[int, Any]:
+        """Kick off a progressive rollout of one candidate instance.
+
+        Body: ``{engineInstanceId, targets: "host:port,...", ...knobs}``
+        (knob names match the ``config`` block of ``/rollout.json``).
+        409 while another rollout is still live — one candidate at a
+        time is the whole point of a judged rollout."""
+        self._check_admin(req)
+        body = req.body if isinstance(req.body, dict) else {}
+        instance_id = body.get("engineInstanceId")
+        if not instance_id:
+            raise HTTPError(400, "engineInstanceId is required")
+        ro = self.rollout
+        if ro is not None and ro.active():
+            raise HTTPError(
+                409,
+                f"rollout of {ro.cfg.candidate_instance!r} is still "
+                f"{ro.stage}; abort it first (POST /rollout/abort)",
+            )
+        from pio_tpu.obs.fleet import parse_targets
+
+        targets = parse_targets(body.get("targets") or "")
+        cfg = RolloutConfig(
+            candidate_instance=str(instance_id),
+            candidate_targets=targets,
+            incumbent_instance=body.get("incumbentInstance"),
+        )
+        for key, attr, cast in (
+            ("shadowRate", "shadow_rate", float),
+            ("shadowMinSamples", "shadow_min_samples", int),
+            ("shadowHoldSeconds", "shadow_hold_s", float),
+            ("mismatchLimit", "mismatch_limit", float),
+            ("scoreTolerance", "score_tolerance", float),
+            ("latencyLimitX", "latency_limit_x", float),
+            ("canaryFraction", "canary_fraction", float),
+            ("canaryHoldSeconds", "canary_hold_s", float),
+            ("canaryMinRequests", "canary_min_requests", int),
+            ("judgeIntervalSeconds", "judge_interval_s", float),
+            ("judgeFastSeconds", "judge_fast_s", float),
+            ("judgeSlowSeconds", "judge_slow_s", float),
+            ("burnLimit", "burn_limit", float),
+            ("availabilityObjective", "availability_objective", float),
+            ("downAfterFailures", "down_after_failures", int),
+            ("auto", "auto", bool),
+        ):
+            if body.get(key) is not None:
+                setattr(cfg, attr, cast(body[key]))
+        try:
+            cfg.validate()
+        except ValueError as e:
+            raise HTTPError(400, str(e)) from e
+        self._rollout_count += 1
+        controller = RolloutController(
+            self.core, cfg, self.rollout_metrics,
+            fetch=self._rollout_fetch,
+            admin_key=self.admin_key,
+            generation=self._rollout_count,
+            started_by=body.get("by") or "operator",
+        )
+        self.rollout = controller
+        controller.start()
+        return 202, {"rollout": controller.payload()}
+
+    def abort_rollout(self, req: Request) -> Tuple[int, Any]:
+        self._check_admin(req)
+        ro = self.rollout
+        if ro is None:
+            raise HTTPError(404, "no rollout has been started")
+        ro.abort(by=str(req.client_addr or "operator"))
+        return 200, {"rollout": ro.payload()}
+
+    def approve_rollout(self, req: Request) -> Tuple[int, Any]:
+        """Release a non-auto rollout's current hold gate."""
+        self._check_admin(req)
+        ro = self.rollout
+        if ro is None:
+            raise HTTPError(404, "no rollout has been started")
+        ro.approve()
+        return 200, {"rollout": ro.payload()}
+
+    def rollout_json(self, req: Request) -> Tuple[int, Any]:
+        ro = self.rollout
+        if ro is None:
+            return 200, {"stage": "idle", "generation": 0, "trail": []}
+        return 200, ro.payload()
+
+    @property
+    def _rollout_fetch(self):
+        # the aggregator's injectable fetch doubles as the controller's
+        # (so socketless tests fake both planes with one callable)
+        return self.agg._fetch
+
     def index(self, req: Request) -> Tuple[int, Any]:
         return 200, {
             "service": "pio-tpu-routerd",
             "members": [m.name for m in self.agg.members()],
             "endpoints": [
                 "/queries.json", "/router.json", "/fleet.json",
-                "/deploy", "/metrics", "/healthz", "/readyz",
+                "/deploy", "/rollout", "/rollout.json", "/metrics",
+                "/healthz", "/readyz",
             ],
         }
 

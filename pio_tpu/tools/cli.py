@@ -505,6 +505,94 @@ def cmd_route(args) -> int:
     return 0
 
 
+def cmd_rollout(args) -> int:
+    """Drive the progressive-delivery controller on a running routerd
+    (ISSUE 19): start a shadow->canary->promote rollout, abort one, or
+    print the live stage + decision trail."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    headers = {"Content-Type": "application/json; charset=utf-8"}
+    if args.admin_key:
+        headers["Authorization"] = f"Bearer {args.admin_key}"
+
+    def call(method: str, path: str, body: Optional[dict] = None):
+        data = _json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            base + path, data=data, headers=headers, method=method
+        )
+        with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+            return _json.loads(resp.read().decode("utf-8"))
+
+    try:
+        if args.start:
+            body = {
+                "engineInstanceId": args.start,
+                "targets": args.targets or "",
+                "by": "pio rollout",
+            }
+            for key, val in (
+                ("shadowRate", args.shadow_rate),
+                ("shadowMinSamples", args.shadow_min_samples),
+                ("shadowHoldSeconds", args.shadow_hold),
+                ("canaryFraction", args.canary_fraction),
+                ("canaryHoldSeconds", args.canary_hold),
+                ("canaryMinRequests", args.canary_min_requests),
+                ("judgeIntervalSeconds", args.judge_interval),
+                ("judgeFastSeconds", args.judge_fast),
+                ("judgeSlowSeconds", args.judge_slow),
+                ("burnLimit", args.burn_limit),
+                ("mismatchLimit", args.mismatch_limit),
+                ("incumbentInstance", args.incumbent),
+            ):
+                if val is not None:
+                    body[key] = val
+            got = call("POST", "/rollout", body)
+            ro = got.get("rollout") or {}
+            _out(
+                f"rollout #{ro.get('generation')} of {args.start} "
+                f"started: stage {ro.get('stage')}"
+            )
+            return 0
+        if args.abort:
+            got = call("POST", "/rollout/abort", {})
+            ro = got.get("rollout") or {}
+            _out(f"rollout aborted: stage {ro.get('stage')}")
+            return 0
+        ro = call("GET", "/rollout.json")
+    except urllib.error.HTTPError as e:
+        _err(f"rollout request failed: HTTP {e.code}: "
+             f"{e.read().decode('utf-8', 'replace')[:500]}")
+        return 1
+    except Exception as e:
+        _err(f"cannot reach router at {base}: {e}")
+        return 1
+
+    _out(f"stage: {ro.get('stage')}")
+    if ro.get("stage") == "idle":
+        return 0
+    _out(f"candidate: {ro.get('candidateInstance')}  "
+         f"incumbent: {ro.get('incumbentInstance')}")
+    shadow = ro.get("shadow") or {}
+    _out(f"shadow: {shadow.get('samples', 0)} samples, "
+         f"mismatch rate {shadow.get('mismatchRate', 0.0)}, "
+         f"{shadow.get('dropped', 0)} dropped")
+    canary = ro.get("canary") or {}
+    _out(f"canary: {canary.get('requests', 0)} requests at fraction "
+         f"{canary.get('fraction')}")
+    judge = ro.get("judge") or {}
+    _out(f"judge: {judge.get('ticks', 0)} ticks, last verdict "
+         f"{judge.get('lastVerdict')}, burn {judge.get('burnRates')}")
+    for entry in ro.get("trail") or []:
+        window = f" [{entry['window']}]" if entry.get("window") else ""
+        detail = f" — {entry['detail']}" if entry.get("detail") else ""
+        _out(f"  {entry.get('from')} -> {entry.get('to')}: "
+             f"{entry.get('signal')}{window}{detail}")
+    return 0
+
+
 def cmd_adminserver(args) -> int:
     from pio_tpu.server import create_admin_server
 
@@ -1303,6 +1391,68 @@ def build_parser() -> argparse.ArgumentParser:
         help="router base URL for --deploy (default localhost:8500)",
     )
     a.set_defaults(fn=cmd_route)
+
+    a = sub.add_parser(
+        "rollout",
+        help="progressive delivery: shadow/canary a candidate instance "
+             "through a running router",
+    )
+    a.add_argument(
+        "--url", default="http://127.0.0.1:8500", metavar="URL",
+        help="router base URL (default localhost:8500)",
+    )
+    a.add_argument(
+        "--start", default=None, metavar="INSTANCE_ID",
+        help="start a rollout of this candidate engine instance",
+    )
+    a.add_argument(
+        "--abort", action="store_true",
+        help="abort the live rollout (immediate incumbent rollback)",
+    )
+    a.add_argument(
+        "--targets", default=None, metavar="HOST:PORT,...",
+        help="candidate serving members for --start",
+    )
+    a.add_argument(
+        "--incumbent", default=None, metavar="INSTANCE_ID",
+        help="pin the incumbent instance (default: discovered from the "
+             "ring members' GET /deploy.json)",
+    )
+    a.add_argument("--shadow-rate", type=float, default=None,
+                   metavar="FRACTION",
+                   help="fraction of live traffic mirrored (default 0.25)")
+    a.add_argument("--shadow-min-samples", type=int, default=None,
+                   metavar="N")
+    a.add_argument("--shadow-hold", type=float, default=None,
+                   metavar="SECONDS")
+    a.add_argument("--canary-fraction", type=float, default=None,
+                   metavar="FRACTION",
+                   help="keyspace fraction served by the candidate "
+                        "during canary (default 0.1)")
+    a.add_argument("--canary-hold", type=float, default=None,
+                   metavar="SECONDS")
+    a.add_argument("--canary-min-requests", type=int, default=None,
+                   metavar="N")
+    a.add_argument("--judge-interval", type=float, default=None,
+                   metavar="SECONDS")
+    a.add_argument("--judge-fast", type=float, default=None,
+                   metavar="SECONDS",
+                   help="fast burn window (default 30s)")
+    a.add_argument("--judge-slow", type=float, default=None,
+                   metavar="SECONDS",
+                   help="slow burn window (default 120s)")
+    a.add_argument("--burn-limit", type=float, default=None,
+                   metavar="RATE")
+    a.add_argument("--mismatch-limit", type=float, default=None,
+                   metavar="FRACTION")
+    a.add_argument(
+        "--admin-key", default=None,
+        help="bearer key when the router requires one",
+    )
+    a.add_argument(
+        "--timeout", type=float, default=10.0, metavar="SECONDS",
+    )
+    a.set_defaults(fn=cmd_rollout)
 
     a = sub.add_parser("adminserver", help="run the admin REST API")
     a.add_argument("--ip", default="0.0.0.0")

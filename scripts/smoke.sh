@@ -2283,4 +2283,374 @@ PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" python "$ROUTER_STAGE" "$WORKDIR" \
     || fail "serving fabric router stage (failover/ring/metrics assertions)"
 echo "ok   serving fabric: member SIGKILLed mid-load, zero failed requests, ring remapped to the survivor"
 
+# ------------------------------------------------ progressive rollout
+# ISSUE 19: the rollout failpoints must be dump-visible, then the
+# progressive-delivery chaos drill — a clean candidate must walk
+# shadow -> canary -> promoted on its own (ring generation flipping
+# exactly once per member, only on a verified 200, shadow mirroring
+# adding no measurable incumbent p50), and a candidate SIGKILLed
+# mid-canary must be auto-rolled-back by the judge with the incumbent
+# restored byte-identically and zero interactive 5xx throughout.
+python -m pio_tpu.tools.cli lint --dump-failpoints pio_tpu | python -c '
+import json, sys
+inv = {f["point"] for f in json.load(sys.stdin)["failpoints"]}
+need = {"rollout.mirror", "rollout.judge", "rollout.promote",
+        "rollout.rollback"}
+missing = need - inv
+assert not missing, f"rollout failpoints missing from inventory: {missing}"
+' || fail "rollout.* failpoints missing from --dump-failpoints"
+echo "ok   rollout failpoints in lint inventory"
+
+ROLLOUT_STAGE="$WORKDIR/rollout_stage.py"
+cat > "$ROLLOUT_STAGE" <<'PY'
+"""Smoke stage: progressive delivery — auto-promote and auto-rollback.
+
+Trains one incumbent and two candidate instances of the tiny
+recommendation engine into shared sqlite (fixed training seed, so a
+clean candidate answers byte-identically to the incumbent), boots two
+incumbent members plus two candidate members as real query-server
+subprocesses, fronts the incumbents with an in-process routerd, and
+drives steady threaded load the whole time.  Drill one: POST /rollout
+with a clean candidate and let the controller walk shadow -> canary ->
+promoted unattended; the member generation must flip exactly once per
+member and only on a verified 200, and the shadow window's client p50
+must sit inside the pre-rollout noise floor (mirroring is off the
+relay path).  Drill two: start a second rollout, SIGKILL the candidate
+mid-canary; the judge must see the scrape go dark, auto-rollback,
+leave the incumbent members untouched (same instance, same generation,
+same manifest sha set), and no client request may fail in either
+drill.
+"""
+import datetime as dt
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+WORKDIR = sys.argv[1]
+
+os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "SQ"
+os.environ["PIO_STORAGE_SOURCES_SQ_TYPE"] = "sqlite"
+os.environ["PIO_STORAGE_SOURCES_SQ_PATH"] = os.path.join(
+    WORKDIR, "rollout.db")
+os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "SQ"
+os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "SQ"
+
+import pio_tpu.templates  # noqa: F401  (registers the factory)
+from pio_tpu.controller import ComputeContext
+from pio_tpu.data import Event
+from pio_tpu.storage import App, Storage
+from pio_tpu.workflow import build_engine, run_train, variant_from_dict
+
+VARIANT = {
+    "id": "smoke-rollout-rec",
+    "engineFactory": "templates.recommendation",
+    "datasource": {"params": {"app_name": "smoke-rollout"}},
+    "algorithms": [{"name": "als", "params": {
+        "rank": 4, "num_iterations": 4, "lambda_": 0.1}}],
+}
+
+app_id = Storage.get_meta_data_apps().insert(App(0, "smoke-rollout"))
+le = Storage.get_levents()
+t0 = dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+for u in range(8):
+    for i in range(6):
+        in_block = (u < 4) == (i < 3)
+        le.insert(
+            Event("rate", "user", f"u{u}", "item", f"i{i}",
+                  properties={"rating": 5.0 if in_block else 1.0},
+                  event_time=t0),
+            app_id,
+        )
+variant = variant_from_dict(VARIANT)
+iids = []
+for _ in range(3):
+    engine, ep = build_engine(variant)
+    iids.append(run_train(engine, ep, variant, ctx=ComputeContext.local()))
+INC, CAND1, CAND2 = iids
+
+variant_file = os.path.join(WORKDIR, "rollout-variant.json")
+with open(variant_file, "w") as f:
+    json.dump(VARIANT, f)
+
+MEMBER_SRC = r'''
+import json, os, signal, sys
+from pio_tpu.server import create_query_server
+from pio_tpu.workflow import variant_from_dict
+
+with open(sys.argv[1]) as f:
+    variant = variant_from_dict(json.load(f))
+server, _service = create_query_server(
+    variant, host="127.0.0.1", port=0, instance_id=sys.argv[3])
+server.start()
+with open(sys.argv[2] + ".tmp", "w") as f:
+    f.write(str(server.port))
+os.rename(sys.argv[2] + ".tmp", sys.argv[2])  # atomic publish
+signal.sigwait({signal.SIGTERM, signal.SIGINT})
+server.stop()
+'''
+
+# m1/m2 are the incumbent ring; c1/c2 boot on the incumbent instance
+# and only ever serve a candidate through the verified deploy path
+names = ("m1", "m2", "c1", "c2")
+port_files = {n: os.path.join(WORKDIR, f"rollout-{n}-port") for n in names}
+procs = {
+    n: subprocess.Popen(
+        [sys.executable, "-c", MEMBER_SRC, variant_file, port_files[n], INC],
+        env=dict(os.environ))
+    for n in names
+}
+router_server = None
+stop_load = threading.Event()
+
+
+def _cleanup():
+    stop_load.set()
+    for p in procs.values():
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    if router_server is not None:
+        router_server.service.stop()
+        router_server.stop()
+
+
+def _wait_ready(base, deadline):
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(base + "/readyz", timeout=5) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise SystemExit(f"{base} never became ready")
+
+
+try:
+    deadline = time.time() + 180
+    ports = {}
+    for n in names:
+        pf, p = port_files[n], procs[n]
+        while not os.path.exists(pf):
+            if p.poll() is not None:
+                raise SystemExit(f"member {n} died during boot")
+            if time.time() > deadline:
+                raise SystemExit(f"member {n} never published its port")
+            time.sleep(0.2)
+        with open(pf) as f:
+            ports[n] = int(f.read().strip())
+    for n in names:
+        _wait_ready(f"http://127.0.0.1:{ports[n]}", deadline)
+
+    from pio_tpu.server.routerd import create_router_server
+
+    targets = [(n, f"http://127.0.0.1:{ports[n]}") for n in ("m1", "m2")]
+    router_server = create_router_server(
+        targets, host="127.0.0.1", port=0, partitions=2, interval_s=0.3,
+    ).start()
+    router_server.service.start()
+    rbase = f"http://127.0.0.1:{router_server.port}"
+    _wait_ready(rbase, time.time() + 30)
+
+    records = []  # (done_at, elapsed_s, status)
+    lock = threading.Lock()
+
+    def load(t):
+        i = 0
+        while not stop_load.is_set():
+            i += 1
+            body = json.dumps(
+                {"user": f"u{(t * 31 + i) % 8}", "num": 3}
+            ).encode("utf-8")
+            req = urllib.request.Request(
+                rbase + "/queries.json", data=body,
+                headers={"Content-Type": "application/json"})
+            t1 = time.time()
+            try:
+                with urllib.request.urlopen(req, timeout=20) as r:
+                    ok = r.status == 200 and b"itemScores" in r.read()
+                    code = r.status if ok else -1
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except Exception as e:
+                code = f"{type(e).__name__}"
+            with lock:
+                records.append((time.time(), time.time() - t1, code))
+
+    threads = [
+        threading.Thread(target=load, args=(t,), daemon=True)
+        for t in range(3)
+    ]
+    for t in threads:
+        t.start()
+
+    def rollout_json():
+        with urllib.request.urlopen(rbase + "/rollout.json", timeout=5) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def deploy_report(name):
+        url = f"http://127.0.0.1:{ports[name]}/deploy.json"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def post_rollout(payload):
+        req = urllib.request.Request(
+            rbase + "/rollout", data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 202, f"POST /rollout answered {r.status}"
+
+    def wait_stage(want, timeout_s):
+        deadline = time.time() + timeout_s
+        snap = None
+        while time.time() < deadline:
+            snap = rollout_json()
+            if snap["stage"] == want:
+                return snap
+            if snap["stage"] in ("failed", "rolled_back") \
+                    and want not in ("failed", "rolled_back"):
+                raise SystemExit(
+                    f"rollout hit {snap['stage']} while waiting for "
+                    f"{want}: {snap['trail']}")
+            time.sleep(0.1)
+        raise SystemExit(
+            f"rollout never reached {want} "
+            f"(stuck at {snap and snap['stage']}): {snap and snap['trail']}")
+
+    def p50(rows):
+        xs = sorted(rows)
+        return xs[len(xs) // 2]
+
+    # warm-up / baseline window: steady traffic with no rollout running
+    deadline = time.time() + 60
+    while True:
+        with lock:
+            n = len(records)
+        if n >= 30:
+            break
+        if time.time() > deadline:
+            raise SystemExit(f"only {n} routed requests in 60s")
+        time.sleep(0.05)
+
+    gen_before = {n: deploy_report(n) for n in ("m1", "m2", "c1")}
+    for n, rep in gen_before.items():
+        assert rep["engineInstanceId"] == INC, (n, rep)
+
+    # ---- drill one: a clean candidate must auto-promote ----------------
+    rollout_started = time.time()
+    post_rollout({
+        "engineInstanceId": CAND1,
+        "targets": f"127.0.0.1:{ports['c1']}",
+        "by": "smoke",
+        "shadowRate": 1.0, "shadowMinSamples": 8, "shadowHoldSeconds": 1.5,
+        "mismatchLimit": 0.2, "scoreTolerance": 0.25,
+        "canaryFraction": 0.5, "canaryHoldSeconds": 0.5,
+        "canaryMinRequests": 5, "judgeIntervalSeconds": 0.25,
+    })
+    snap = wait_stage("promoted", 150)
+    signals = [e["signal"] for e in snap["trail"]]
+    assert signals == ["start", "candidate_verified", "shadow_clean",
+                       "canary_clean", "all_verified"], snap["trail"]
+    assert snap["stageCode"] == 5, snap["stageCode"]
+    assert snap["incumbentInstance"] == INC, snap["incumbentInstance"]
+    assert snap["shadow"]["samples"] >= 8, snap["shadow"]
+    assert snap["shadow"]["mismatches"] == 0, snap["shadow"]
+    assert snap["canary"]["requests"] >= 5, snap["canary"]
+    assert snap["judge"]["ticks"] >= 1, snap["judge"]
+
+    # generation flipped exactly once per member, only on a verified 200
+    for n in ("m1", "m2", "c1"):
+        rep = deploy_report(n)
+        assert rep["engineInstanceId"] == CAND1, (n, rep)
+        assert rep["generation"] == gen_before[n]["generation"] + 1, (
+            n, gen_before[n]["generation"], rep["generation"])
+
+    # shadow mirroring must not move the incumbent's client p50: compare
+    # the shadow-stage window against the pre-rollout baseline (generous
+    # noise floor — the mirror thread is off the relay path entirely)
+    by_stage = {e["to"]: e["at"] for e in snap["trail"]}
+    with lock:
+        done = list(records)
+    base_rows = [el for at, el, c in done
+                 if c == 200 and at < rollout_started]
+    shadow_rows = [el for at, el, c in done
+                   if c == 200 and by_stage["shadow"] <= at
+                   < by_stage["canary"]]
+    assert len(base_rows) >= 10 and len(shadow_rows) >= 5, (
+        len(base_rows), len(shadow_rows))
+    base_p50, shadow_p50 = p50(base_rows), p50(shadow_rows)
+    assert shadow_p50 <= base_p50 * 3 + 0.08, (
+        f"shadow mirroring moved the incumbent p50: baseline "
+        f"{base_p50 * 1e3:.1f}ms -> shadow {shadow_p50 * 1e3:.1f}ms")
+
+    # ---- drill two: SIGKILL the candidate mid-canary -------------------
+    base2 = {n: deploy_report(n) for n in ("m1", "m2")}
+    post_rollout({
+        "engineInstanceId": CAND2,
+        "targets": f"127.0.0.1:{ports['c2']}",
+        "by": "smoke",
+        "shadowRate": 1.0, "shadowMinSamples": 5, "shadowHoldSeconds": 0.2,
+        "mismatchLimit": 0.2, "scoreTolerance": 0.25,
+        "canaryFraction": 0.5, "canaryHoldSeconds": 120.0,
+        "canaryMinRequests": 1000000, "judgeIntervalSeconds": 0.25,
+        "downAfterFailures": 3,
+    })
+    wait_stage("canary", 90)
+    time.sleep(0.6)  # let the canary keyspace take real traffic
+    os.kill(procs["c2"].pid, signal.SIGKILL)
+    procs["c2"].wait()
+    killed_at = time.time()
+    snap2 = wait_stage("rolled_back", 30)
+
+    trail2 = snap2["trail"]
+    back = [e for e in trail2 if e["to"] == "rolling_back"]
+    assert back and back[0]["signal"] == "candidate_unreachable", trail2
+    assert back[0]["at"] - killed_at < 15, (
+        f"rollback took {back[0]['at'] - killed_at:.1f}s after the kill")
+    assert trail2[-1]["signal"] == "incumbent_restored", trail2
+    assert snap2["incumbentInstance"] == CAND1, snap2["incumbentInstance"]
+
+    # the incumbent ring must be byte-identically where the rollout
+    # found it: same instance, same swap generation, same sha set
+    for n in ("m1", "m2"):
+        rep = deploy_report(n)
+        assert rep == base2[n], (n, base2[n], rep)
+
+    stop_load.set()
+    for t in threads:
+        t.join(timeout=30)
+
+    bad = [r for r in records if r[2] != 200]
+    assert not bad, (
+        f"{len(bad)}/{len(records)} client requests failed across the "
+        f"two rollout drills: {bad[:5]} (want zero interactive non-200)")
+
+    with urllib.request.urlopen(rbase + "/metrics", timeout=5) as r:
+        metrics = r.read().decode("utf-8")
+    for fam in ("pio_tpu_rollout_stage",
+                "pio_tpu_rollout_transitions_total{",
+                "pio_tpu_rollout_mirrored_total{",
+                "pio_tpu_rollout_shadow_samples_total{",
+                "pio_tpu_rollout_judge_total{"):
+        assert fam in metrics, f"/metrics missing {fam}"
+
+    print(f"rollout stage: clean candidate promoted "
+          f"({snap['shadow']['samples']} shadow samples, "
+          f"{snap['canary']['requests']} canaried, p50 "
+          f"{base_p50 * 1e3:.1f}ms -> {shadow_p50 * 1e3:.1f}ms), "
+          f"SIGKILLed candidate rolled back in "
+          f"{back[0]['at'] - killed_at:.1f}s, "
+          f"{len(records)} client requests, 0 failed")
+finally:
+    _cleanup()
+PY
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" python "$ROLLOUT_STAGE" "$WORKDIR" \
+    || fail "progressive rollout stage (promote/rollback/trail assertions)"
+echo "ok   progressive delivery: clean candidate auto-promoted, SIGKILLed candidate auto-rolled-back, zero failed requests"
+
 echo "smoke OK"

@@ -1,0 +1,781 @@
+"""Progressive-delivery rollout tests (ISSUE 19): shadow diffing,
+canary keyspace carve (0.0/1.0 degeneracy, stability), the stage
+machine against live fake members with real manifest verification,
+burn/mismatch/unreachable-triggered rollback within one judging
+window, the rollback-restores-incumbent-byte-identically property,
+the routerd HTTP surface, and /fleet.json federation."""
+
+import hashlib
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pio_tpu.obs import monotonic_s
+from pio_tpu.obs.fleet import FleetAggregator
+from pio_tpu.obs.metrics import MetricsRegistry
+from pio_tpu.router.core import ServingRouter
+from pio_tpu.router.deploy import (
+    DeployVerifyError,
+    manifest_digests,
+    verify_instance,
+)
+from pio_tpu.router.rollout import (
+    STAGES,
+    RolloutConfig,
+    RolloutController,
+    RolloutMetrics,
+    diff_answers,
+)
+from pio_tpu.server.http import JsonHTTPServer, Router, metrics_response
+from pio_tpu.server.routerd import RouterService
+from pio_tpu.workflow.shard_store import SHARD_MANIFEST_SUFFIX
+
+KEYS = [f"user{i}" for i in range(400)]
+
+
+def http(method, url, body=None, headers=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _wait_for(pred, timeout_s=8.0):
+    deadline = monotonic_s() + timeout_s
+    while monotonic_s() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# shared sharded store: two instances with distinct shard bytes
+
+
+class _Rec:
+    def __init__(self, models):
+        self.models = models
+
+
+class _Store(dict):
+    def get(self, k, default=None):
+        return dict.get(self, k, default)
+
+
+def _manifest_for(instance_id, shards):
+    total = sum(len(b) for b in shards)
+    rows = 2 * len(shards)
+    return {
+        "version": 1,
+        "n_shards": len(shards),
+        "mesh_shape": [len(shards)],
+        "algos": [{
+            "template": "als",
+            "arrays": [{
+                "name": "emb", "shape": [rows, total // rows or 1],
+                "dtype": "int8", "spec": [["rows"]],
+                "shards": [
+                    {"id": f"{instance_id}.shard{i}",
+                     "sha256": hashlib.sha256(b).hexdigest(),
+                     "size": len(b), "rows": [2 * i, 2 * i + 2]}
+                    for i, b in enumerate(shards)
+                ],
+            }],
+        }],
+    }
+
+
+def _dual_store(inc_byte=b"\x01", cand_byte=b"\x7f"):
+    """One models store holding an incumbent and a candidate instance
+    whose shard bytes (and therefore sha256 sets) differ."""
+    store = _Store()
+    manifests = {}
+    for iid, fill in (("inc", inc_byte), ("cand", cand_byte)):
+        shards = [fill * 64, fill * 96]
+        manifest = _manifest_for(iid, shards)
+        manifests[iid] = manifest
+        store[iid + SHARD_MANIFEST_SUFFIX] = _Rec(
+            json.dumps(manifest).encode()
+        )
+        for i, b in enumerate(shards):
+            store[f"{iid}.shard{i}"] = _Rec(b)
+    return store, manifests
+
+
+def _shas(manifest):
+    return sorted(s for s, _ in manifest_digests(manifest).values())
+
+
+# ---------------------------------------------------------------------------
+# fake serving member with a real verify-before-swap deploy handler
+
+
+class _Member:
+    """Query member double: verifies pushed manifests against its own
+    (shared) store before swapping — the same contract the real
+    ``deploy_verified`` handler enforces — and reports its serving
+    identity on ``GET /deploy.json``."""
+
+    def __init__(self, name, store, instance=None, manifest=None,
+                 score=1.0):
+        self.name = name
+        self.store = store
+        self.instance = instance
+        self.manifest = manifest
+        self.generation = 1 if instance else 0
+        self.score = score
+        self.fail_queries = False
+        self.reject_deploys = False
+        #: (instanceId, sorted sha256 list, generation) per verified swap
+        self.swaps = []
+        self.queries_total = 0.0
+        self.query_errors_total = 0.0
+        router = Router()
+        router.add("POST", "/queries\\.json", self.query)
+        router.add("GET", "/metrics", self.metrics)
+        router.add("POST", "/deploy\\.json", self.deploy)
+        router.add("GET", "/deploy\\.json", self.deploy_report)
+        self.server = JsonHTTPServer(
+            router, "127.0.0.1", 0, name=f"member-{name}"
+        ).start()
+        self.url = f"http://127.0.0.1:{self.server.port}"
+
+    def query(self, req):
+        self.queries_total += 1
+        if self.fail_queries:
+            self.query_errors_total += 1
+            return 500, {"message": "injected"}
+        return 200, {
+            "itemScores": [{"item": "i1", "score": self.score}],
+            "member": self.name,
+            "priority": req.header("X-Pio-Priority"),
+        }
+
+    def deploy(self, req):
+        if self.reject_deploys:
+            return 409, {"message": "deploy verification failed: refused"}
+        body = req.body or {}
+        iid = body.get("engineInstanceId")
+        manifest = body.get("manifest")
+        try:
+            verify_instance(self.store, iid, expected=manifest)
+        except DeployVerifyError as e:
+            return 409, {"message": str(e)}
+        self.instance, self.manifest = iid, manifest
+        self.generation += 1
+        self.swaps.append((iid, _shas(manifest), self.generation))
+        return 200, {"verified": True, "member": self.name}
+
+    def deploy_report(self, req):
+        return 200, {
+            "engineInstanceId": self.instance,
+            "engineId": "e1",
+            "manifestSha256": _shas(self.manifest) if self.manifest else [],
+            "generation": self.generation,
+        }
+
+    def metrics(self, req):
+        text = (
+            f"pio_tpu_queries_total {self.queries_total}\n"
+            f"pio_tpu_query_errors_total {self.query_errors_total}\n"
+        )
+        return 200, metrics_response(text)
+
+    def stop(self):
+        self.server.stop()
+
+
+# ---------------------------------------------------------------------------
+# answer diffing
+
+
+class TestDiffAnswers:
+    def test_byte_identical_matches(self):
+        assert diff_answers(200, b'{"x":1}', 200, b'{"x":1}') == (True, [])
+
+    def test_status_disagreement_mismatches(self):
+        assert diff_answers(200, b"{}", 500, b"{}")[0] is False
+
+    def test_scores_within_tolerance_match(self):
+        a = json.dumps({"itemScores": [
+            {"item": "i1", "score": 1.0}, {"item": "i2", "score": 2.0},
+        ]}).encode()
+        b = json.dumps({"itemScores": [
+            {"item": "i2", "score": 2.0004}, {"item": "i1", "score": 1.0},
+        ]}).encode()
+        match, deltas = diff_answers(200, a, 200, b, score_tolerance=1e-3)
+        assert match and len(deltas) == 2
+        assert max(deltas) == pytest.approx(0.0004)
+
+    def test_scores_beyond_tolerance_mismatch(self):
+        a = json.dumps({"itemScores": [{"item": "i1", "score": 1.0}]})
+        b = json.dumps({"itemScores": [{"item": "i1", "score": 1.5}]})
+        match, deltas = diff_answers(
+            200, a.encode(), 200, b.encode(), score_tolerance=1e-3
+        )
+        assert not match and deltas == [pytest.approx(0.5)]
+
+    def test_disjoint_item_sets_mismatch(self):
+        a = json.dumps({"itemScores": [{"item": "i1", "score": 1.0}]})
+        b = json.dumps({"itemScores": [{"item": "i9", "score": 1.0}]})
+        assert diff_answers(200, a.encode(), 200, b.encode())[0] is False
+
+    def test_non_json_divergence_mismatches(self):
+        assert diff_answers(200, b"abc", 200, b"abd")[0] is False
+
+    def test_iid_spelling_accepted(self):
+        a = json.dumps({"itemScores": [{"iid": "i1", "score": 1.0}]})
+        b = json.dumps({"itemScores": [{"item": "i1", "score": 1.0}]})
+        assert diff_answers(200, a.encode(), 200, b.encode())[0] is True
+
+
+# ---------------------------------------------------------------------------
+# canary keyspace carve
+
+
+class _DummyCore:
+    timeout_s = 1.0
+
+
+def _controller(cfg, core=None, fetch=None, loader=None):
+    return RolloutController(
+        core if core is not None else _DummyCore(),
+        cfg,
+        RolloutMetrics(MetricsRegistry()),
+        manifest_loader=loader if loader is not None else (lambda iid: None),
+        fetch=fetch if fetch is not None else (lambda url, t: b""),
+    )
+
+
+class TestCanaryKeyspace:
+    def _ctrl(self, fraction):
+        return _controller(RolloutConfig(
+            candidate_instance="cand",
+            candidate_targets=[("cand0", "http://127.0.0.1:9")],
+            canary_fraction=fraction,
+        ))
+
+    def test_fraction_zero_is_pure_incumbent(self):
+        ctrl = self._ctrl(0.0)
+        assert not any(ctrl.in_canary_keyspace(k) for k in KEYS)
+
+    def test_fraction_one_is_pure_candidate(self):
+        ctrl = self._ctrl(1.0)
+        assert all(ctrl.in_canary_keyspace(k) for k in KEYS)
+
+    def test_fraction_is_stable_and_roughly_proportional(self):
+        ctrl = self._ctrl(0.3)
+        hit = {k for k in KEYS if ctrl.in_canary_keyspace(k)}
+        # entity-affine stability: the same entity answers the same way
+        assert hit == {k for k in KEYS if ctrl.in_canary_keyspace(k)}
+        assert 0.15 * len(KEYS) < len(hit) < 0.45 * len(KEYS)
+
+    def test_consecutive_rollouts_carve_different_slices(self):
+        a = self._ctrl(0.3)
+        b = _controller(RolloutConfig(
+            candidate_instance="cand2",
+            candidate_targets=[("cand0", "http://127.0.0.1:9")],
+            canary_fraction=0.3,
+        ))
+        hits_a = {k for k in KEYS if a.in_canary_keyspace(k)}
+        hits_b = {k for k in KEYS if b.in_canary_keyspace(k)}
+        assert hits_a != hits_b
+
+    def test_divert_only_in_canary_stage(self):
+        ctrl = self._ctrl(1.0)
+        assert ctrl.divert("user1", "") is None  # stage is pending
+        ctrl.stage = "canary"
+        # shadow traffic never diverts (a mirror must not re-divert)
+        assert ctrl.divert("user1", "shadow") is None
+        assert ctrl.divert(None, "") is None
+
+
+# ---------------------------------------------------------------------------
+# judge: every rollback trigger, driven with an explicit clock
+
+
+def _judge_ctrl(metrics_state, cfg_kw=None):
+    """Controller parked in shadow with an injectable candidate scrape
+    (``metrics_state`` dict renders as the candidate's /metrics)."""
+    def fetch(url, timeout):
+        if metrics_state.get("raise"):
+            raise OSError("injected scrape failure")
+        return (
+            f"pio_tpu_queries_total {metrics_state['total']}\n"
+            f"pio_tpu_query_errors_total {metrics_state['errors']}\n"
+        ).encode()
+
+    kw = dict(
+        candidate_instance="cand",
+        candidate_targets=[("cand0", "http://127.0.0.1:9")],
+        incumbent_instance="inc",
+        judge_fast_s=30.0,
+        judge_slow_s=120.0,
+        burn_limit=2.0,
+        availability_objective=0.99,
+        shadow_min_samples=10_000,  # park in shadow
+        down_after_failures=3,
+    )
+    kw.update(cfg_kw or {})
+    # a placeholder incumbent; nothing in these tests forwards to it
+    core = ServingRouter(
+        [("inc0", "http://127.0.0.1:9")], MetricsRegistry()
+    )
+    ctrl = _controller(RolloutConfig(**kw), core=core, fetch=fetch)
+    ctrl.stage = "shadow"
+    ctrl._stage_entered = 0.0
+    return ctrl
+
+
+class TestJudge:
+    def test_clean_candidate_judges_ok(self):
+        state = {"total": 100.0, "errors": 0.0}
+        ctrl = _judge_ctrl(state)
+        assert ctrl.judge_once(now=0.0) == "ok"
+        state["total"] = 200.0
+        assert ctrl.judge_once(now=10.0) == "ok"
+        assert ctrl.last_verdict == "ok"
+        assert ctrl.stage == "shadow"
+
+    def test_slo_burn_rolls_back_within_one_window(self):
+        state = {"total": 100.0, "errors": 0.0}
+        ctrl = _judge_ctrl(state)
+        assert ctrl.judge_once(now=0.0) == "ok"
+        # 90 of the next 100 queries error: burn 90/(1-0.99) >> limit 2
+        state["total"], state["errors"] = 200.0, 90.0
+        assert ctrl.judge_once(now=10.0) == "rollback"
+        assert ctrl.stage == "rolled_back"
+        rb = next(e for e in ctrl.trail if e["to"] == "rolling_back")
+        assert rb["signal"] == "slo_burn"
+        assert rb["window"] == "30s/120s"
+        assert ctrl.trail[-1]["to"] == "rolled_back"
+
+    def test_unreachable_candidate_rolls_back(self):
+        state = {"total": 100.0, "errors": 0.0, "raise": True}
+        ctrl = _judge_ctrl(state)
+        assert ctrl.judge_once(now=0.0) == "ok"   # 1st failure tolerated
+        assert ctrl.judge_once(now=2.0) == "ok"   # 2nd
+        assert ctrl.judge_once(now=4.0) == "rollback"
+        rb = next(e for e in ctrl.trail if e["to"] == "rolling_back")
+        assert rb["signal"] == "candidate_unreachable"
+
+    def test_shadow_mismatch_rolls_back(self):
+        state = {"total": 100.0, "errors": 0.0}
+        ctrl = _judge_ctrl(state, {"shadow_min_samples": 50,
+                                   "shadow_hold_s": 10_000.0,
+                                   "mismatch_limit": 0.02})
+        ctrl.shadow_matches, ctrl.shadow_mismatches = 45, 5
+        assert ctrl.judge_once(now=0.0) == "rollback"
+        rb = next(e for e in ctrl.trail if e["to"] == "rolling_back")
+        assert rb["signal"] == "shadow_mismatch"
+
+    def test_shadow_latency_blowup_rolls_back(self):
+        state = {"total": 100.0, "errors": 0.0}
+        ctrl = _judge_ctrl(state, {"latency_limit_x": 5.0})
+        ctrl._lat_incumbent.extend([0.010] * 30)
+        ctrl._lat_candidate.extend([0.200] * 30)
+        assert ctrl.judge_once(now=0.0) == "rollback"
+        rb = next(e for e in ctrl.trail if e["to"] == "rolling_back")
+        assert rb["signal"] == "shadow_latency"
+
+    def test_terminal_stage_is_sticky(self):
+        state = {"total": 100.0, "errors": 0.0}
+        ctrl = _judge_ctrl(state)
+        ctrl.abort(by="test")
+        assert ctrl.stage == "rolled_back"
+        assert not ctrl.active()
+        assert ctrl.judge_once(now=99.0) == "rolled_back"
+        ctrl.abort(by="test")  # idempotent on a terminal stage
+        assert sum(
+            1 for e in ctrl.trail if e["to"] == "rolled_back"
+        ) == 1
+
+
+# ---------------------------------------------------------------------------
+# the full stage machine against live members
+
+
+class _Fabric:
+    """One incumbent ring + one candidate member over a shared store,
+    plus a ServingRouter and a controller-ready config."""
+
+    def __init__(self, n_incumbents=1, inc_byte=b"\x01",
+                 cand_byte=b"\x7f", **cfg_kw):
+        self.store, self.manifests = _dual_store(inc_byte, cand_byte)
+        self.incumbents = [
+            _Member(f"inc{i}", self.store, instance="inc",
+                    manifest=self.manifests["inc"])
+            for i in range(n_incumbents)
+        ]
+        self.candidate = _Member("cand0", self.store)
+        self.core = ServingRouter(
+            [(m.name, m.url) for m in self.incumbents],
+            MetricsRegistry(),
+        )
+        kw = dict(
+            candidate_instance="cand",
+            candidate_targets=[(self.candidate.name, self.candidate.url)],
+            shadow_rate=1.0,
+            shadow_min_samples=2,
+            shadow_hold_s=0.0,
+            canary_fraction=1.0,
+            canary_hold_s=0.0,
+            canary_min_requests=1,
+            mismatch_limit=0.5,
+        )
+        kw.update(cfg_kw)
+        self.ctrl = RolloutController(
+            self.core,
+            RolloutConfig(**kw),
+            RolloutMetrics(self.core.obs),
+            manifest_loader=self.manifests.get,
+        )
+
+    def observe_incumbent_relay(self, entity, body=None):
+        """Synthesize one completed incumbent relay through the hook
+        the router would call (the diffing side sees real bytes)."""
+        if body is None:
+            body = json.dumps({"user": entity}).encode()
+        out = json.dumps(
+            {"itemScores": [{"item": "i1", "score": 1.0}]}
+        ).encode()
+        self.ctrl.observe(
+            "POST", "/queries.json", body,
+            {"content-type": "application/json"}, entity, "",
+            self.incumbents[0].name, 200, out, 0.002,
+        )
+
+    def close(self):
+        self.ctrl.stop()
+        self.core.close()
+        for m in self.incumbents + [self.candidate]:
+            m.stop()
+
+
+@pytest.fixture()
+def fabric():
+    f = _Fabric()
+    try:
+        yield f
+    finally:
+        f.close()
+
+
+class TestStageMachine:
+    def test_shadow_to_canary_to_promoted(self, fabric):
+        ctrl, core = fabric.ctrl, fabric.core
+
+        ctrl._deploy_candidate()
+        # incumbent discovered from the members' own GET /deploy.json
+        assert ctrl.incumbent_instance == "inc"
+        assert ctrl.incumbent_shas == _shas(fabric.manifests["inc"])
+        # the candidate member verified the pushed manifest and swapped
+        assert fabric.candidate.instance == "cand"
+        # aux: pooled but never in the incumbent ring
+        assert core.has_member("cand0")
+        assert "cand0" not in core.ring.members
+        assert [m.name for m in core.ring_members()] == ["inc0"]
+
+        ctrl._enter_shadow()
+        assert ctrl.stage == "shadow"
+        for i in range(3):
+            fabric.observe_incumbent_relay(f"user{i}")
+        assert _wait_for(
+            lambda: ctrl.shadow_matches + ctrl.shadow_mismatches >= 2
+        ), "mirror worker never diffed the sampled relays"
+        assert ctrl.shadow_mismatches == 0
+
+        assert ctrl.judge_once() == "canary"
+        assert ctrl.stage == "canary"
+        # fraction 1.0: every keyed pick fronts the candidate, with the
+        # incumbent plan behind it as the transparent retry
+        plan = [m.name for m in core.pick("user1")]
+        assert plan[0] == "cand0" and "inc0" in plan[1:]
+        status, _, body, member = core.forward(
+            "POST", "/queries.json",
+            json.dumps({"user": "user1"}).encode(),
+            {"content-type": "application/json"}, entity_id="user1",
+        )
+        assert status == 200 and member == "cand0"
+        assert _wait_for(lambda: ctrl.canary_requests >= 1)
+
+        assert ctrl.judge_once() == "promoted"
+        assert ctrl.stage == "promoted"
+        # the ring member's generation flipped to the candidate —
+        # verified, never blind
+        assert fabric.incumbents[0].instance == "cand"
+        assert core.member("inc0").generation == "cand"
+        # candidate aux member released, hooks detached
+        assert not core.has_member("cand0")
+        assert core._observer is None and core._divert is None
+        signals = [e["signal"] for e in ctrl.trail]
+        assert signals == [
+            "start", "candidate_verified", "shadow_clean",
+            "canary_clean", "all_verified",
+        ]
+
+    def test_canary_fraction_zero_never_diverts(self):
+        f = _Fabric(canary_fraction=0.0)
+        try:
+            f.ctrl._deploy_candidate()
+            f.ctrl._enter_shadow()
+            f.ctrl._enter_canary(0, 0.0)
+            for k in KEYS[:50]:
+                assert [m.name for m in f.core.pick(k)] == ["inc0"]
+        finally:
+            f.close()
+
+    def test_payload_shape(self, fabric):
+        body = fabric.ctrl.payload()
+        assert body["stage"] == "pending"
+        assert body["stageCode"] == STAGES["pending"]
+        assert body["candidateInstance"] == "cand"
+        assert body["config"]["canaryFraction"] == 1.0
+        assert body["shadow"]["samples"] == 0
+        assert body["judge"]["lastVerdict"] is None
+        assert body["trail"] == []
+
+
+class TestRollbackProperty:
+    @pytest.mark.parametrize("inc_byte,cand_byte", [
+        (b"\x01", b"\x7f"),
+        (b"\x22", b"\x23"),
+        (b"\xaa", b"\x55"),
+    ])
+    def test_rollback_restores_incumbent_byte_identically(
+        self, inc_byte, cand_byte
+    ):
+        """The property the runbook leans on: after any rollback, every
+        member that flipped is back on the incumbent manifest with the
+        exact sha256 set recorded at rollout start, and its swap
+        generation only ever moved forward."""
+        f = _Fabric(n_incumbents=2, inc_byte=inc_byte,
+                    cand_byte=cand_byte)
+        # inc1 refuses the candidate: promote must fail halfway and the
+        # controller must walk inc0 back
+        f.incumbents[1].reject_deploys = True
+        try:
+            before = {m.name: _shas(m.manifest) for m in f.incumbents}
+            f.ctrl._deploy_candidate()
+            f.ctrl._enter_shadow()
+            f.ctrl._promote(canaried=0)
+
+            assert f.ctrl.stage == "rolled_back"
+            rb = next(
+                e for e in f.ctrl.trail if e["to"] == "rolling_back"
+            )
+            assert rb["signal"] == "promote_failed"
+            for m in f.incumbents:
+                # byte identity: the restored manifest's digest set is
+                # exactly the one recorded before the rollout touched
+                # anything (the store never changed, so equal digests
+                # mean equal bytes)
+                assert m.instance == "inc"
+                assert _shas(m.manifest) == before[m.name]
+                assert _shas(m.manifest) == f.ctrl.incumbent_shas
+            # generation strictly monotone through flip + restore
+            gens = [g for _, _, g in f.incumbents[0].swaps]
+            assert gens == sorted(gens) and len(set(gens)) == len(gens)
+            assert f.incumbents[0].swaps[-1][0] == "inc"
+            # router generation view restored too
+            assert f.core.member("inc0").generation == "inc"
+            assert not f.core.has_member("cand0")
+        finally:
+            f.close()
+
+    def test_candidate_deploy_rejection_rolls_back_before_traffic(self):
+        f = _Fabric()
+        f.candidate.reject_deploys = True
+        try:
+            f.ctrl._run()
+            assert f.ctrl.stage == "rolled_back"
+            rb = next(
+                e for e in f.ctrl.trail if e["to"] == "rolling_back"
+            )
+            assert rb["signal"] == "candidate_deploy_failed"
+            # the incumbent never flipped and no mirror ever started
+            assert f.incumbents[0].instance == "inc"
+            assert f.ctrl.shadow_matches + f.ctrl.shadow_mismatches == 0
+        finally:
+            f.close()
+
+
+# ---------------------------------------------------------------------------
+# routerd HTTP surface
+
+
+class TestRolloutHTTP:
+    def _service(self, members):
+        svc = RouterService(
+            [(m.name, m.url) for m in members], interval_s=5.0
+        )
+        server = JsonHTTPServer(
+            svc.router, "127.0.0.1", 0, name="test-routerd"
+        ).start()
+        return svc, server, f"http://127.0.0.1:{server.port}"
+
+    def test_rollout_json_idle_shape(self):
+        store, manifests = _dual_store()
+        inc = _Member("inc0", store, "inc", manifests["inc"])
+        svc, server, base = self._service([inc])
+        try:
+            status, body, _ = http("GET", f"{base}/rollout.json")
+            assert status == 200
+            assert json.loads(body) == {
+                "stage": "idle", "generation": 0, "trail": [],
+            }
+        finally:
+            server.stop()
+            svc.stop()
+            inc.stop()
+
+    def test_start_validation_and_conflict(self, monkeypatch):
+        from pio_tpu.storage import Storage
+
+        store, manifests = _dual_store()
+        monkeypatch.setattr(
+            Storage, "get_model_data_models", staticmethod(lambda: store)
+        )
+        inc = _Member("inc0", store, "inc", manifests["inc"])
+        cand = _Member("cand0", store)
+        svc, server, base = self._service([inc])
+        try:
+            # no candidate instance
+            assert http("POST", f"{base}/rollout", {})[0] == 400
+            # bad knob value
+            assert http("POST", f"{base}/rollout", {
+                "engineInstanceId": "cand",
+                "targets": f"127.0.0.1:{cand.server.port}",
+                "canaryFraction": 7.0,
+            })[0] == 400
+            # no targets
+            assert http("POST", f"{base}/rollout", {
+                "engineInstanceId": "cand",
+            })[0] == 400
+            # abort with nothing started
+            assert http("POST", f"{base}/rollout/abort", {})[0] == 404
+            assert http("POST", f"{base}/rollout/approve", {})[0] == 404
+
+            status, body, _ = http("POST", f"{base}/rollout", {
+                "engineInstanceId": "cand",
+                "targets": f"127.0.0.1:{cand.server.port}",
+                "incumbentInstance": "inc",
+                "shadowHoldSeconds": 600.0,  # park in shadow
+                "judgeIntervalSeconds": 0.05,
+            })
+            assert status == 202
+            assert json.loads(body)["rollout"]["stage"] in (
+                "pending", "deploying", "shadow",
+            )
+            assert _wait_for(
+                lambda: json.loads(
+                    http("GET", f"{base}/rollout.json")[1]
+                )["stage"] == "shadow"
+            )
+            # one judged rollout at a time
+            assert http("POST", f"{base}/rollout", {
+                "engineInstanceId": "cand2",
+                "targets": f"127.0.0.1:{cand.server.port}",
+            })[0] == 409
+
+            status, body, _ = http("POST", f"{base}/rollout/abort", {})
+            assert status == 200
+            out = json.loads(body)["rollout"]
+            assert out["stage"] in ("rolling_back", "rolled_back")
+            assert _wait_for(
+                lambda: json.loads(
+                    http("GET", f"{base}/rollout.json")[1]
+                )["stage"] == "rolled_back"
+            )
+            trail = json.loads(
+                http("GET", f"{base}/rollout.json")[1]
+            )["trail"]
+            assert any(e["signal"] == "operator_abort" for e in trail)
+            # terminal: a new rollout may start again
+            status, _, _ = http("POST", f"{base}/rollout", {
+                "engineInstanceId": "cand",
+                "targets": f"127.0.0.1:{cand.server.port}",
+                "incumbentInstance": "inc",
+                "shadowHoldSeconds": 600.0,
+            })
+            assert status == 202
+        finally:
+            server.stop()
+            svc.stop()
+            inc.stop()
+            cand.stop()
+
+
+# ---------------------------------------------------------------------------
+# /fleet.json federation
+
+
+class TestFleetFederation:
+    def test_rollout_block_federates_compactly(self):
+        rollout_doc = {
+            "stage": "canary", "generation": 3,
+            "candidateInstance": "cand", "incumbentInstance": "inc",
+            "shadow": {"samples": 120, "mismatchRate": 0.01},
+            "canary": {"requests": 7},
+            "judge": {"lastVerdict": "ok"},
+            "trail": [
+                {"to": "shadow", "signal": "candidate_verified"},
+                {"to": "canary", "signal": "shadow_clean"},
+            ],
+        }
+
+        def fetch(url, timeout):
+            if url.endswith("/metrics"):
+                return b"pio_tpu_queries_total 1\n"
+            if url.endswith("/router.json"):
+                return json.dumps({"ring": {"size": 1}}).encode()
+            if url.endswith("/rollout.json"):
+                return json.dumps(rollout_doc).encode()
+            raise OSError("no such surface")
+
+        agg = FleetAggregator(
+            [("r1", "http://r1")], MetricsRegistry(), interval_s=5.0,
+            fetch=fetch,
+        )
+        agg.scrape_once()
+        entry = next(
+            m for m in agg.fleet_payload()["members"]
+            if m["member"] == "r1"
+        )
+        assert entry["rollout"] == {
+            "stage": "canary", "generation": 3,
+            "candidateInstance": "cand", "incumbentInstance": "inc",
+            "lastVerdict": "ok", "shadowSamples": 120,
+            "mismatchRate": 0.01, "canaryRequests": 7,
+            "lastTransition": {"to": "canary", "signal": "shadow_clean"},
+        }
+
+    def test_idle_rollout_is_omitted(self):
+        def fetch(url, timeout):
+            if url.endswith("/metrics"):
+                return b"pio_tpu_queries_total 1\n"
+            if url.endswith("/router.json"):
+                return json.dumps({"ring": {"size": 1}}).encode()
+            if url.endswith("/rollout.json"):
+                return json.dumps(
+                    {"stage": "idle", "generation": 0, "trail": []}
+                ).encode()
+            raise OSError("no such surface")
+
+        agg = FleetAggregator(
+            [("r1", "http://r1")], MetricsRegistry(), interval_s=5.0,
+            fetch=fetch,
+        )
+        agg.scrape_once()
+        entry = agg.fleet_payload()["members"][0]
+        assert entry["rollout"] is None

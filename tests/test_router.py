@@ -1,15 +1,18 @@
 """Serving fabric tests (ISSUE 18): rendezvous ring math (partlog
 co-location agreement, churn remaps only the affected keyspace),
 router core pick/forward/retry/shed against live fake members,
-manifest-verified deploys, and the routerd HTTP surface including the
+manifest-verified deploys, hedged requests and headroom-aware
+spreading (ISSUE 19), and the routerd HTTP surface including the
 packed int8 passthrough."""
 
 import json
+import time
 import urllib.error
 import urllib.request
 
 import pytest
 
+from pio_tpu.obs import monotonic_s
 from pio_tpu.obs.metrics import MetricsRegistry
 from pio_tpu.router.core import ServingRouter, Shed, forward_headers
 from pio_tpu.router.deploy import (
@@ -137,6 +140,7 @@ class _FakeMember:
 
     def __init__(self, name):
         self.name = name
+        self.delay_s = 0.0
         self.obs = MetricsRegistry()
         router = Router()
         router.add("POST", "/queries\\.json", self.query)
@@ -149,6 +153,8 @@ class _FakeMember:
         self.port = self.server.port
 
     def query(self, req):
+        if self.delay_s:
+            time.sleep(self.delay_s)
         if req.packed is not None:
             return 200, RawResponse(
                 bytes(req.packed),
@@ -297,6 +303,180 @@ class TestServingRouter:
                 "pio_tpu_router_ring_size", ""
             ).value() == 1.0
         finally:
+            sr.close()
+
+    def test_headroom_exhausted_member_demoted(self, two_members):
+        """Satellite (ISSUE 19): a member whose device budget headroom
+        hit zero demotes behind healthy ones before its SLO burns."""
+        sr = ServingRouter(_targets(two_members), MetricsRegistry())
+        try:
+            entity = next(k for k in KEYS if sr.ring.rank(k)[0] == "a")
+            sr.ingest_fleet({"members": [
+                {"member": "a", "status": "up",
+                 "devices": {"headroomBytes": 0}},
+                {"member": "b", "status": "up",
+                 "devices": {"headroomBytes": 1 << 30}},
+            ]})
+            # affinity says "a", the exhausted headroom says "b"
+            assert [m.name for m in sr.pick(entity)] == ["b", "a"]
+            snap = sr.snapshot()
+            by = {m["member"]: m for m in snap["members"]}
+            assert by["a"]["headroomBytes"] == 0.0
+            assert by["b"]["headroomBytes"] == float(1 << 30)
+        finally:
+            sr.close()
+
+    def test_headroom_and_burn_both_shed_non_interactive(
+        self, two_members
+    ):
+        sr = ServingRouter(_targets(two_members), MetricsRegistry())
+        try:
+            sr.ingest_fleet({"members": [
+                {"member": "a", "status": "up",
+                 "devices": {"headroomBytes": 0}},
+                {"member": "b", "status": "up",
+                 "slo": {"worstBurn": 5.0}},
+            ]})
+            with pytest.raises(Shed) as ei:
+                sr.pick("u1", priority="batchpredict")
+            assert ei.value.reason == "slo_burn"
+            # interactive still rides the least-pressured replica
+            assert len(sr.pick("u1", "interactive")) == 2
+        finally:
+            sr.close()
+
+    def test_hedge_fires_after_budget_and_wins(self, two_members):
+        """Satellite (ISSUE 19): with PIO_TPU_ROUTER_HEDGE_MS armed, an
+        interactive request whose primary outlives the budget races the
+        next replica; the faster answer wins and is counted."""
+        sr = ServingRouter(
+            _targets(two_members), MetricsRegistry(), hedge_ms=40.0
+        )
+        try:
+            entity = next(k for k in KEYS if sr.ring.rank(k)[0] == "a")
+            two_members[0].delay_s = 0.4
+            t0 = monotonic_s()
+            status, _, _, member = sr.forward(
+                "POST", "/queries.json", b"{}", {}, entity_id=entity,
+                priority="interactive",
+            )
+            elapsed = monotonic_s() - t0
+            assert status == 200 and member == "b"
+            assert elapsed < 0.35  # did not wait out the slow primary
+            assert sr._hedged.value("hedge_won") == 1.0
+            assert sr._retried.value("b") == 1.0
+        finally:
+            sr.close()
+
+    def test_hedge_primary_wins_race(self, two_members):
+        sr = ServingRouter(
+            _targets(two_members), MetricsRegistry(), hedge_ms=30.0
+        )
+        try:
+            entity = next(k for k in KEYS if sr.ring.rank(k)[0] == "a")
+            two_members[0].delay_s = 0.1   # slower than the budget...
+            two_members[1].delay_s = 0.4   # ...but faster than the hedge
+            status, _, _, member = sr.forward(
+                "POST", "/queries.json", b"{}", {}, entity_id=entity,
+            )
+            assert status == 200 and member == "a"
+            assert sr._hedged.value("primary_won") == 1.0
+            assert sr._hedged.value("hedge_won") == 0.0
+        finally:
+            sr.close()
+
+    def test_hedge_skipped_for_non_interactive(self, two_members):
+        sr = ServingRouter(
+            _targets(two_members), MetricsRegistry(), hedge_ms=30.0
+        )
+        try:
+            entity = next(k for k in KEYS if sr.ring.rank(k)[0] == "a")
+            two_members[0].delay_s = 0.1
+            status, _, _, member = sr.forward(
+                "POST", "/queries.json", b"{}", {}, entity_id=entity,
+                priority="batchpredict",
+            )
+            assert status == 200 and member == "a"
+            for outcome in ("primary_won", "hedge_won", "error"):
+                assert sr._hedged.value(outcome) == 0.0
+        finally:
+            sr.close()
+
+    def test_hedge_off_by_default(self, two_members):
+        sr = ServingRouter(_targets(two_members), MetricsRegistry())
+        try:
+            assert sr.hedge_s == 0.0
+            assert sr.snapshot()["policy"]["hedgeMs"] == 0.0
+        finally:
+            sr.close()
+
+    def test_removed_member_pool_sockets_close(self, two_members):
+        """Satellite (ISSUE 19): removing a member (or forcing it down)
+        closes its keep-alive pool sockets immediately — no FD may keep
+        pointing at a corpse."""
+        sr = ServingRouter(_targets(two_members), MetricsRegistry())
+        try:
+            entity = next(k for k in KEYS if sr.ring.rank(k)[0] == "a")
+            assert sr.forward(
+                "POST", "/queries.json", b"{}", {}, entity_id=entity
+            )[3] == "a"
+            pool = sr._pools["a"]
+            assert pool._idle, "keep-alive should have parked a conn"
+            socks = [c.sock for c in pool._idle if c.sock is not None]
+            assert socks
+            sr.remove_member("a")
+            assert pool._idle == []
+            assert all(s.fileno() == -1 for s in socks)  # really closed
+            assert not sr.has_member("a")
+            assert "a" not in sr.ring.members
+        finally:
+            sr.close()
+
+    def test_forced_down_member_pool_sockets_close(self, two_members):
+        sr = ServingRouter(
+            _targets(two_members), MetricsRegistry(), forced_down_s=60.0
+        )
+        try:
+            assert sr.forward(
+                "POST", "/queries.json", b"{}", {},
+                entity_id=next(
+                    k for k in KEYS if sr.ring.rank(k)[0] == "b"
+                ),
+            )[3] == "b"
+            pool = sr._pools["b"]
+            socks = [c.sock for c in pool._idle if c.sock is not None]
+            assert socks
+            sr.note_failure("b")
+            assert pool._idle == []
+            assert all(s.fileno() == -1 for s in socks)
+            assert [m.name for m in sr.pick("u1")] == ["a"]
+        finally:
+            sr.close()
+
+    def test_aux_member_takes_no_ring_traffic(self, two_members):
+        sr = ServingRouter(_targets(two_members), MetricsRegistry())
+        aux = _FakeMember("aux0")
+        try:
+            sr.add_member("aux0", f"http://127.0.0.1:{aux.port}",
+                          aux=True)
+            assert sr.has_member("aux0")
+            assert "aux0" not in sr.ring.members
+            for k in KEYS[:50]:
+                assert "aux0" not in [m.name for m in sr.pick(k)]
+            # but it is directly reachable over its pool
+            status, _, body = sr.upstream_request(
+                "aux0", "POST", "/queries.json", b"{}",
+                {"content-type": "application/json"},
+            )
+            assert status == 200
+            assert json.loads(body)["member"] == "aux0"
+            snap = sr.snapshot()
+            by = {m["member"]: m for m in snap["members"]}
+            assert by["aux0"]["aux"] is True
+            assert snap["ring"]["size"] == 2
+        finally:
+            sr.remove_member("aux0")
+            aux.stop()
             sr.close()
 
     def test_forward_headers_allowlist(self):
