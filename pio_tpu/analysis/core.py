@@ -34,13 +34,16 @@ _SUPPRESS_RE = re.compile(
 )
 
 #: analysis markers, same comment grammar as suppressions:
-#:   # pio: hotpath              <- function is a hot-path root
-#:   # pio: hotpath=zerocopy     <- additionally no JSON / bytes copies
-#:   # pio: frame=lane-slot      <- struct call site belongs to a frame
+#:   # pio: hotpath                  <- function is a hot-path root
+#:   # pio: hotpath=zerocopy         <- additionally no JSON / bytes copies
+#:   # pio: frame=lane-slot          <- struct call site belongs to a frame
+#:   # pio: endpoint=/fleet.json     <- function builds this endpoint's payload
+#:   # pio: consumes=/fleet.json     <- function parses this endpoint's payload
 #: A marker alone on its line covers the line below it (so a def whose
 #: signature spans lines can carry the marker above itself).
 _MARKER_RE = re.compile(
-    r"#\s*pio:\s*(?P<kind>hotpath|frame)(?:=(?P<value>[A-Za-z0-9_.\-]+))?"
+    r"#\s*pio:\s*(?P<kind>hotpath|frame|endpoint|consumes)"
+    r"(?:=(?P<value>[A-Za-z0-9_./\-]+))?"
 )
 
 #: directories never descended into when a lint path is a directory
@@ -86,6 +89,10 @@ class ModuleInfo:
     hotpath_markers: Dict[int, str] = field(default_factory=dict)
     #: line -> frame family name  (`# pio: frame=<family>`)
     frame_markers: Dict[int, str] = field(default_factory=dict)
+    #: line -> endpoint path  (`# pio: endpoint=/fleet.json`)
+    endpoint_markers: Dict[int, str] = field(default_factory=dict)
+    #: line -> endpoint path  (`# pio: consumes=/fleet.json`)
+    consumes_markers: Dict[int, str] = field(default_factory=dict)
 
     def suppressed(self, rule: str, line: int) -> bool:
         if rule in self.file_suppressions:
@@ -104,13 +111,25 @@ class LintContext:
     """Shared, lazily-populated state handed to every rule."""
 
     def __init__(self, repo_root: Optional[str] = None,
-                 catalog: Optional[Set[str]] = None):
+                 catalog: Optional[Set[str]] = None,
+                 knob_registry: Optional[Dict[str, object]] = None):
         self.repo_root = repo_root or _default_repo_root()
         self._catalog = catalog
         self._catalog_loaded = catalog is not None
         self._catalog_kinds: Optional[Dict[str, str]] = None
         # an injected catalog (tests) has no type info: skip kind checks
         self._catalog_kinds_loaded = catalog is not None
+        self._knob_registry = knob_registry
+
+    @property
+    def knob_registry(self) -> Dict[str, object]:
+        """Canonical knob declarations (name -> :class:`~pio_tpu.utils.
+        knobs.Knob`). The in-tree registry by default; tests inject a
+        synthetic one to lint fixtures against it."""
+        if self._knob_registry is None:
+            from pio_tpu.utils.knobs import KNOBS
+            self._knob_registry = dict(KNOBS)
+        return self._knob_registry
 
     @property
     def metric_catalog(self) -> Optional[Set[str]]:
@@ -187,6 +206,7 @@ def _load_rule_modules() -> None:
     from pio_tpu.analysis import effects  # noqa: F401
     from pio_tpu.analysis import lockgraph  # noqa: F401
     from pio_tpu.analysis import rules_concurrency  # noqa: F401
+    from pio_tpu.analysis import rules_contracts  # noqa: F401
     from pio_tpu.analysis import rules_convention  # noqa: F401
 
 
@@ -248,6 +268,8 @@ def _collect_suppressions(source: str):
     whole_file: Set[str] = set()
     hotpath: Dict[int, str] = {}
     frames: Dict[int, str] = {}
+    endpoints: Dict[int, str] = {}
+    consumes: Dict[int, str] = {}
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in tokens:
@@ -280,9 +302,17 @@ def _collect_suppressions(source: str):
                 frames[line] = value
                 if alone:
                     frames.setdefault(line + 1, value)
+            elif kind == "endpoint" and value:
+                endpoints[line] = value
+                if alone:
+                    endpoints.setdefault(line + 1, value)
+            elif kind == "consumes" and value:
+                consumes[line] = value
+                if alone:
+                    consumes.setdefault(line + 1, value)
     except tokenize.TokenError:
         pass
-    return per_line, whole_file, hotpath, frames
+    return per_line, whole_file, hotpath, frames, endpoints, consumes
 
 
 def collect_files(paths: Sequence[str]) -> List[str]:
@@ -323,7 +353,8 @@ def parse_module(path: str, display: Optional[str] = None
                        exc.offset or 0, f"syntax error: {exc.msg}")
     except OSError as exc:
         return Finding("parse-error", display, 0, 0, f"unreadable: {exc}")
-    per_line, whole_file, hotpath, frames = _collect_suppressions(source)
+    (per_line, whole_file, hotpath, frames,
+     endpoints, consumes) = _collect_suppressions(source)
     return ModuleInfo(
         path=os.path.abspath(path),
         display=display,
@@ -335,6 +366,8 @@ def parse_module(path: str, display: Optional[str] = None
         file_suppressions=whole_file,
         hotpath_markers=hotpath,
         frame_markers=frames,
+        endpoint_markers=endpoints,
+        consumes_markers=consumes,
     )
 
 
@@ -350,7 +383,9 @@ def run_lint(paths: Sequence[str],
              rule_ids: Optional[Sequence[str]] = None,
              catalog: Optional[Set[str]] = None,
              repo_root: Optional[str] = None,
-             only: Optional[Sequence[str]] = None) -> List[Finding]:
+             only: Optional[Sequence[str]] = None,
+             knob_registry: Optional[Dict[str, object]] = None
+             ) -> List[Finding]:
     """Lint ``paths`` and return the surviving (unsuppressed) findings,
     sorted by file/line. ``rule_ids`` restricts to a subset of rules;
     ``catalog`` overrides the docs/observability.md metric catalog
@@ -365,7 +400,8 @@ def run_lint(paths: Sequence[str],
         if unknown:
             raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
         rules = {rid: rules[rid] for rid in rule_ids}
-    ctx = LintContext(repo_root=repo_root, catalog=catalog)
+    ctx = LintContext(repo_root=repo_root, catalog=catalog,
+                      knob_registry=knob_registry)
 
     modules: List[ModuleInfo] = []
     findings: List[Finding] = []

@@ -31,11 +31,12 @@ the held-stack stays truthful across waits.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 import weakref
 from typing import Dict, List, Optional, Set, Tuple
+
+from pio_tpu.utils import knobs
 
 ENV_VAR = "PIO_TPU_DEBUG_SYNC"
 
@@ -169,7 +170,7 @@ def sync_debugger() -> SyncDebugger:
 
 
 def _mode() -> str:
-    return os.environ.get(ENV_VAR, "").strip().lower()
+    return knobs.knob_str(ENV_VAR).strip().lower()
 
 
 def _armed() -> bool:

@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs import parse_duration_s
 
 #: spawned workers / subprocesses inherit the armed spec through this
@@ -167,7 +168,7 @@ def install(spec: Optional[str] = None) -> List[FaultRule]:
     resolved spec disarms (every failpoint back to inert). Trigger
     counts survive re-installs — only :func:`uninstall` clears them."""
     if spec is None:
-        spec = os.environ.get(ENV_VAR, "")
+        spec = knobs.knob_str(ENV_VAR)
     rules = parse_faults(spec) if spec else []
     global _rules, _spec
     with _lock:
@@ -296,5 +297,5 @@ def snapshot() -> dict:
 # arm from the environment at import: spawned pool workers and forked
 # test writers inherit the spec without any plumbing. A bad env spec
 # raises here — same fail-fast the CLI gives the flag form.
-if os.environ.get(ENV_VAR):
+if knobs.knob_str(ENV_VAR):
     install()

@@ -42,7 +42,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
-import os
+from pio_tpu.utils import knobs
 from pio_tpu.obs import monotonic_s, trainwatch
 from typing import Optional, Tuple
 
@@ -97,7 +97,7 @@ class ALSFactors:
 def _native_packer():
     """The C++ packer (pio_tpu/native/als_pack.cpp), or None when no
     toolchain is available (tests cover both paths)."""
-    if os.environ.get("PIO_TPU_NO_NATIVE"):
+    if knobs.knob_str("PIO_TPU_NO_NATIVE"):
         return None
     try:
         from pio_tpu.native import als_pack_lib
@@ -1050,7 +1050,7 @@ def _choose_item_wire(i_sorted, counts_u, I_pad, n_edges):
     deltas over the (user, item)-sorted adjacency, sized by a count-only
     pass (PIO_TPU_ALS_ITEM_WIRE overrides: auto/delta12/planes).
     Returns (item_wire, n_ovf, edge_item_bytes)."""
-    item_env = os.environ.get("PIO_TPU_ALS_ITEM_WIRE", "auto")
+    item_env = knobs.knob_str("PIO_TPU_ALS_ITEM_WIRE")
     plane_width = 2 if I_pad < 65536 else (3 if I_pad < 2 ** 24 else 4)
     n_ovf = None
     delta_bytes = None
@@ -1309,7 +1309,7 @@ def train_als(
         # layout construction, whose sharded outputs feed the shard_map
         # half-steps. "blocked" keeps the host-packed f32 block shipment
         # (~16× the bytes/edge) — retained as the equality reference.
-        mesh_wire = os.environ.get("PIO_TPU_ALS_MESH_WIRE", "auto")
+        mesh_wire = knobs.knob_str("PIO_TPU_ALS_MESH_WIRE")
         if mesh_wire in ("auto", "compact"):
             P_f, Q_f = _run_mesh_compact(
                 config, mesh, axis, n_shards, user_idx, item_idx, rating,

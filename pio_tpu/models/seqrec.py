@@ -499,8 +499,7 @@ def train_seqrec(
         n_stream = max(
             2,
             n_stream_chunks(12 * seqs.shape[0] * t_pad,
-                            "PIO_TPU_TRAIN_STREAM_MB",
-                            default="64", cap=256),
+                            "PIO_TPU_TRAIN_STREAM_MB", cap=256),
         )
         if budget > params_pd:
             n_stream = max(n_stream, -(-staged_pd // (budget - params_pd)))

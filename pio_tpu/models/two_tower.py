@@ -428,7 +428,7 @@ def train_two_tower(
         n_stream = max(
             2,
             n_stream_chunks(staged_nbytes, "PIO_TPU_TRAIN_STREAM_MB",
-                            default="64", cap=256),
+                            cap=256),
         )
         if budget > params_pd:
             # every span must fit in the budget headroom beside params

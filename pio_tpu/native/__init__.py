@@ -27,6 +27,8 @@ import subprocess
 import threading
 from typing import Optional
 
+from pio_tpu.utils import knobs
+
 log = logging.getLogger("pio_tpu.native")
 
 _lock = threading.Lock()
@@ -38,7 +40,7 @@ class NativeUnavailable(RuntimeError):
 
 
 def _build_dir() -> str:
-    home = os.environ.get("PIO_TPU_HOME") or os.path.expanduser("~/.pio_tpu")
+    home = knobs.knob_str("PIO_TPU_HOME") or os.path.expanduser("~/.pio_tpu")
     d = os.path.join(home, "native")
     os.makedirs(d, exist_ok=True)
     return d

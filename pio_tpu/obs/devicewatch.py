@@ -55,12 +55,12 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs.metrics import (
     REGISTRY,
     MetricsRegistry,
     monotonic_s,
 )
-from pio_tpu.utils.envutil import env_float, env_int
 
 log = logging.getLogger("pio_tpu.obs.devicewatch")
 
@@ -207,10 +207,10 @@ class DeviceWatch:
         for s in COMPILE_SITES:
             self._compile_seconds.labels(s)
         if interval_s is None:
-            interval_s = env_float(INTERVAL_ENV, DEFAULT_INTERVAL_S)
+            interval_s = knobs.knob_float(INTERVAL_ENV)
         self.interval_s = max(0.05, float(interval_s))
         if budget_bytes is None:
-            budget_bytes = env_int(BUDGET_ENV, 0)
+            budget_bytes = knobs.knob_int(BUDGET_ENV)
         self.budget_bytes = int(budget_bytes)
         self._stats_fn = stats_fn
         self._lock = threading.Lock()
@@ -452,6 +452,7 @@ class DeviceWatch:
             )
 
     # -- payload ------------------------------------------------------------
+    # pio: endpoint=/device.json
     def payload(self) -> dict:
         """The ``GET /device.json`` body (schema in
         docs/observability.md). Always samples inline — sample() is

@@ -45,10 +45,10 @@ import urllib.error
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs import promparse
 from pio_tpu.obs.metrics import MetricsRegistry, monotonic_s
 from pio_tpu.obs.promparse import ParsedMetrics
-from pio_tpu.utils.envutil import env_float
 
 #: env fallback for ``pio fleet --targets`` / embedded aggregators
 TARGETS_ENV = "PIO_TPU_FLEET_TARGETS"
@@ -156,9 +156,7 @@ class FleetAggregator:
         fetch: Optional[Callable[[str, float], bytes]] = None,
     ):
         if interval_s is None:
-            interval_s = env_float(
-                INTERVAL_ENV, DEFAULT_INTERVAL_S, positive=True
-            )
+            interval_s = knobs.knob_float(INTERVAL_ENV)
         self.interval_s = interval_s
         self.stale_after_s = (
             stale_after_s if stale_after_s is not None
@@ -381,6 +379,7 @@ class FleetAggregator:
         return promparse.render(merged)
 
     # -- /fleet.json -------------------------------------------------------
+    # pio: endpoint=/fleet.json
     def fleet_payload(self) -> dict:
         """The router contract (documented in docs/observability.md)."""
         with self._lock:

@@ -71,6 +71,7 @@ def _bucket_quantile(edges: Sequence[float], buckets: Sequence[int],
     return edges[-1] if edges else None
 
 
+# pio: endpoint=/debug/hotpath.json
 def hotpath_payload(tracer, e2e_cell, stage_order: Sequence[str] = (),
                     pool: bool = True,
                     slow_threshold_s: Optional[float] = None) -> dict:

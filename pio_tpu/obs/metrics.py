@@ -436,6 +436,7 @@ class RequestWindow:
                 self._ring[self._pos] = item
                 self._pos = (self._pos + 1) % self._cap
 
+    # pio: endpoint=/stats.json
     def to_dict(self) -> dict:
         """The classic ``/stats.json`` shape: exact cumulative count/
         errors/avg, percentiles over the ring (recent ``cap`` requests)."""
@@ -453,6 +454,7 @@ class RequestWindow:
             "p99Ms": q(0.99),
         }
 
+    # pio: endpoint=/stats.json
     def window(self, window_s: float) -> dict:
         """count/errors/avg/p50/p95/p99 over the trailing ``window_s``
         seconds (best effort: bounded by the ring capacity)."""

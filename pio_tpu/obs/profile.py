@@ -21,6 +21,8 @@ import os
 import threading
 from contextlib import contextmanager
 
+from pio_tpu.utils import knobs
+
 log = logging.getLogger("pio_tpu.obs")
 
 ENV_DIR = "PIO_TPU_PROFILE"
@@ -46,10 +48,9 @@ class DeviceProfileHook:
 
     @classmethod
     def from_env(cls) -> "DeviceProfileHook":
-        from pio_tpu.utils.envutil import env_int
 
-        directory = os.environ.get(ENV_DIR, "")
-        return cls(directory, env_int(ENV_N, 8, positive=True))
+        directory = knobs.knob_str(ENV_DIR)
+        return cls(directory, knobs.knob_int(ENV_N))
 
     @property
     def enabled(self) -> bool:

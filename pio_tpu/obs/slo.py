@@ -230,6 +230,7 @@ class SLOEngine:
         ws.add(slo.window_s)
         return sorted(ws)
 
+    # pio: endpoint=/slo.json
     def evaluate(self, now: Optional[float] = None,
                  take_sample: bool = True) -> dict:
         """The ``GET /slo.json`` body: per objective, cumulative totals,

@@ -38,11 +38,11 @@ import datetime as _dt
 import io
 import json
 import logging
-import os
 import sys
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs.metrics import REGISTRY
 
 #: the active (trace_id, span) for THIS thread/task — set by Tracer.trace
@@ -210,11 +210,10 @@ def install(stream: Optional[io.TextIOBase] = None,
     global _handler
     with _install_lock:
         if _handler is None:
-            if stream is None and os.environ.get("PIO_TPU_LOG_JSON") == "1":
+            if stream is None and knobs.knob_str("PIO_TPU_LOG_JSON") == "1":
                 stream = sys.stderr
-            from pio_tpu.utils.envutil import env_int
 
-            ring = LogRing(env_int("PIO_TPU_LOG_RING", DEFAULT_RING))
+            ring = LogRing(knobs.knob_int("PIO_TPU_LOG_RING"))
             _handler = JsonLogHandler(ring, stream=stream, worker=worker)
             target = logging.getLogger(logger_name)
             target.addHandler(_handler)
@@ -247,6 +246,7 @@ def exposition_lines() -> List[str]:
     return _LOG_MESSAGES.render(pool=False)
 
 
+# pio: endpoint=/logs.json
 def logs_payload(n: int = 100, level: Optional[str] = None,
                  trace_id: Optional[str] = None,
                  logger: Optional[str] = None) -> Dict[str, object]:

@@ -38,6 +38,7 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs.metrics import REGISTRY, monotonic_s
 
 #: steps retired by training loops (streamed or staged), per algorithm
@@ -183,6 +184,7 @@ class StepRecorder:
 
     # -- reads (sidecar / registry side) --------------------------------
 
+    # pio: endpoint=/train.json
     def payload(self) -> dict:
         """The ``/train.json`` body (see docs/observability.md)."""
         from pio_tpu.faults import failpoint
@@ -400,7 +402,7 @@ RUN_FIELDS: Tuple[Tuple[str, str], ...] = (
 
 
 def runs_path(engine_id: str) -> str:
-    home = os.environ.get("PIO_TPU_HOME") or os.path.expanduser("~/.pio_tpu")
+    home = knobs.knob_str("PIO_TPU_HOME") or os.path.expanduser("~/.pio_tpu")
     return os.path.join(home, "runs", f"{engine_id}.jsonl")
 
 

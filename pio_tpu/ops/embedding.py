@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pio_tpu.utils import knobs
 from pio_tpu.utils.numutil import round_up as _round_up
 
 
@@ -214,11 +215,8 @@ _PALLAS_OVER_MB_DEFAULT = 2048.0
 
 
 def _pallas_cutoff_bytes() -> float:
-    from pio_tpu.utils.envutil import env_float
 
-    return env_float(
-        "PIO_TPU_EMBED_PALLAS_OVER_MB", _PALLAS_OVER_MB_DEFAULT
-    ) * 2 ** 20
+    return knobs.knob_float("PIO_TPU_EMBED_PALLAS_OVER_MB") * 2 ** 20
 
 
 def _use_pallas(table) -> bool:

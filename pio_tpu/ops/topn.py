@@ -32,6 +32,7 @@ from __future__ import annotations
 import ctypes
 import functools
 import os
+from pio_tpu.utils import knobs
 from pio_tpu.obs import monotonic_s
 from typing import Optional, Tuple
 
@@ -126,7 +127,7 @@ def _pairs_fn():
 
 
 def _env_mode() -> str:
-    env = os.environ.get("PIO_TPU_SERVE_DEVICE", "auto").lower()
+    env = knobs.knob_str("PIO_TPU_SERVE_DEVICE").lower()
     if env in ("1", "true", "yes", "device"):
         return "device"
     if env in ("0", "false", "no", "host"):

@@ -25,6 +25,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from pio_tpu.utils import knobs
+
 _initialized = False
 
 
@@ -47,15 +49,15 @@ def maybe_initialize(
     if _initialized:
         return True
 
-    coordinator = coordinator or os.environ.get("PIO_TPU_COORDINATOR")
+    coordinator = coordinator or knobs.knob_raw("PIO_TPU_COORDINATOR")
     if coordinator is None:
         # Single host. (On TPU pods with a metadata server, set
         # PIO_TPU_COORDINATOR or call jax.distributed.initialize() yourself
         # before any JAX use.)
         return False
-    num_str = os.environ.get("PIO_TPU_NUM_PROCESSES")
+    num_str = knobs.knob_raw("PIO_TPU_NUM_PROCESSES")
     num_processes = num_processes or (int(num_str) if num_str else None)
-    pid_str = os.environ.get("PIO_TPU_PROCESS_ID")
+    pid_str = knobs.knob_raw("PIO_TPU_PROCESS_ID")
     process_id = process_id if process_id is not None else (
         int(pid_str) if pid_str else None
     )

@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 
 import numpy as np
 
-from pio_tpu.utils.envutil import env_int
+from pio_tpu.utils import knobs
 
 #: Per-device parameter budget (bytes); 0 = unlimited. The OOM guard the
 #: multichip proof leans on: set it below total model size and only a
@@ -240,7 +240,7 @@ register_partition_rules("seqrec", _seqrec_rules)
 
 def device_budget_bytes() -> int:
     """Per-device parameter budget from the env; 0 = unlimited."""
-    return env_int(DEVICE_BUDGET_ENV, 0)
+    return knobs.knob_int(DEVICE_BUDGET_ENV)
 
 
 def tree_nbytes(tree: Any) -> int:

@@ -48,7 +48,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Sequence
 
 from pio_tpu.obs import REGISTRY
-from pio_tpu.utils.envutil import env_float
+from pio_tpu.utils import knobs
 
 #: host→device bytes shipped by the streamed training feed (all
 #: stream_feed callers: two-tower/seqrec batch spans, ALS wire chunks)
@@ -71,8 +71,12 @@ def n_stream_chunks(n_bytes: int, env_var: str, default: str = "8",
     chunk_mb)`` capped at ``cap``; 1 (streaming off) when the env knob
     is ≤ 0. THE sizing rule for every streamed wire (ALS edges, logreg
     features, training batch spans) so the threshold semantics can't
-    drift — ``utils.numutil.n_stream_chunks`` delegates here."""
-    mb = env_float(env_var, float(default))
+    drift — ``utils.numutil.n_stream_chunks`` delegates here.
+
+    Registered knobs take their default from the canonical registry
+    (``pio_tpu.utils.knobs``); ``default`` applies only to scratch env
+    names tests invent."""
+    mb = knobs.knob_float(env_var, fallback=float(default))
     if mb <= 0:
         return 1
     return int(min(cap, -(-n_bytes // max(1, int(mb * 2 ** 20)))))

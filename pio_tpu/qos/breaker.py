@@ -224,6 +224,7 @@ class CircuitBreaker:
         if len(self._outcomes) > self.window:
             del self._outcomes[0]
 
+    # pio: endpoint=/qos.json
     def snapshot(self) -> dict:
         with self._lock:
             self._maybe_half_open_locked()

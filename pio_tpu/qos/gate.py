@@ -224,6 +224,7 @@ class QoSGate:
         self.degraded_total.inc(scope=self.scope)
 
     # -- /qos.json ---------------------------------------------------------
+    # pio: endpoint=/qos.json
     def snapshot(self) -> dict:
         out = {
             "enabled": True,

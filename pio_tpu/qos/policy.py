@@ -13,6 +13,7 @@ import dataclasses
 import os
 from typing import Any, Dict, Optional, Union
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs import parse_duration_s
 
 
@@ -80,6 +81,7 @@ class QoSPolicy:
             return self.key_burst
         return max(self.key_rps or 0.0, 1.0)
 
+    # pio: endpoint=/qos.json
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
         d["priorities"] = dict(PRIORITY_FLOORS)
@@ -160,7 +162,7 @@ def resolve_policy(
         return spec
     if spec:
         return parse_qos(spec)
-    env = os.environ.get("PIO_TPU_QOS")
+    env = knobs.knob_str("PIO_TPU_QOS")
     if env:
         return parse_qos(env)
     block = (variant or {}).get("qos")

@@ -38,12 +38,12 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 from urllib.parse import urlsplit
 
+from pio_tpu.utils import knobs
 from pio_tpu.faults import failpoint
 from pio_tpu.obs import monotonic_s
 from pio_tpu.obs.metrics import MetricsRegistry
 from pio_tpu.qos.policy import priority_floor
 from pio_tpu.router.ring import Ring
-from pio_tpu.utils.envutil import env_float
 
 log = logging.getLogger("pio_tpu.router")
 
@@ -218,16 +218,14 @@ class ServingRouter:
             raise ValueError("router needs at least one member target")
         self.burn_limit = (
             burn_limit if burn_limit is not None
-            else env_float(BURN_LIMIT_ENV, DEFAULT_BURN_LIMIT, positive=True)
+            else knobs.knob_float(BURN_LIMIT_ENV)
         )
         self.lag_soft_bytes = (
             lag_soft_bytes if lag_soft_bytes is not None
-            else env_float(
-                LAG_SOFT_ENV, float(DEFAULT_LAG_SOFT_BYTES), positive=True
-            )
+            else knobs.knob_float(LAG_SOFT_ENV)
         )
         if hedge_ms is None:
-            hedge_ms = env_float(HEDGE_ENV, 0.0)
+            hedge_ms = knobs.knob_float(HEDGE_ENV)
         self.hedge_s = max(float(hedge_ms), 0.0) / 1e3
         self.timeout_s = timeout_s
         self.forced_down_s = forced_down_s
@@ -385,6 +383,7 @@ class ServingRouter:
         return pool.request(method, path, body, headers)
 
     # -- health/load ingestion --------------------------------------------
+    # pio: consumes=/fleet.json
     def ingest_fleet(self, payload: dict) -> None:
         """Fold a ``fleet_payload()`` snapshot into the member table:
         scrape status, per-member worst burn, worst follower lag."""
@@ -656,6 +655,7 @@ class ServingRouter:
             pass
 
     # -- introspection -----------------------------------------------------
+    # pio: endpoint=/router.json
     def snapshot(self) -> dict:
         """The ``/router.json`` member/ring view (schema documented in
         docs/observability.md)."""

@@ -417,6 +417,7 @@ class RolloutController:
                 return
 
     # -- trail / transitions -------------------------------------------------
+    # pio: endpoint=/rollout.json
     def _transition(self, to: str, signal: str, detail: str = "",
                     window: Optional[str] = None) -> None:
         with self._lock:
@@ -881,6 +882,7 @@ class RolloutController:
                 pass
 
     # -- /rollout.json -------------------------------------------------------
+    # pio: endpoint=/rollout.json
     def payload(self) -> dict:
         """The ``GET /rollout.json`` body (schema in
         docs/observability.md); federated into ``/fleet.json``."""

@@ -72,9 +72,9 @@ import struct
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.faults import failpoint
 from pio_tpu.obs.metrics import monotonic_s
-from pio_tpu.utils import envutil
 
 log = logging.getLogger("pio_tpu.batchlane")
 
@@ -180,11 +180,11 @@ class BatchLaneSegment:
     def create(cls, path: str, n_workers: int,
                slots_per_worker: int = 0,
                payload_bytes: int = 0) -> "BatchLaneSegment":
-        slots_per_worker = slots_per_worker or envutil.env_int(
-            "PIO_TPU_LANE_SLOTS", DEFAULT_SLOTS, positive=True
+        slots_per_worker = slots_per_worker or knobs.knob_int(
+            "PIO_TPU_LANE_SLOTS"
         )
-        payload_bytes = payload_bytes or envutil.env_int(
-            "PIO_TPU_LANE_SLOT_BYTES", DEFAULT_PAYLOAD_BYTES, positive=True
+        payload_bytes = payload_bytes or knobs.knob_int(
+            "PIO_TPU_LANE_SLOT_BYTES"
         )
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -338,8 +338,8 @@ class LaneClient:
         self._idx = worker_idx
         self._doorbell = doorbell
         self._resp_event = resp_event
-        self._timeout_s = timeout_s or envutil.env_float(
-            "PIO_TPU_LANE_TIMEOUT_S", 0.25, positive=True
+        self._timeout_s = timeout_s or knobs.knob_float(
+            "PIO_TPU_LANE_TIMEOUT_S"
         )
         self._alloc_lock = threading.Lock()
         #: slots this process believes are in flight (its own stripe —

@@ -35,6 +35,7 @@ import html as _html
 import json
 from typing import Any, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs import HealthMonitor, MetricsRegistry
 from pio_tpu.obs import slog
 from pio_tpu.obs.promparse import ParsedMetrics, parse_prometheus_text
@@ -79,7 +80,7 @@ class DashboardService:
         #: base URL of a `pio train` status sidecar whose /train.json
         #: the /training.html view follows
         self.train_url = (
-            train_url or _os0.environ.get("PIO_TPU_TRAIN_STATUS_URL", "")
+            train_url or knobs.knob_str("PIO_TPU_TRAIN_STATUS_URL")
         ).rstrip("/")
         self.obs = MetricsRegistry()
         self._pageviews = self.obs.counter(

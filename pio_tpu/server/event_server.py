@@ -22,11 +22,11 @@ from __future__ import annotations
 
 import datetime as _dt
 import logging
-import os
 import threading
 from contextlib import nullcontext
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.analysis.runtime import make_lock
 from pio_tpu.data.event import Event, EventValidationError
 from pio_tpu.obs import (
@@ -202,7 +202,7 @@ class EventServerService:
         self.health.add_readiness("storage", self._check_storage_ready)
         # -- SLO engine (optional; specs from the caller or PIO_TPU_SLO) --
         if slos is None:
-            env_slos = os.environ.get("PIO_TPU_SLO", "")
+            env_slos = knobs.knob_str("PIO_TPU_SLO")
             slos = [s for s in env_slos.split(",") if s.strip()]
         self.slo = None
         if slos:
@@ -772,9 +772,8 @@ class EventServerService:
         """Slow-trace capture threshold in seconds (see the query
         server's twin): env override, tightest latency SLO, or the live
         p99 once the distribution has enough mass."""
-        from pio_tpu.utils import envutil
 
-        ms = envutil.env_float("PIO_TPU_SLOW_TRACE_MS", 0.0)
+        ms = knobs.knob_float("PIO_TPU_SLOW_TRACE_MS")
         if ms > 0:
             return ms / 1e3
         slo = self.slo

@@ -47,6 +47,7 @@ from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs.metrics import monotonic_s
 from pio_tpu.server import http as _http
 from pio_tpu.server.http import (
@@ -60,7 +61,6 @@ from pio_tpu.server.http import (
     json_response,
     ssl_context_from_env,
 )
-from pio_tpu.utils import envutil
 
 log = logging.getLogger("pio_tpu.server.evfront")
 
@@ -174,9 +174,7 @@ class EvLoopHTTPServer:
         self._name = name
         self._pre_body = pre_body
         self._idle_timeout_s = _http.http_idle_timeout_s()
-        self._max_pipeline = envutil.env_int(
-            "PIO_TPU_HTTP_MAX_PIPELINE", 16, positive=True
-        )
+        self._max_pipeline = knobs.knob_int("PIO_TPU_HTTP_MAX_PIPELINE")
         self._static_head: Dict[int, bytes] = {}
         self._conns: Dict[int, _Conn] = {}
         self._sel = selectors.DefaultSelector()

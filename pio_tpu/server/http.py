@@ -30,6 +30,7 @@ from http.server import ThreadingHTTPServer
 from typing import Any, BinaryIO, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs.metrics import monotonic_s
 from pio_tpu.utils import envutil
 
@@ -68,7 +69,7 @@ def http_backlog() -> int:
     between server boots. socketserver's default of 5 overflowed under
     a 16-client connect burst; 128 keeps dropped-SYN retransmits out of
     the serving p95."""
-    return envutil.env_int("PIO_TPU_HTTP_BACKLOG", 128, positive=True)
+    return knobs.knob_int("PIO_TPU_HTTP_BACKLOG")
 
 
 def http_idle_timeout_s() -> float:
@@ -76,8 +77,7 @@ def http_idle_timeout_s() -> float:
     produces no bytes for this long is closed. On the threaded front it
     bounds how long a parked per-connection thread survives; on the
     event loop it bounds the connection table."""
-    return envutil.env_float("PIO_TPU_HTTP_IDLE_TIMEOUT_S", 30.0,
-                             positive=True)
+    return knobs.knob_float("PIO_TPU_HTTP_IDLE_TIMEOUT_S")
 
 
 #: Content type of the packed int8 binary query wire: the request body
@@ -712,11 +712,11 @@ def ssl_context_from_env() -> Optional[ssl.SSLContext]:
     (PEM paths, keyfile optional if the cert bundles the key) switch every
     server built through :class:`JsonHTTPServer` to HTTPS.
     """
-    cert = os.environ.get("PIO_TPU_SSL_CERTFILE")
+    cert = knobs.knob_str("PIO_TPU_SSL_CERTFILE")
     if not cert:
         return None
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-    ctx.load_cert_chain(cert, os.environ.get("PIO_TPU_SSL_KEYFILE") or None)
+    ctx.load_cert_chain(cert, knobs.knob_str("PIO_TPU_SSL_KEYFILE") or None)
     return ctx
 
 

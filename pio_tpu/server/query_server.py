@@ -23,11 +23,11 @@ import dataclasses
 import datetime as _dt
 import json
 import logging
-import os
 import threading
 import uuid
 from typing import Any, List, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.analysis.runtime import make_condition, make_lock
 from pio_tpu.controller.engine import Engine, EngineParams
 from pio_tpu.controller.params import ParamsError, params_from_dict
@@ -47,7 +47,6 @@ from pio_tpu.qos import (
     Deadline, DeadlineExceeded, QoSGate, cache_key, resolve_policy,
     retry_after_header,
 )
-from pio_tpu.utils import envutil
 from pio_tpu.server.batchlane import (
     BatchLaneSegment, LaneClient, LaneDrainer, LaneFallback, PackedQuery,
     pack_query_i8, packed_frame_ok, unpack_query_i8,
@@ -183,7 +182,7 @@ class _MicroBatcher:
         #: can catch compile transients / cold caches that a warmed
         #: server has long outgrown — "off" is a lease, not a latch
         #: (0 disables re-probing and restores the one-shot behavior)
-        self._reprobe_s = envutil.env_float("PIO_TPU_MB_REPROBE_S", 30.0)
+        self._reprobe_s = knobs.knob_float("PIO_TPU_MB_REPROBE_S")
         self._decided_at = 0.0
         self.reprobes = 0
         self._probe_lock = make_lock("query.microbatch.probe")
@@ -579,8 +578,8 @@ class QueryServerService:
 
         self.obs.add_collector(_faults.exposition_lines)
         # -- health probes (ISSUE 2) --
-        self.heartbeat = Heartbeat(max_age_s=envutil.env_float(
-            "PIO_TPU_HEARTBEAT_MAX_AGE_S", 30.0, positive=True
+        self.heartbeat = Heartbeat(max_age_s=knobs.knob_float(
+            "PIO_TPU_HEARTBEAT_MAX_AGE_S"
         ))
         self.health = HealthMonitor()
         self.health.add_liveness("http_loop", self._http_loop_alive)
@@ -592,7 +591,7 @@ class QueryServerService:
         self.health.add_readiness("storage", self._check_storage_ready)
         # -- SLO engine (ISSUE 2): specs from the caller or PIO_TPU_SLO --
         if slos is None:
-            env_slos = os.environ.get("PIO_TPU_SLO", "")
+            env_slos = knobs.knob_str("PIO_TPU_SLO")
             slos = [s for s in env_slos.split(",") if s.strip()]
         self.slo = None
         if slos:
@@ -768,7 +767,7 @@ class QueryServerService:
         # thread off — /device.json then samples on demand).
         self.devwatch = devicewatch.DeviceWatch(registry=self.obs)
         devicewatch.activate(self.devwatch)
-        if os.environ.get(devicewatch.SAMPLER_ENV, "1") != "0":
+        if knobs.knob_str(devicewatch.SAMPLER_ENV) != "0":
             self.devwatch.start()
         self.profile_hook = DeviceProfileHook.from_env()
         self._swap_lock = make_lock("query.model_swap")
@@ -790,9 +789,9 @@ class QueryServerService:
         #: undeploy` terminates the server process, not just the flag)
         self._server = None
         self._load(instance_id)
-        window_us = envutil.env_float("PIO_TPU_SERVE_MICROBATCH_US", 0.0)
-        adaptive = os.environ.get(
-            "PIO_TPU_SERVE_MICROBATCH_ADAPTIVE", "1"
+        window_us = knobs.knob_float("PIO_TPU_SERVE_MICROBATCH_US")
+        adaptive = knobs.knob_str(
+            "PIO_TPU_SERVE_MICROBATCH_ADAPTIVE"
         ) != "0"
         self._batcher = (
             _MicroBatcher(self, window_us / 1e6, adaptive=adaptive)
@@ -894,7 +893,7 @@ class QueryServerService:
         context mesh; ``0``/unset keeps the single-device placement every
         existing deploy runs (sharding changes device placement, so it is
         opt-in per server, not inferred from mesh presence)."""
-        flag = os.environ.get("PIO_TPU_MESH_SERVE", "0").strip().lower()
+        flag = knobs.knob_str("PIO_TPU_MESH_SERVE").strip().lower()
         if flag not in ("1", "on", "true"):
             return None
         mesh = self.ctx.mesh
@@ -1031,12 +1030,12 @@ class QueryServerService:
         without the env) must not pay len(buckets) compiles at boot.
         ``PIO_TPU_BUCKET_WARMUP=0`` force-disables, ``=1``
         force-enables."""
-        flag = os.environ.get("PIO_TPU_BUCKET_WARMUP", "")
+        flag = knobs.knob_str("PIO_TPU_BUCKET_WARMUP")
         if flag == "0":
             return False
         if flag == "1":
             return True
-        if envutil.env_float("PIO_TPU_SERVE_MICROBATCH_US", 0.0) > 0:
+        if knobs.knob_float("PIO_TPU_SERVE_MICROBATCH_US") > 0:
             return True
         return self._lane_drainer is not None
 
@@ -1215,7 +1214,7 @@ class QueryServerService:
     def _slow_threshold_s(self) -> Optional[float]:
         """The slow-trace capture threshold in seconds, or None while
         there is no basis for one (fresh server, no SLO declared)."""
-        ms = envutil.env_float("PIO_TPU_SLOW_TRACE_MS", 0.0)
+        ms = knobs.knob_float("PIO_TPU_SLOW_TRACE_MS")
         if ms > 0:
             return ms / 1e3
         slo = self.slo
@@ -2376,8 +2375,8 @@ def create_query_server(
         variant, instance_id, ctx, feedback, feedback_app_id, admin_key,
         slos=slos, qos=qos,
     )
-    front = os.environ.get(
-        "PIO_TPU_HTTP_FRONT", "threaded"
+    front = knobs.knob_str(
+        "PIO_TPU_HTTP_FRONT"
     ).strip().lower() or "threaded"
     if front not in ("threaded", "evloop"):
         log.warning(

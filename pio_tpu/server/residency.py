@@ -53,6 +53,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from pio_tpu.utils import knobs
 from pio_tpu.faults import failpoint
 from pio_tpu.obs import devicewatch
 
@@ -69,7 +70,7 @@ def enabled() -> bool:
     ``=0`` forces off; the ``auto`` default enables residency only on a
     real accelerator backend — on CPU the host-numpy predict path is
     already resident by definition and existing deploys keep it."""
-    flag = os.environ.get("PIO_TPU_DEVICE_RESIDENT", "auto").strip().lower()
+    flag = knobs.knob_str("PIO_TPU_DEVICE_RESIDENT").strip().lower()
     if flag in ("0", "off", "false"):
         return False
     if flag in ("1", "on", "true"):
@@ -85,7 +86,7 @@ def enabled() -> bool:
 def wire_mode(has_scales: bool) -> str:
     """Resolve the serving feature wire: the ``PIO_TPU_SERVE_WIRE``
     override, else int8 whenever training scales exist to fold."""
-    raw = os.environ.get("PIO_TPU_SERVE_WIRE", "auto").strip().lower()
+    raw = knobs.knob_str("PIO_TPU_SERVE_WIRE").strip().lower()
     if raw == WIRE_INT8:
         return WIRE_INT8 if has_scales else WIRE_FLOAT32
     if raw == WIRE_FLOAT32:
@@ -445,6 +446,7 @@ class ResidentLinearScorer:
         return np.asarray(codes)
 
     # -- introspection -----------------------------------------------------
+    # pio: endpoint=/stats.json
     def to_dict(self) -> dict:
         total = self.donation_hits + self.donation_misses
         return {

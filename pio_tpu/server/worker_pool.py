@@ -54,6 +54,7 @@ import tempfile
 import time
 from typing import Optional
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs.metrics import monotonic_s
 from pio_tpu.workflow.engine_json import EngineVariant
 
@@ -330,7 +331,7 @@ class ServingPool:
         self._lane_resp_events = None
         if (
             (device_worker or mesh_worker) and n_workers > 1
-            and os.environ.get("PIO_TPU_BATCH_LANE", "1") != "0"
+            and knobs.knob_str("PIO_TPU_BATCH_LANE") != "0"
         ):
             try:
                 from pio_tpu.server.batchlane import BatchLaneSegment

@@ -38,6 +38,7 @@ import tempfile
 from typing import Callable, Dict, List, Optional
 from urllib.parse import quote, urlparse
 
+from pio_tpu.utils import knobs
 from pio_tpu.faults import failpoint
 from pio_tpu.storage import base
 from pio_tpu.storage.durability import fsync_fileobj, replace_durable
@@ -172,7 +173,7 @@ class HTTPBlobBackend(BlobBackend):
             qs = parse_qs(parts.query)
             access_key = (qs.get("accessKey") or [None])[0]
             if access_key is None:
-                access_key = os.environ.get("PIO_TPU_BLOB_ACCESS_KEY")
+                access_key = knobs.knob_raw("PIO_TPU_BLOB_ACCESS_KEY")
         self._key_hdr = access_key
         self.base = urlunsplit(
             (parts.scheme, parts.netloc, parts.path.rstrip("/"), "", "")

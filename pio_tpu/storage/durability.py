@@ -27,6 +27,7 @@ import os
 import threading
 from typing import Dict
 
+from pio_tpu.utils import knobs
 from pio_tpu.obs import monotonic_s
 
 ENV_VAR = "PIO_TPU_DURABILITY"
@@ -41,7 +42,7 @@ def mode() -> str:
     """Effective durability mode; raises ValueError on an unknown value
     (misconfigured durability must be loud — a typo'd mode silently
     running ``os`` would void the ack guarantee the operator asked for)."""
-    v = os.environ.get(ENV_VAR, DEFAULT).strip().lower() or DEFAULT
+    v = knobs.knob_str(ENV_VAR).strip().lower() or DEFAULT
     if v not in MODES:
         raise ValueError(
             f"{ENV_VAR}={v!r} is not one of {'|'.join(MODES)}"

@@ -35,6 +35,7 @@ import threading
 import zlib
 from typing import Dict, List, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.data.datamap import DataMap
 from pio_tpu.data.event import Event, _parse_time
 from pio_tpu.faults import failpoint
@@ -45,7 +46,6 @@ from pio_tpu.storage.durability import (
 from pio_tpu.storage.memory import _match
 from pio_tpu.storage.partlog import compaction, framing, replication
 from pio_tpu.storage.partlog.segments import SegmentLog
-from pio_tpu.utils.envutil import env_int
 from pio_tpu.utils.timeutil import to_micros
 
 PARTITIONS_VAR = "PIO_TPU_PARTLOG_PARTITIONS"
@@ -193,8 +193,8 @@ class PartitionedEventLog(base.LEvents):
             # the manifest wins: repartitioning an existing root would
             # strand every record routed under the old N
             return n
-        n = partitions if partitions is not None else env_int(
-            PARTITIONS_VAR, DEFAULT_PARTITIONS, positive=True
+        n = partitions if partitions is not None else knobs.knob_int(
+            PARTITIONS_VAR
         )
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -483,6 +483,7 @@ class PartitionedEventLog(base.LEvents):
         return out
 
     # -- topology ------------------------------------------------------------
+    # pio: endpoint=/storage.json
     def topology(self) -> dict:
         """The ``/storage.json`` payload: router + per-partition stream
         state + replication positions."""

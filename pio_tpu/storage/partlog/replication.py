@@ -38,6 +38,7 @@ import struct
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.faults import FaultInjected, failpoint
 from pio_tpu.obs import REGISTRY, monotonic_s
 from pio_tpu.qos.deadline import Deadline
@@ -45,7 +46,7 @@ from pio_tpu.storage import base
 from pio_tpu.storage.durability import IntervalSyncer
 from pio_tpu.storage.partlog import framing
 from pio_tpu.storage.retry import is_transient, retrying
-from pio_tpu.utils.envutil import env_float, env_int
+from pio_tpu.utils.envutil import env_int
 
 log = logging.getLogger("pio_tpu.partlog.repl")
 
@@ -92,7 +93,7 @@ _ACK_SECONDS = REGISTRY.histogram(
 
 def replica_addrs() -> List[Tuple[str, int]]:
     """Parse :data:`REPLICAS_VAR`; bad entries are dropped loudly."""
-    raw = os.environ.get(REPLICAS_VAR, "").strip()
+    raw = knobs.knob_str(REPLICAS_VAR).strip()
     out: List[Tuple[str, int]] = []
     if not raw:
         return out
@@ -314,9 +315,7 @@ class _FollowerLink:
                  self.label, pos)
 
     def _run(self) -> None:
-        deadline_s = env_float(
-            CONNECT_DEADLINE_VAR, 10.0, positive=True
-        )
+        deadline_s = knobs.knob_float(CONNECT_DEADLINE_VAR)
         while not self.owner.stopped.is_set():
             if self.sock is None:
                 try:
@@ -418,6 +417,10 @@ class Replicator:
         self.partitions = owner.partitions
         self.stopped = threading.Event()
         self._wake = threading.Condition()
+        # the unset default is topology-dependent (1 when replicas are
+        # configured, 0 standalone) — a computed default the static
+        # registry cannot express, so this one read stays on env_int
+        # pio: disable=knob-default-drift
         self.min_acks = env_int(
             MIN_ACKS_VAR, 1 if addrs else 0, positive=False
         )
@@ -430,9 +433,7 @@ class Replicator:
                 f"{len(addrs)} replica(s) configured in {REPLICAS_VAR}: "
                 "commit durability could never collect that many acks"
             )
-        self.ack_timeout_s = env_float(
-            ACK_TIMEOUT_VAR, DEFAULT_ACK_TIMEOUT_S, positive=True
-        )
+        self.ack_timeout_s = knobs.knob_float(ACK_TIMEOUT_VAR)
         self._links = [
             _FollowerLink(self, a, self._wake) for a in addrs
         ]
@@ -481,6 +482,7 @@ class Replicator:
                     )
                 self._wake.wait(timeout=remaining)
 
+    # pio: endpoint=/storage.json
     def lag_snapshot(self) -> List[dict]:
         """Topology view: per (follower, partition) acked positions."""
         out = []

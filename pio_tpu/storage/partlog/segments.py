@@ -24,12 +24,12 @@ import re
 import threading
 from typing import List, Optional, Tuple
 
+from pio_tpu.utils import knobs
 from pio_tpu.faults import failpoint
 from pio_tpu.obs import REGISTRY
 from pio_tpu.storage import base
 from pio_tpu.storage.durability import IntervalSyncer, fsync_fileobj
 from pio_tpu.storage.partlog import framing
-from pio_tpu.utils.envutil import env_int
 
 #: active segment seals once it reaches this many bytes (the blob that
 #: crosses the line still lands whole — records never split segments)
@@ -60,8 +60,9 @@ class SegmentLog:
         self.partition = partition
         self._label = str(partition)
         self._syncer = syncer or IntervalSyncer()
-        self._seg_bytes = seg_bytes if seg_bytes is not None else env_int(
-            SEGMENT_BYTES_VAR, DEFAULT_SEGMENT_BYTES, positive=True
+        self._seg_bytes = (
+            seg_bytes if seg_bytes is not None
+            else knobs.knob_int(SEGMENT_BYTES_VAR)
         )
         self._lock = threading.RLock()
         os.makedirs(pdir, exist_ok=True)

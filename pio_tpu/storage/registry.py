@@ -35,6 +35,7 @@ import os
 import threading
 from typing import Dict, Optional
 
+from pio_tpu.utils import knobs
 from pio_tpu.storage import base
 from pio_tpu.storage.localfs import LocalFSModels
 from pio_tpu.storage.memory import (
@@ -71,7 +72,7 @@ _homes_made: set = set()
 
 
 def pio_home() -> str:
-    home = os.environ.get("PIO_TPU_HOME")
+    home = knobs.knob_str("PIO_TPU_HOME")
     if not home:
         home = os.path.join(os.path.expanduser("~"), ".pio_tpu")
     if home not in _homes_made:  # once per path — this sits on the

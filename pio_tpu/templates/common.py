@@ -13,6 +13,7 @@ from typing import FrozenSet, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from pio_tpu.utils import knobs
 from pio_tpu.storage import Storage
 
 
@@ -125,7 +126,7 @@ def eval_app_name(app_name: str) -> str:
     one contract shared by every template's evaluation factory."""
     import os
 
-    return app_name or os.environ.get("PIO_TPU_EVAL_APP", "")
+    return app_name or knobs.knob_str("PIO_TPU_EVAL_APP")
 
 
 def resolve_app(params) -> Tuple[int, Optional[int]]:

@@ -21,6 +21,8 @@ from typing import Optional
 
 import pio_tpu
 
+from pio_tpu.utils import knobs
+
 
 def _out(s: str = ""):
     print(s)
@@ -233,9 +235,7 @@ def cmd_train(args) -> int:
     ctx = ComputeContext.create(seed=args.seed)
     status_port = args.status_port
     if status_port is None:
-        status_port = int(
-            os.environ.get("PIO_TPU_TRAIN_STATUS_PORT", "0") or 0
-        )
+        status_port = knobs.knob_int("PIO_TPU_TRAIN_STATUS_PORT")
     status_server = None
     if status_port >= 0:
         from pio_tpu.server.fleetd import create_train_status_server
@@ -1027,7 +1027,8 @@ def cmd_lint(args) -> int:
         return 0
 
     paths = args.paths or ["pio_tpu", "tests"]
-    if args.dump_failpoints or args.dump_callgraph or args.dump_effects:
+    if args.dump_failpoints or args.dump_callgraph or args.dump_effects \
+            or args.dump_contracts:
         modules = []
         for path in collect_files(paths):
             parsed = parse_module(path)
@@ -1038,6 +1039,10 @@ def cmd_lint(args) -> int:
         elif args.dump_callgraph:
             from pio_tpu.analysis.effects import callgraph_inventory
             payload = {"callgraph": callgraph_inventory(modules)}
+        elif args.dump_contracts:
+            from pio_tpu.analysis.contracts import contracts_inventory
+            from pio_tpu.analysis.core import LintContext
+            payload = contracts_inventory(modules, LintContext())
         else:
             from pio_tpu.analysis.effects import (
                 effects_inventory,
@@ -1052,7 +1057,7 @@ def cmd_lint(args) -> int:
     if args.changed:
         only = _changed_py_files(args.base)
         if only is not None and not only:
-            print("pio lint: no changed python files")
+            print("pio lint: no changed python or docs files")
             return 0
 
     rule_ids = args.rules.split(",") if args.rules else None
@@ -1066,8 +1071,11 @@ def cmd_lint(args) -> int:
 
 
 def _changed_py_files(base: str):
-    """``git diff --name-only <base>`` filtered to .py, as absolute
-    paths — or None (fall back to a full lint) when git is unavailable."""
+    """``git diff --name-only <base>`` filtered to .py plus docs/*.md,
+    as absolute paths — or None (fall back to a full lint) when git is
+    unavailable. Docs count: the knob table in docs/operations.md is a
+    linted contract surface (knob-doc-drift), so a docs-only change
+    must still re-lint contracts instead of early-exiting."""
     import subprocess
     try:
         out = subprocess.run(
@@ -1086,6 +1094,7 @@ def _changed_py_files(base: str):
         os.path.join(top, line)
         for line in out.splitlines()
         if line.endswith(".py")
+        or (line.endswith(".md") and line.startswith("docs/"))
     ]
 
 
@@ -1559,6 +1568,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--dump-effects", action="store_true",
         help="hot-path roots, per-function effect summaries and "
              "frame-family census as JSON",
+    )
+    a.add_argument(
+        "--dump-contracts", action="store_true",
+        help="extracted cross-surface inventory as JSON: endpoint "
+             "payload keys with producers/consumers, X-Pio-* header "
+             "flows, and PIO_TPU_* knob sites joined against the "
+             "canonical registry",
     )
     a.add_argument(
         "--changed", action="store_true",

@@ -22,11 +22,13 @@ import logging
 import os
 from typing import Any, Optional, Tuple
 
+from pio_tpu.utils import knobs
+
 log = logging.getLogger("pio_tpu.workflow.checkpoint")
 
 
 def default_checkpoint_dir(instance_id: str) -> str:
-    home = os.environ.get("PIO_TPU_HOME") or os.path.expanduser("~/.pio_tpu")
+    home = knobs.knob_str("PIO_TPU_HOME") or os.path.expanduser("~/.pio_tpu")
     return os.path.join(home, "checkpoints", instance_id)
 
 

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pio_tpu.utils import knobs
 from pio_tpu.faults import failpoint
 from pio_tpu.obs import REGISTRY
 from pio_tpu.storage import Model
@@ -53,7 +54,7 @@ _SHARD_RESHARD = REGISTRY.counter(
 
 
 def _env_on() -> bool:
-    return os.environ.get("PIO_TPU_SHARDED_PERSIST", "0") == "1"
+    return knobs.knob_str("PIO_TPU_SHARDED_PERSIST") == "1"
 
 
 @dataclasses.dataclass(frozen=True)

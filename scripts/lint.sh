@@ -13,7 +13,11 @@
 #
 # Other flags pass through to `pio lint` (--rules ID[,ID...],
 # --list-rules, --base REV, --dump-failpoints, --dump-callgraph,
-# --dump-effects).
+# --dump-effects, --dump-contracts).
+#
+# The changed-files fast path includes docs/*.md: the knob table in
+# docs/operations.md is a linted contract surface (knob-doc-drift), so
+# a docs-only diff still re-lints contracts instead of short-circuiting.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."

@@ -84,20 +84,72 @@ for fam in ("lane-slot", "metrics-stripe", "pel2-record"):
 ' || fail "--dump-effects roots/frames incomplete"
 echo "ok   dump-effects lists every seeded hot-path root + frame family"
 
-python - <<'PY' || fail "effect fixpoint exceeded 10s budget"
+python - <<'PY' || fail "effects+contracts exceeded the 10s lint budget"
 import time
-from pio_tpu.analysis.core import Finding, collect_files, parse_module
+from pio_tpu.analysis.contracts import get_contracts
+from pio_tpu.analysis.core import (
+    Finding, LintContext, collect_files, parse_module,
+)
 from pio_tpu.analysis.effects import EffectAnalysis
 
 mods = [m for m in (parse_module(p) for p in collect_files(["pio_tpu"]))
         if not isinstance(m, Finding)]
 t0 = time.monotonic()
 EffectAnalysis(mods)
+get_contracts(mods, LintContext())
 dt = time.monotonic() - t0
-assert dt < 10.0, f"effect fixpoint took {dt:.1f}s (budget 10s)"
-print(f"     effect fixpoint over {len(mods)} modules: {dt:.2f}s")
+assert dt < 10.0, f"effects+contracts took {dt:.1f}s (budget 10s)"
+print(f"     effects + contracts over {len(mods)} modules: {dt:.2f}s")
 PY
-echo "ok   effect fixpoint within budget"
+echo "ok   effect fixpoint + contract extraction within budget"
+
+# ------------------------------------------------ contract surfaces
+# ISSUE 20: the contract-drift rules must be registered, clean on
+# their own (not just drowned in a clean aggregate), and the dump
+# inventory must cover the cross-process surface end to end.
+python -m pio_tpu.tools.cli lint --list-rules | python -c '
+import sys
+have = {line.split()[0] for line in sys.stdin if line.strip()}
+need = {"endpoint-drift", "header-drift", "knob-default-drift",
+        "knob-doc-drift", "failpoint-coverage"}
+missing = need - have
+assert not missing, f"contract rules not registered: {missing}"
+' || fail "contract rules missing from --list-rules"
+echo "ok   all five contract-drift rules registered"
+
+python -m pio_tpu.tools.cli lint pio_tpu tests --json \
+    --rules endpoint-drift,header-drift,knob-default-drift,knob-doc-drift,failpoint-coverage \
+    | python -c '
+import json, sys
+doc = json.load(sys.stdin)
+assert doc["count"] == 0, f"contract-drift findings: {doc}"
+' || fail "contract-drift rules not clean"
+echo "ok   contract-drift rules clean over the tree"
+
+python -m pio_tpu.tools.cli lint --dump-contracts pio_tpu tests \
+    | python -c '
+import json, sys
+doc = json.load(sys.stdin)
+eps = set(doc["endpoints"])
+need = {"/fleet.json", "/train.json", "/device.json", "/stats.json",
+        "/slo.json", "/qos.json", "/storage.json", "/rollout.json",
+        "/queries.json", "/events.json", "/router.json"}
+missing = need - eps
+assert not missing, f"endpoints missing from --dump-contracts: {missing}"
+fleet = doc["endpoints"]["/fleet.json"]
+assert fleet["producers"] and fleet["keys"] and fleet["consumers"], \
+    "/fleet.json inventory must carry producers, keys and consumers"
+hdrs = set(doc["headers"])
+for h in ("x-pio-priority", "x-pio-deadline-ms", "x-pio-trace"):
+    assert h in hdrs, f"header {h} missing from --dump-contracts"
+from pio_tpu.utils.knobs import KNOBS
+knobs = doc["knobs"]
+unlisted = set(KNOBS) - set(knobs)
+assert not unlisted, f"registry knobs missing from dump: {unlisted}"
+for name in KNOBS:
+    assert "default" in knobs[name], f"{name} has no canonical default"
+' || fail "--dump-contracts inventory incomplete"
+echo "ok   dump-contracts inventories endpoints, headers + every knob"
 
 # Boot: train the recommendation template on a tiny in-memory corpus,
 # serve it with a declared SLO, publish the ephemeral port, then park.

@@ -1,0 +1,342 @@
+"""Contract extraction + drift rules (ISSUE 20): bad/clean fixture
+pairs per rule, the real-tree guards (the /fleet.json producer must
+cover every scraper read; the knob registry must round-trip every
+swept reader), and the ``--dump-contracts`` CLI surface.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from pio_tpu.analysis.contracts import get_contracts
+from pio_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    collect_files,
+    parse_module,
+    run_lint,
+)
+from pio_tpu.utils.knobs import KNOBS, Knob
+
+
+def lint_files(tmp_path, files, *, rules, knob_registry=None,
+               repo_root=None):
+    paths = []
+    for name, src in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    return run_lint(paths, rule_ids=rules,
+                    knob_registry=knob_registry,
+                    repo_root=repo_root or str(tmp_path))
+
+
+# ------------------------------------------------------- endpoint-drift
+_PRODUCER = """
+    # pio: endpoint=/thing.json
+    def build():
+        return {"alpha": 1, "beta": {"gamma": 2}}
+    """
+
+
+class TestEndpointDrift:
+    def test_missing_key_is_a_finding_with_suggestion(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "prod.py": _PRODUCER,
+            "cons.py": """
+                def scrape(http):
+                    pay = http("http://h:1/thing.json")
+                    return pay["delta"]
+                """,
+        }, rules=["endpoint-drift"])
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.rule == "endpoint-drift" and f.path.endswith("cons.py")
+        assert "'delta'" in f.message
+        assert "prod.py" in f.message          # names the producer
+        assert "closest produced key" in f.message
+
+    def test_produced_keys_read_clean(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "prod.py": _PRODUCER,
+            "cons.py": """
+                def scrape(http):
+                    pay = http("http://h:1/thing.json")
+                    return pay["alpha"], pay["beta"]["gamma"]
+                """,
+        }, rules=["endpoint-drift"])
+        assert findings == []
+
+    def test_consumes_marker_seeds_the_parameter(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "prod.py": _PRODUCER,
+            "cons.py": """
+                # pio: consumes=/thing.json
+                def ingest(payload):
+                    return payload["omega"]
+                """,
+        }, rules=["endpoint-drift"])
+        assert len(findings) == 1
+        assert "'omega'" in findings[0].message
+
+    def test_wildcard_producer_grants_unknown_keys(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "prod.py": """
+                # pio: endpoint=/dyn.json
+                def build(names):
+                    return {n: 0 for n in names}
+                """,
+            "cons.py": """
+                def scrape(http):
+                    pay = http("http://h:1/dyn.json")
+                    return pay["anything"]
+                """,
+        }, rules=["endpoint-drift"])
+        assert findings == []
+
+
+# --------------------------------------------------------- header-drift
+class TestHeaderDrift:
+    def test_consume_only_header_is_a_finding(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "handler.py": """
+                def handler(req):
+                    return req.get("X-Pio-Widget-Count")
+                """,
+        }, rules=["header-drift"])
+        assert len(findings) == 1
+        assert "never produced" in findings[0].message
+
+    def test_produce_only_header_is_a_finding(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "emit.py": """
+                def emit(resp):
+                    resp.send_header("X-Pio-Widget-Count", "3")
+                """,
+        }, rules=["header-drift"])
+        assert len(findings) == 1
+        assert "never consumed" in findings[0].message
+
+    def test_both_sides_clean(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "emit.py": """
+                def emit(resp):
+                    resp.send_header("X-Pio-Widget-Count", "3")
+                """,
+            "handler.py": """
+                def handler(req):
+                    return req.get("X-Pio-Widget-Count")
+                """,
+        }, rules=["header-drift"])
+        assert findings == []
+
+
+# --------------------------------------------------- knob-default-drift
+_FIXTURE_REGISTRY = {
+    "PIO_TPU_WIDGETS": Knob("PIO_TPU_WIDGETS", "int", 4, "fixture"),
+}
+
+
+class TestKnobDefaultDrift:
+    def test_bypass_with_disagreeing_default(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "reader.py": """
+                from pio_tpu.utils.envutil import env_int
+
+                def n():
+                    return env_int("PIO_TPU_WIDGETS", 9)
+                """,
+        }, rules=["knob-default-drift"],
+            knob_registry=_FIXTURE_REGISTRY)
+        assert len(findings) == 1
+        msg = findings[0].message
+        assert "bypasses the knob registry" in msg
+        assert "9" in msg and "4" in msg      # both defaults named
+
+    def test_undeclared_name_is_a_finding(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "reader.py": """
+                import os
+
+                def n():
+                    return os.environ.get("PIO_TPU_MYSTERY", "x")
+                """,
+        }, rules=["knob-default-drift"],
+            knob_registry=_FIXTURE_REGISTRY)
+        assert len(findings) == 1
+        assert "undeclared" in findings[0].message
+
+    def test_registry_read_is_clean(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "reader.py": """
+                from pio_tpu.utils import knobs
+
+                def n():
+                    return knobs.knob_int("PIO_TPU_WIDGETS")
+                """,
+        }, rules=["knob-default-drift"],
+            knob_registry=_FIXTURE_REGISTRY)
+        assert findings == []
+
+    def test_registry_read_of_undeclared_name(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "reader.py": """
+                from pio_tpu.utils import knobs
+
+                def n():
+                    return knobs.knob_int("PIO_TPU_NOT_DECLARED")
+                """,
+        }, rules=["knob-default-drift"],
+            knob_registry=_FIXTURE_REGISTRY)
+        assert len(findings) == 1
+        assert "never declared" in findings[0].message
+
+
+# ------------------------------------------------------- knob-doc-drift
+def _doc_repo(tmp_path, row):
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "operations.md").write_text(
+        "# ops\n\n| Knob | Kind | Default | What it does |\n"
+        "|---|---|---|---|\n" + row + "\n"
+    )
+
+
+class TestKnobDocDrift:
+    def test_wrong_documented_default(self, tmp_path):
+        _doc_repo(tmp_path,
+                  "| `PIO_TPU_WIDGETS` | int | `9` | fixture |")
+        findings = lint_files(tmp_path, {"mod.py": "x = 1\n"},
+                              rules=["knob-doc-drift"],
+                              knob_registry=_FIXTURE_REGISTRY)
+        assert len(findings) == 1
+        assert "documented default `9` disagrees" in findings[0].message
+
+    def test_missing_and_stale_rows(self, tmp_path):
+        _doc_repo(tmp_path,
+                  "| `PIO_TPU_GONE` | int | `1` | removed long ago |")
+        findings = lint_files(tmp_path, {"mod.py": "x = 1\n"},
+                              rules=["knob-doc-drift"],
+                              knob_registry=_FIXTURE_REGISTRY)
+        msgs = "\n".join(f.message for f in findings)
+        assert len(findings) == 2
+        assert "missing from the docs" in msgs      # PIO_TPU_WIDGETS
+        assert "does not exist in the registry" in msgs  # PIO_TPU_GONE
+
+    def test_matching_table_is_clean(self, tmp_path):
+        _doc_repo(tmp_path,
+                  "| `PIO_TPU_WIDGETS` | int | `4` | fixture |")
+        findings = lint_files(tmp_path, {"mod.py": "x = 1\n"},
+                              rules=["knob-doc-drift"],
+                              knob_registry=_FIXTURE_REGISTRY)
+        assert findings == []
+
+
+# --------------------------------------------------- failpoint-coverage
+_FAILPOINT_MOD = """
+    from pio_tpu.faults import failpoint
+
+    def work():
+        failpoint("fixture.widget.spin")
+    """
+
+
+class TestFailpointCoverage:
+    def test_unarmed_failpoint_is_a_finding(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "widget.py": _FAILPOINT_MOD,
+            "test_widget.py": """
+                def test_nothing():
+                    assert True
+                """,
+        }, rules=["failpoint-coverage"])
+        assert len(findings) == 1
+        assert "fixture.widget.spin" in findings[0].message
+        assert "never armed" in findings[0].message
+
+    def test_armed_by_test_string_is_clean(self, tmp_path):
+        findings = lint_files(tmp_path, {
+            "widget.py": _FAILPOINT_MOD,
+            "test_widget.py": """
+                def test_chaos(faults):
+                    faults.install("fixture.widget.spin=error")
+                """,
+        }, rules=["failpoint-coverage"])
+        assert findings == []
+
+    def test_production_slice_proves_nothing(self, tmp_path):
+        # no test modules in view → absence of arming is not evidence
+        findings = lint_files(tmp_path, {
+            "widget.py": _FAILPOINT_MOD,
+        }, rules=["failpoint-coverage"])
+        assert findings == []
+
+
+# ------------------------------------------------------ real-tree guards
+@pytest.fixture(scope="module")
+def tree_contracts():
+    files = collect_files(["pio_tpu", "tests"])
+    mods = [m for m in (parse_module(f) for f in files)
+            if not isinstance(m, Finding)]
+    return get_contracts(mods, LintContext())
+
+
+class TestRealTreeGuards:
+    def test_fleet_producer_covers_every_scraper_read(
+            self, tree_contracts):
+        c = tree_contracts
+        keys = c.keys.get("/fleet.json", set())
+        assert len(keys) > 20, "fleet payload key tree looks truncated"
+        reads = [r for r in c.reads if r.endpoint == "/fleet.json"]
+        assert reads, "no /fleet.json consumer chains extracted"
+        for r in reads:
+            for seg in r.key.split("."):
+                assert seg in keys or "*" in keys, (
+                    f"{r.path}:{r.line} reads {r.key!r} but the fleet "
+                    f"producer never writes {seg!r}"
+                )
+
+    def test_registry_round_trips_every_swept_reader(
+            self, tree_contracts):
+        for site in tree_contracts.knob_reads:
+            if site.is_test or site.via != "registry":
+                continue
+            assert site.name in KNOBS, (
+                f"{site.path}:{site.line} reads {site.name} through "
+                f"the registry helpers but the registry never "
+                f"declares it"
+            )
+
+    def test_every_knob_has_exactly_one_canonical_default(self):
+        # frozen dataclass + one declaration tuple: names are unique
+        names = [k for k in KNOBS]
+        assert len(names) == len(set(names))
+        for knob in KNOBS.values():
+            assert knob.kind in ("int", "float", "str")
+            assert isinstance(knob.doc, str) and knob.doc
+
+    def test_headers_all_flow_both_ways(self, tree_contracts):
+        produced = {h.header for h in tree_contracts.headers
+                    if h.role == "write"}
+        consumed = {h.header for h in tree_contracts.headers
+                    if h.role == "read"}
+        # the forwarding prefix constant declares, it doesn't flow
+        assert consumed - {"x-pio-"} <= produced
+
+
+# ------------------------------------------------------------------- CLI
+class TestDumpContractsCLI:
+    def test_dump_contracts_payload(self, capsys):
+        from pio_tpu.tools.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["lint", "--dump-contracts", "pio_tpu/utils"]
+        )
+        assert args.fn(args) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert set(payload) == {"endpoints", "headers", "knobs"}
+        # the registry is always joined in, even over a narrow slice
+        assert "PIO_TPU_HTTP_FRONT" in payload["knobs"]
+        assert payload["knobs"]["PIO_TPU_HTTP_FRONT"]["default"] == \
+            "threaded"
